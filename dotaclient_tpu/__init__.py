@@ -1,0 +1,10 @@
+"""dotaclient-tpu: a TPU-native distributed self-play PPO framework.
+
+Brand-new implementation of the capabilities of TimZaman/dotaclient
+(see SURVEY.md): CPU actor processes drive a Dota2-style gRPC environment,
+stream variable-length LSTM trajectories through an experience broker, and
+a JAX/Flax learner runs the PPO+GAE train step jit/pjit-compiled over a
+TPU device mesh with gradient reduction over ICI.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,114 @@
+"""Flat dataclass configs, one per binary, overridable by CLI flags.
+
+The reference configures each entrypoint with argparse flags and k8s env
+vars and deliberately has no config framework (SURVEY.md §5 "Config / flag
+system"); we mirror that: plain dataclasses + an argparse bridge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PolicyConfig:
+    """Architecture of the LSTM actor-critic (reference: policy.py)."""
+
+    unit_embed_dim: int = 128
+    lstm_hidden: int = 128
+    mlp_hidden: int = 128
+    n_move_bins: int = 9  # 9-way discretized move offsets per axis
+    move_step: float = 350.0  # map units per outermost move-grid cell
+    # Must equal featurizer.MAX_UNITS — the featurizer emits fixed
+    # [MAX_UNITS, UNIT_FEATURES] arrays; the policy asserts this at init.
+    max_units: int = 16
+    # Auxiliary value heads (benchmark config 5: win-prob, last-hit, net-worth).
+    aux_heads: bool = False
+    dtype: str = "bfloat16"  # compute dtype on TPU; params stay f32
+
+
+@dataclass
+class PPOConfig:
+    """PPO + GAE hyperparameters (reference: optimizer.py)."""
+
+    gamma: float = 0.98
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    value_coef: float = 0.5
+    value_clip: float = 0.2
+    entropy_coef: float = 0.01
+    lr: float = 1e-4
+    adam_eps: float = 1e-5
+    max_grad_norm: float = 0.5
+    # Experience older than this many learner versions is dropped on the host
+    # (reference drops/weights stale experience by model version).
+    max_staleness: int = 4
+
+
+@dataclass
+class LearnerConfig:
+    """Learner binary (reference: optimizer.py CLI)."""
+
+    batch_size: int = 256  # sequences per train step (global, across dp shards)
+    seq_len: int = 16  # rollout chunk length = LSTM truncation window
+    ppo: PPOConfig = field(default_factory=PPOConfig)
+    policy: PolicyConfig = field(default_factory=PolicyConfig)
+    broker_url: str = "mem://"
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 100  # steps between durable checkpoints
+    publish_every: int = 1  # steps between weight fanout publishes
+    log_dir: str = ""
+    seed: int = 0
+    mesh_shape: str = "dp=-1"  # e.g. "dp=4,tp=2"; -1 = all remaining devices
+
+
+@dataclass
+class ActorConfig:
+    """Actor binary (reference: agent.py CLI)."""
+
+    env_addr: str = "localhost:13337"
+    broker_url: str = "mem://"
+    rollout_len: int = 16  # steps per published experience chunk
+    host_timescale: float = 10.0
+    ticks_per_observation: int = 30
+    max_dota_time: float = 600.0
+    hero: str = "npc_dota_hero_nevermore"
+    opponent: str = "scripted"  # "scripted" | "self"
+    policy: PolicyConfig = field(default_factory=PolicyConfig)
+    seed: int = 0
+
+
+def add_flags(parser: argparse.ArgumentParser, cfg, prefix: str = "") -> None:
+    """Register one --flag per (possibly nested) dataclass field."""
+    for f in dataclasses.fields(cfg):
+        val = getattr(cfg, f.name)
+        name = f"{prefix}{f.name}"
+        if dataclasses.is_dataclass(val):
+            add_flags(parser, val, prefix=f"{name}.")
+        elif isinstance(val, bool):
+            parser.add_argument(f"--{name}", type=lambda s: s.lower() in ("1", "true", "yes"), default=val)
+        else:
+            parser.add_argument(f"--{name}", type=type(val), default=val)
+
+
+def parse_config(cfg, argv=None):
+    """Parse CLI flags into a fresh deep copy of `cfg` (returns the copy)."""
+    cfg = copy.deepcopy(cfg)
+    parser = argparse.ArgumentParser()
+    add_flags(parser, cfg)
+    args = parser.parse_args(argv)
+    _apply(cfg, vars(args))
+    return cfg
+
+
+def _apply(cfg, flat: dict, prefix: str = "") -> None:
+    for f in dataclasses.fields(cfg):
+        val = getattr(cfg, f.name)
+        name = f"{prefix}{f.name}"
+        if dataclasses.is_dataclass(val):
+            _apply(val, flat, prefix=f"{name}.")
+        elif name in flat:
+            setattr(cfg, f.name, flat[name])

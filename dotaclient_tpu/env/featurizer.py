@@ -1,0 +1,193 @@
+"""World-state → fixed-shape feature arrays.
+
+The reference featurizes each `CMsgBotWorldState` inside agent.py's hot loop
+into hero-stat vectors plus per-unit feature rows that feed the policy's
+unit embeddings (SURVEY.md §3.1, §3.3). TPU-first re-design decisions:
+
+- **Static shapes everywhere.** XLA traces once; a worldstate with 3 units
+  and one with 40 must produce identically shaped arrays. We take the
+  `MAX_UNITS` nearest units to the controlled hero and carry validity masks.
+- **Masks are first-class outputs**, not an afterthought: `unit_mask`
+  (slot holds a real unit), `target_mask` (slot is a legal attack target)
+  and `action_mask` (legal action types) flow straight into the policy's
+  masked heads, so "no attackable units ⇒ attack head masked" is decided
+  on the host once, never via data-dependent control flow under jit.
+- Features are coarse normalizations (fractions, log-scales, clipped
+  offsets) so bfloat16 is safe on device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+
+# ---------------------------------------------------------------------------
+# Schema constants (shared with the policy).
+MAX_UNITS = 16
+UNIT_FEATURES = 16
+HERO_FEATURES = 16
+GLOBAL_FEATURES = 8
+
+# Action-type head ordering (reference: {noop, move, attack[, ability]}).
+ACT_NOOP, ACT_MOVE, ACT_ATTACK, ACT_CAST = 0, 1, 2, 3
+N_ACTION_TYPES = 4
+
+# Spatial normalization scales (dota map is roughly ±8000 units).
+_MAP_SCALE = 8000.0
+_LOCAL_SCALE = 3000.0  # neighbourhood radius for unit offsets
+_CREEP_WAVE_PERIOD = 30.0  # seconds between creep waves
+
+
+class Observation(NamedTuple):
+    """One featurized observation; every leaf has a static shape.
+
+    Leaves are numpy on the host; the same structure (stacked to [B] or
+    [B, T]) is what the policy consumes on device.
+    """
+
+    global_feats: np.ndarray  # [GLOBAL_FEATURES] f32
+    hero_feats: np.ndarray  # [HERO_FEATURES] f32
+    unit_feats: np.ndarray  # [MAX_UNITS, UNIT_FEATURES] f32
+    unit_mask: np.ndarray  # [MAX_UNITS] bool — slot holds a unit
+    target_mask: np.ndarray  # [MAX_UNITS] bool — legal attack target
+    action_mask: np.ndarray  # [N_ACTION_TYPES] bool — legal action types
+
+
+def zeros_observation() -> Observation:
+    action_mask = np.zeros(N_ACTION_TYPES, bool)
+    action_mask[ACT_NOOP] = True
+    return Observation(
+        global_feats=np.zeros(GLOBAL_FEATURES, np.float32),
+        hero_feats=np.zeros(HERO_FEATURES, np.float32),
+        unit_feats=np.zeros((MAX_UNITS, UNIT_FEATURES), np.float32),
+        unit_mask=np.zeros(MAX_UNITS, bool),
+        target_mask=np.zeros(MAX_UNITS, bool),
+        action_mask=action_mask,
+    )
+
+
+def find_hero(world: ws.World, player_id: int) -> Optional[ws.Unit]:
+    for u in world.units:
+        if u.unit_type == ws.Unit.HERO and u.player_id == player_id:
+            return u
+    return None
+
+
+def _sorted_others(world: ws.World, hero: ws.Unit):
+    """All non-self units sorted nearest-first — the single source of truth
+    for the feature-slot ↔ unit correspondence (featurize and
+    handles_for_slots must agree exactly)."""
+    others = [u for u in world.units if u.handle != hero.handle]
+    others.sort(key=lambda u: (u.x - hero.x) ** 2 + (u.y - hero.y) ** 2)
+    return others[:MAX_UNITS]
+
+
+def _unit_row(u: ws.Unit, hero: ws.Unit, out: np.ndarray) -> None:
+    dx = u.x - hero.x
+    dy = u.y - hero.y
+    dist = math.hypot(dx, dy)
+    is_enemy = u.team_id != hero.team_id
+    hp_max = max(u.health_max, 1.0)
+    out[0] = 1.0 if is_enemy else 0.0
+    out[1] = 0.0 if is_enemy else 1.0
+    out[2] = 1.0 if u.unit_type == ws.Unit.HERO else 0.0
+    out[3] = 1.0 if u.unit_type == ws.Unit.LANE_CREEP else 0.0
+    out[4] = 1.0 if u.unit_type in (ws.Unit.TOWER, ws.Unit.BARRACKS, ws.Unit.FORT) else 0.0
+    out[5] = 1.0 if u.unit_type not in (ws.Unit.HERO, ws.Unit.LANE_CREEP, ws.Unit.TOWER, ws.Unit.BARRACKS, ws.Unit.FORT) else 0.0
+    out[6] = u.health / hp_max
+    out[7] = math.log1p(max(u.health, 0.0)) / 8.0
+    out[8] = np.clip(dx / _LOCAL_SCALE, -1.0, 1.0)
+    out[9] = np.clip(dy / _LOCAL_SCALE, -1.0, 1.0)
+    out[10] = min(dist / _LOCAL_SCALE, 1.0)
+    out[11] = 1.0 if dist <= hero.attack_range else 0.0
+    out[12] = u.attack_damage / 200.0
+    out[13] = u.speed / 500.0
+    out[14] = math.cos(u.facing)
+    out[15] = 1.0 if u.is_alive else 0.0
+
+
+def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
+    hp_max = max(h.health_max, 1.0)
+    mana_max = max(h.mana_max, 1.0)
+    out[0] = h.level / 25.0
+    out[1] = h.health / hp_max
+    out[2] = math.log1p(max(h.health, 0.0)) / 8.0
+    out[3] = h.health_regen / 20.0
+    out[4] = h.mana / mana_max
+    out[5] = np.clip(h.x / _MAP_SCALE, -1.0, 1.0)
+    out[6] = np.clip(h.y / _MAP_SCALE, -1.0, 1.0)
+    out[7] = math.sin(h.facing)
+    out[8] = math.cos(h.facing)
+    out[9] = h.attack_damage / 200.0
+    out[10] = h.attack_range / 1000.0
+    out[11] = h.speed / 500.0
+    out[12] = math.log1p(max(h.gold, 0)) / 10.0
+    out[13] = math.log1p(max(h.xp, 0)) / 10.0
+    out[14] = h.last_hits / 100.0
+    out[15] = 1.0 if h.is_alive else 0.0
+
+
+def featurize(world: ws.World, player_id: int) -> Observation:
+    """Featurize one worldstate for the hero controlled by `player_id`.
+
+    Nearest-`MAX_UNITS` units (excluding the controlled hero) sorted by
+    distance; masks computed host-side. If the hero is absent (dead and
+    despawned), returns a zero observation with only NOOP legal.
+    """
+    # All stat-derived features are defensively clamped to this range so a
+    # corrupt/adversarial worldstate cannot inject huge activations.
+    _CLAMP = 8.0
+    hero = find_hero(world, player_id)
+    obs = zeros_observation()
+    gf = obs.global_feats
+    gf[0] = world.dota_time / 600.0
+    gf[1] = math.sin(2.0 * math.pi * world.dota_time / _CREEP_WAVE_PERIOD)
+    gf[2] = math.cos(2.0 * math.pi * world.dota_time / _CREEP_WAVE_PERIOD)
+    gf[3] = world.game_state / 10.0
+    gf[4] = 1.0 if world.team_id == 2 else -1.0  # radiant/dire indicator
+    gf[5] = world.tick / 1e5
+    np.clip(gf, -_CLAMP, _CLAMP, out=gf)
+    if hero is None or not hero.is_alive:
+        return obs
+
+    _hero_row(hero, obs.hero_feats)
+
+    for i, u in enumerate(_sorted_others(world, hero)):
+        _unit_row(u, hero, obs.unit_feats[i])
+        obs.unit_mask[i] = True
+        obs.target_mask[i] = (
+            u.team_id != hero.team_id
+            and u.is_alive
+            and u.unit_type in (ws.Unit.HERO, ws.Unit.LANE_CREEP, ws.Unit.JUNGLE_CREEP, ws.Unit.TOWER, ws.Unit.BARRACKS, ws.Unit.FORT, ws.Unit.ROSHAN)
+        )
+
+    np.clip(obs.hero_feats, -_CLAMP, _CLAMP, out=obs.hero_feats)
+    np.clip(obs.unit_feats, -_CLAMP, _CLAMP, out=obs.unit_feats)
+
+    castable = any(a.is_castable and a.cooldown_remaining <= 0.0 and a.mana_cost <= hero.mana for a in hero.abilities)
+    obs.action_mask[ACT_NOOP] = True
+    obs.action_mask[ACT_MOVE] = True
+    obs.action_mask[ACT_ATTACK] = bool(obs.target_mask.any())
+    obs.action_mask[ACT_CAST] = castable
+    return obs
+
+
+def handles_for_slots(world: ws.World, player_id: int) -> np.ndarray:
+    """Unit handle per feature slot (0 = empty) — maps the policy's target
+    head index back to a concrete unit handle for the Actions proto."""
+    hero = find_hero(world, player_id)
+    out = np.zeros(MAX_UNITS, np.uint32)
+    if hero is None or not hero.is_alive:
+        return out
+    for i, u in enumerate(_sorted_others(world, hero)):
+        out[i] = u.handle
+    return out
+
+
+def stack(observations) -> Observation:
+    """Stack a list of Observations along a new leading axis."""
+    return Observation(*(np.stack(xs) for xs in zip(*observations)))

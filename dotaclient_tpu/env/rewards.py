@@ -1,0 +1,99 @@
+"""Shaped component rewards from consecutive world-state deltas.
+
+The reference computes per-step rewards in agent.py as a weighted sum of
+component deltas between the previous and current worldstate — xp, hp,
+last-hits, denies, kills/deaths, tower damage, and a terminal win bonus
+(SURVEY.md §3.1 hot loop). Exact reference weights are [MED]-confidence
+(mount was empty); the weights below follow the same component set and are
+centralized so they can be corrected against a populated reference.
+
+Host-side pure Python/numpy: rewards are computed once per env step on the
+actor CPU, never on device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+from dotaclient_tpu.env.featurizer import find_hero
+
+REWARD_WEIGHTS: Dict[str, float] = {
+    "xp": 0.002,  # per xp point
+    "hp": 0.5,  # per health fraction
+    "mana": 0.25,  # per mana fraction
+    "last_hits": 0.16,
+    "denies": 0.15,
+    "kills": 0.5,
+    "deaths": -0.5,
+    "tower_hp": 1.0,  # per enemy-tower health fraction destroyed
+    "win": 2.5,
+}
+
+
+def _tower_hp_frac(world: ws.World, enemy_team: int) -> float:
+    total = 0.0
+    for u in world.units:
+        if u.team_id == enemy_team and u.unit_type in (ws.Unit.TOWER, ws.Unit.FORT, ws.Unit.BARRACKS):
+            total += u.health / max(u.health_max, 1.0)
+    return total
+
+
+def component_rewards(
+    prev: Optional[ws.World],
+    world: ws.World,
+    player_id: int,
+    last_hero: Optional[ws.Unit] = None,
+) -> Dict[str, float]:
+    """Per-component reward deltas for `player_id` between two observations.
+
+    `prev` may be None (first step): all deltas are zero except `win`.
+    A dead hero contributes via the deaths counter, not a spurious negative
+    hp delta. If the hero record despawns from `prev` entirely, pass
+    `last_hero` — the last worldstate snapshot of the hero the caller saw —
+    so counter deltas (deaths, kills, xp, last-hits) spanning the despawn
+    gap are not lost; the actor loop maintains this snapshot.
+    """
+    out = {k: 0.0 for k in REWARD_WEIGHTS}
+    hero = find_hero(world, player_id)
+    prev_hero = find_hero(prev, player_id) if prev is not None else None
+    if prev_hero is None:
+        prev_hero = last_hero
+
+    if world.winning_team:
+        out["win"] = 1.0 if world.winning_team == world.team_id else -1.0
+
+    if hero is None or prev_hero is None:
+        return out
+
+    out["xp"] = float(hero.xp - prev_hero.xp)
+    if hero.is_alive and prev_hero.is_alive:
+        hp_frac = hero.health / max(hero.health_max, 1.0)
+        prev_hp_frac = prev_hero.health / max(prev_hero.health_max, 1.0)
+        out["hp"] = hp_frac - prev_hp_frac
+        mana_frac = hero.mana / max(hero.mana_max, 1.0)
+        prev_mana_frac = prev_hero.mana / max(prev_hero.mana_max, 1.0)
+        out["mana"] = mana_frac - prev_mana_frac
+    out["last_hits"] = float(hero.last_hits - prev_hero.last_hits)
+    out["denies"] = float(hero.denies - prev_hero.denies)
+    out["kills"] = float(hero.kills - prev_hero.kills)
+    out["deaths"] = float(hero.deaths - prev_hero.deaths)
+
+    if prev is not None:
+        enemy_team = 3 if hero.team_id == 2 else 2
+        out["tower_hp"] = _tower_hp_frac(prev, enemy_team) - _tower_hp_frac(world, enemy_team)
+    return out
+
+
+def total_reward(components: Dict[str, float]) -> float:
+    return math.fsum(REWARD_WEIGHTS[k] * v for k, v in components.items())
+
+
+def reward(
+    prev: Optional[ws.World],
+    world: ws.World,
+    player_id: int,
+    last_hero: Optional[ws.Unit] = None,
+) -> float:
+    return total_reward(component_rewards(prev, world, player_id, last_hero))

@@ -1,0 +1,7 @@
+"""Generated protobuf modules (worldstate, dotaservice).
+
+Regenerate with ./regen.sh (protoc only; gRPC stubs are hand-written in
+dotaclient_tpu/env/service.py because grpc_tools is not in the image).
+"""
+
+from . import worldstate_pb2, dotaservice_pb2  # noqa: F401

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Regenerate the protobuf Python modules. Run from this directory.
+# grpc_tools is not available in the image, so only message classes are
+# generated; the gRPC service stubs are hand-written in
+# dotaclient_tpu/env/service.py using grpc's generic handler API.
+set -e
+protoc --python_out=. -I. worldstate.proto dotaservice.proto
+# protoc emits absolute sibling imports; make them package-relative.
+sed -i 's/^import worldstate_pb2 as/from . import worldstate_pb2 as/' dotaservice_pb2.py

@@ -1,0 +1,12 @@
+"""Test harness: force JAX onto 8 virtual CPU devices BEFORE jax imports.
+
+This proves every mesh/collective code path (dp/tp shardings, psum/pmean
+over the mesh) without TPU hardware, per SURVEY.md §4 item 4.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"

@@ -1,0 +1,110 @@
+import numpy as np
+
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+
+
+def make_world(n_creeps=3, hero_alive=True, with_enemy_hero=True):
+    w = ws.World(dota_time=42.0, game_state=5, tick=1000, team_id=2)
+    w.units.add(
+        handle=1, unit_type=ws.Unit.HERO, team_id=2, player_id=0,
+        x=0.0, y=0.0, level=3, health=400 if hero_alive else 0, health_max=600,
+        mana=200, mana_max=300, attack_damage=50, attack_range=600, speed=300,
+        is_alive=hero_alive, gold=600, xp=900, last_hits=7, denies=2,
+    )
+    if with_enemy_hero:
+        w.units.add(
+            handle=2, unit_type=ws.Unit.HERO, team_id=3, player_id=5,
+            x=500, y=100, health=500, health_max=550, is_alive=True,
+            attack_damage=45, speed=290,
+        )
+    for i in range(n_creeps):
+        w.units.add(
+            handle=10 + i, unit_type=ws.Unit.LANE_CREEP, team_id=3,
+            x=300.0 + 50 * i, y=-50.0, health=300, health_max=550,
+            is_alive=True, attack_damage=20, speed=325,
+        )
+    return w
+
+
+def test_shapes_and_dtypes():
+    obs = F.featurize(make_world(), player_id=0)
+    assert obs.global_feats.shape == (F.GLOBAL_FEATURES,)
+    assert obs.hero_feats.shape == (F.HERO_FEATURES,)
+    assert obs.unit_feats.shape == (F.MAX_UNITS, F.UNIT_FEATURES)
+    assert obs.unit_mask.shape == (F.MAX_UNITS,)
+    assert obs.target_mask.shape == (F.MAX_UNITS,)
+    assert obs.action_mask.shape == (F.N_ACTION_TYPES,)
+    assert obs.unit_feats.dtype == np.float32
+    assert obs.unit_mask.dtype == bool
+
+
+def test_unit_ordering_and_masks():
+    obs = F.featurize(make_world(n_creeps=3), player_id=0)
+    # 4 other units present → 4 valid slots, sorted nearest-first.
+    assert obs.unit_mask.sum() == 4
+    assert not obs.unit_mask[4:].any()
+    dists = obs.unit_feats[:4, 10]
+    assert (np.diff(dists) >= -1e-6).all()
+    # All others are enemies and alive → all are legal targets.
+    assert obs.target_mask.sum() == 4
+    # noop/move/attack legal; no castable ability → cast masked.
+    assert obs.action_mask.tolist() == [True, True, True, False]
+
+
+def test_no_targets_masks_attack():
+    w = make_world(n_creeps=0, with_enemy_hero=False)
+    obs = F.featurize(w, player_id=0)
+    assert obs.unit_mask.sum() == 0
+    assert not obs.target_mask.any()
+    assert not obs.action_mask[F.ACT_ATTACK]
+
+
+def test_dead_hero_zero_obs():
+    obs = F.featurize(make_world(hero_alive=False), player_id=0)
+    assert not obs.unit_mask.any()
+    assert obs.action_mask.tolist() == [True, False, False, False]
+    assert np.all(obs.hero_feats == 0)
+
+
+def test_missing_player_zero_obs():
+    obs = F.featurize(make_world(), player_id=99)
+    assert not obs.unit_mask.any()
+    assert obs.action_mask[F.ACT_NOOP]
+
+
+def test_handles_for_slots_align_with_target_mask():
+    w = make_world(n_creeps=2)
+    obs = F.featurize(w, player_id=0)
+    handles = F.handles_for_slots(w, player_id=0)
+    assert (handles[obs.unit_mask] != 0).all()
+    assert (handles[~obs.unit_mask] == 0).all()
+
+
+def test_stack():
+    obs = [F.featurize(make_world(), 0) for _ in range(5)]
+    batched = F.stack(obs)
+    assert batched.unit_feats.shape == (5, F.MAX_UNITS, F.UNIT_FEATURES)
+    assert batched.action_mask.shape == (5, F.N_ACTION_TYPES)
+
+
+def test_values_are_finite_and_normalized():
+    obs = F.featurize(make_world(), 0)
+    for leaf in obs[:3]:
+        assert np.isfinite(leaf).all()
+        assert np.abs(leaf).max() < 10.0
+
+
+def test_dead_hero_global_feats_clamped():
+    w = make_world(hero_alive=False)
+    w.dota_time = 1e7
+    obs = F.featurize(w, player_id=0)
+    assert np.abs(obs.global_feats).max() <= 8.0
+
+
+def test_parse_config_does_not_mutate_base():
+    from dotaclient_tpu.config import LearnerConfig, parse_config
+    base = LearnerConfig()
+    out = parse_config(base, ["--ppo.gamma", "0.5"])
+    assert out.ppo.gamma == 0.5
+    assert base.ppo.gamma != 0.5

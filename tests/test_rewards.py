@@ -1,0 +1,94 @@
+import math
+
+from dotaclient_tpu.env import rewards as R
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+
+from tests.test_featurizer import make_world
+
+
+def clone(w):
+    out = ws.World()
+    out.CopyFrom(w)
+    return out
+
+
+def hero(w, player_id=0):
+    for u in w.units:
+        if u.unit_type == ws.Unit.HERO and u.player_id == player_id:
+            return u
+    raise AssertionError
+
+
+def test_first_step_zero():
+    w = make_world()
+    comps = R.component_rewards(None, w, 0)
+    assert all(v == 0.0 for v in comps.values())
+
+
+def test_xp_and_lasthit_delta():
+    w0 = make_world()
+    w1 = clone(w0)
+    hero(w1).xp += 50
+    hero(w1).last_hits += 2
+    comps = R.component_rewards(w0, w1, 0)
+    assert comps["xp"] == 50
+    assert comps["last_hits"] == 2
+    expected = 50 * R.REWARD_WEIGHTS["xp"] + 2 * R.REWARD_WEIGHTS["last_hits"]
+    assert math.isclose(R.total_reward(comps), expected)
+
+
+def test_hp_delta_fraction():
+    w0 = make_world()
+    w1 = clone(w0)
+    hero(w1).health -= 60  # 600 max → -0.1 fraction
+    comps = R.component_rewards(w0, w1, 0)
+    assert math.isclose(comps["hp"], -0.1, abs_tol=1e-6)
+
+
+def test_death_counted_not_hp():
+    w0 = make_world()
+    w1 = clone(w0)
+    h = hero(w1)
+    h.health = 0
+    h.is_alive = False
+    h.deaths += 1
+    comps = R.component_rewards(w0, w1, 0)
+    assert comps["deaths"] == 1
+    assert comps["hp"] == 0.0  # dead hero must not double-count hp loss
+
+
+def test_tower_damage():
+    w0 = make_world()
+    w0.units.add(handle=50, unit_type=ws.Unit.TOWER, team_id=3, health=1000, health_max=2000, is_alive=True)
+    w1 = clone(w0)
+    w1.units[-1].health = 500  # enemy tower lost 0.25 of max
+    comps = R.component_rewards(w0, w1, 0)
+    assert math.isclose(comps["tower_hp"], 0.25)
+
+
+def test_win_loss():
+    w0 = make_world()
+    w1 = clone(w0)
+    w1.winning_team = 2
+    assert R.component_rewards(w0, w1, 0)["win"] == 1.0
+    w1.winning_team = 3
+    assert R.component_rewards(w0, w1, 0)["win"] == -1.0
+
+
+def test_despawn_gap_uses_last_hero():
+    # hero present -> despawned -> respawned with deaths+1; the death must
+    # still be penalized via the last-seen snapshot.
+    w0 = make_world()
+    snapshot = ws.Unit()
+    snapshot.CopyFrom(hero(w0))
+    w_gone = clone(w0)
+    del w_gone.units[0]
+    comps = R.component_rewards(w0, w_gone, 0)
+    assert all(v == 0.0 for v in comps.values())  # nothing computable yet
+    w2 = clone(w0)
+    h2 = hero(w2)
+    h2.deaths = snapshot.deaths + 1
+    h2.xp = snapshot.xp + 30
+    comps = R.component_rewards(w_gone, w2, 0, last_hero=snapshot)
+    assert comps["deaths"] == 1
+    assert comps["xp"] == 30
