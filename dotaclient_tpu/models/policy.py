@@ -1,0 +1,187 @@
+"""Flax LSTM actor-critic — the TPU-native re-design of the reference's
+policy.py (SURVEY.md §2 "Policy net", §3.3 call stack).
+
+Reference architecture (PyTorch): per-unit MLP embeddings pooled over
+nearby units + hero stats → LSTM(~128) → heads {action-enum, move-x,
+move-y (9-way grids), target-unit via dot-product attention over unit
+embeddings, value}, with invalid-action masking and a joint log-prob over
+selected sub-heads. TPU-first decisions here:
+
+- **One module, two modes.** The actor needs a stateful single step, the
+  learner a teacher-forced full unroll; both are the same `PolicyCore`
+  applied directly or through `nn.scan` over the time axis (params
+  broadcast), so step-vs-unroll equivalence is structural, not tested-in.
+- **`lax.scan` over time, batch over devices.** The time axis stays inside
+  one device (sequence parallelism is deliberately N/A at chunk length
+  ~16 — SURVEY.md §5); scaling is over the batch via the mesh.
+- **bfloat16 compute, float32 params and heads.** Matmuls hit the MXU in
+  bf16; logits/value are cast to f32 before masking/sampling/loss so the
+  distribution math is stable.
+- **Masks flow in as data** (from the featurizer) — no data-dependent
+  Python control flow under jit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dotaclient_tpu.config import PolicyConfig
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.ops.action_dist import BIG_NEG, Dist, masked_log_softmax
+
+LSTMState = Tuple[jnp.ndarray, jnp.ndarray]  # (c, h), each [B, H]
+
+
+class AuxOutputs(NamedTuple):
+    """Auxiliary value heads (benchmark config 5): win-prob logit,
+    predicted last-hit rate, predicted net-worth (both normalized)."""
+
+    win_logit: jnp.ndarray  # [...]
+    last_hit: jnp.ndarray  # [...]
+    net_worth: jnp.ndarray  # [...]
+
+
+class PolicyOutput(NamedTuple):
+    dist: Dist
+    value: jnp.ndarray  # [...] f32
+    aux: Optional[AuxOutputs]
+
+
+def _dtype(cfg: PolicyConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+class LSTMCell(nn.Module):
+    """Fused-gate LSTM cell: one [x;h] @ W matmul for all four gates.
+
+    Kept hand-rolled (rather than flax's OptimizedLSTMCell) so the gate
+    matmul + elementwise tail can be swapped for a Pallas kernel without
+    changing the parameter layout. Forget-gate bias +1.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, carry: LSTMState, x: jnp.ndarray) -> Tuple[LSTMState, jnp.ndarray]:
+        c, h = carry
+        z = nn.Dense(4 * self.features, dtype=self.dtype, name="gates")(
+            jnp.concatenate([x, h.astype(self.dtype)], axis=-1)
+        )
+        i, f, g, o = jnp.split(z.astype(jnp.float32), 4, axis=-1)
+        new_c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        return (new_c, new_h), new_h
+
+
+class PolicyCore(nn.Module):
+    """One policy step: featurized obs + LSTM state → action dist + value."""
+
+    cfg: PolicyConfig
+
+    @nn.compact
+    def __call__(self, carry: LSTMState, obs: F.Observation) -> Tuple[LSTMState, PolicyOutput]:
+        cfg = self.cfg
+        dt = _dtype(cfg)
+        D = cfg.unit_embed_dim
+
+        unit_mask = obs.unit_mask
+        units = obs.unit_feats.astype(dt)
+        x = nn.Dense(cfg.mlp_hidden, dtype=dt, name="unit_mlp1")(units)
+        x = nn.relu(x)
+        unit_emb = nn.Dense(D, dtype=dt, name="unit_mlp2")(x)  # [B, U, D]
+
+        # Masked max+mean pooling to a fixed-size neighbourhood context.
+        m = unit_mask[..., None]
+        neg = jnp.asarray(BIG_NEG, dt)
+        pool_max = jnp.max(jnp.where(m, unit_emb, neg), axis=-2)
+        any_unit = jnp.any(unit_mask, axis=-1, keepdims=True)
+        pool_max = jnp.where(any_unit, pool_max, 0.0)
+        denom = jnp.maximum(jnp.sum(m, axis=-2), 1).astype(dt)
+        pool_mean = jnp.sum(jnp.where(m, unit_emb, 0.0), axis=-2) / denom
+
+        hero = nn.Dense(cfg.mlp_hidden, dtype=dt, name="hero_mlp")(obs.hero_feats.astype(dt))
+        glob = nn.Dense(cfg.mlp_hidden // 4, dtype=dt, name="global_mlp")(obs.global_feats.astype(dt))
+        trunk = jnp.concatenate([nn.relu(hero), nn.relu(glob), pool_max, pool_mean], axis=-1)
+        trunk = nn.relu(nn.Dense(cfg.lstm_hidden, dtype=dt, name="trunk")(trunk))
+
+        carry, out = LSTMCell(cfg.lstm_hidden, dtype=dt, name="lstm")(carry, trunk)
+        out = out.astype(dt)
+
+        # Heads — logits in f32 for stable masking/softmax.
+        type_logits = nn.Dense(F.N_ACTION_TYPES, dtype=jnp.float32, name="type_head")(out)
+        move_x = nn.Dense(cfg.n_move_bins, dtype=jnp.float32, name="move_x_head")(out)
+        move_y = nn.Dense(cfg.n_move_bins, dtype=jnp.float32, name="move_y_head")(out)
+        # Target selection = dot-product attention of an lstm-out query
+        # against the unit embeddings (reference's target head).
+        query = nn.Dense(D, dtype=jnp.float32, name="target_query")(out)
+        target_logits = jnp.einsum("...d,...ud->...u", query, unit_emb.astype(jnp.float32))
+        target_logits = target_logits / jnp.sqrt(jnp.asarray(D, jnp.float32))
+
+        dist = Dist(
+            type_logp=masked_log_softmax(type_logits, obs.action_mask),
+            move_x_logp=jax.nn.log_softmax(move_x, axis=-1),
+            move_y_logp=jax.nn.log_softmax(move_y, axis=-1),
+            target_logp=masked_log_softmax(target_logits, obs.target_mask),
+        )
+        value = nn.Dense(1, dtype=jnp.float32, name="value_head")(out)[..., 0]
+
+        aux = None
+        if cfg.aux_heads:
+            aux = AuxOutputs(
+                win_logit=nn.Dense(1, dtype=jnp.float32, name="aux_win")(out)[..., 0],
+                last_hit=nn.Dense(1, dtype=jnp.float32, name="aux_lh")(out)[..., 0],
+                net_worth=nn.Dense(1, dtype=jnp.float32, name="aux_nw")(out)[..., 0],
+            )
+        return carry, PolicyOutput(dist=dist, value=value, aux=aux)
+
+
+class PolicyNet(nn.Module):
+    """Public policy module.
+
+    - `apply(params, state, obs)` — single step, obs leaves [B, ...].
+    - `apply(params, state, obs_seq, unroll=True)` — teacher-forced unroll,
+      obs leaves [B, T, ...]; returns outputs with a [B, T] time axis and
+      the final LSTM state.
+    Params are identical between the two modes (scan broadcasts them).
+    """
+
+    cfg: PolicyConfig
+
+    def _assert_shapes(self, obs: F.Observation) -> None:
+        assert self.cfg.max_units == F.MAX_UNITS, (
+            f"PolicyConfig.max_units={self.cfg.max_units} must equal "
+            f"featurizer.MAX_UNITS={F.MAX_UNITS}"
+        )
+        assert obs.unit_feats.shape[-2:] == (F.MAX_UNITS, F.UNIT_FEATURES)
+
+    @nn.compact
+    def __call__(self, state: LSTMState, obs: F.Observation, unroll: bool = False):
+        self._assert_shapes(obs)
+        if not unroll:
+            return PolicyCore(self.cfg, name="core")(state, obs)
+        scan = nn.scan(
+            PolicyCore,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1,
+            out_axes=1,
+        )
+        return scan(self.cfg, name="core")(state, obs)
+
+def initial_state(cfg: PolicyConfig, batch_shape) -> LSTMState:
+    """LSTM zero-state without needing a module instance (host-side use)."""
+    shape = tuple(batch_shape) + (cfg.lstm_hidden,)
+    return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+
+def init_params(cfg: PolicyConfig, rng: jax.Array):
+    """Initialize parameters with a dummy single-step batch of 1."""
+    net = PolicyNet(cfg)
+    obs = jax.tree.map(lambda x: jnp.asarray(x)[None], F.zeros_observation())
+    state = initial_state(cfg, (1,))
+    return net.init(rng, state, obs)

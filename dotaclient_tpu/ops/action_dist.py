@@ -1,0 +1,107 @@
+"""Masked factorized action distribution.
+
+The reference's policy.py samples a joint action from factorized heads —
+action-type enum, discretized move x/y grids, and an attention-scored
+target-unit head — with invalid sub-heads masked, and accumulates a joint
+log-prob over the selected sub-heads (SURVEY.md §3.3). This module is the
+jit-friendly re-design of that logic:
+
+- Pure functions over a `Dist` of *already masked* log-probs; every
+  function broadcasts over arbitrary leading axes ([B] actor step,
+  [B, T] learner unroll) so the same code runs in both modes.
+- Masking uses a large finite negative (not -inf) so that an all-masked
+  head yields a uniform distribution instead of NaNs; legality of the
+  head itself is enforced through the action-type mask, so the uniform
+  never gets sampled or contributes log-prob/entropy.
+- Joint entropy is exact for the factorized family:
+  H = H(type) + p(move)·(H(x)+H(y)) + p(attack)·H(target).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dotaclient_tpu.env.featurizer import ACT_ATTACK, ACT_MOVE
+
+BIG_NEG = -1e9
+
+
+class Dist(NamedTuple):
+    """Masked log-probabilities for each head; leading axes arbitrary."""
+
+    type_logp: jnp.ndarray  # [..., N_ACTION_TYPES]
+    move_x_logp: jnp.ndarray  # [..., n_move_bins]
+    move_y_logp: jnp.ndarray  # [..., n_move_bins]
+    target_logp: jnp.ndarray  # [..., MAX_UNITS]
+
+
+class Action(NamedTuple):
+    """One sampled (or stored) action; leading axes match the Dist."""
+
+    type: jnp.ndarray  # int32 [...]
+    move_x: jnp.ndarray  # int32 [...]
+    move_y: jnp.ndarray  # int32 [...]
+    target: jnp.ndarray  # int32 [...]
+
+
+def masked_log_softmax(logits: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """log-softmax with masked entries pinned to BIG_NEG.
+
+    All-masked rows degrade to a uniform distribution (finite), never NaN.
+    """
+    logits = jnp.where(mask, logits, BIG_NEG)
+    return jax.nn.log_softmax(logits, axis=-1)
+
+
+def _gather(logp: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    return jnp.take_along_axis(logp, idx[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def _entropy(logp: jnp.ndarray) -> jnp.ndarray:
+    # p·logp with p==0 and logp==BIG_NEG is 0·(-1e9) == -0.0 — finite.
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def sample(rng: jax.Array, dist: Dist) -> Action:
+    """Sample each head independently; unselected heads' samples are valid
+    indices but contribute nothing to log_prob (factorized semantics)."""
+    r_type, r_x, r_y, r_t = jax.random.split(rng, 4)
+    return Action(
+        type=jax.random.categorical(r_type, dist.type_logp),
+        move_x=jax.random.categorical(r_x, dist.move_x_logp),
+        move_y=jax.random.categorical(r_y, dist.move_y_logp),
+        target=jax.random.categorical(r_t, dist.target_logp),
+    )
+
+
+def mode(dist: Dist) -> Action:
+    """Greedy action (argmax per head) — used for evaluation."""
+    return Action(
+        type=jnp.argmax(dist.type_logp, axis=-1),
+        move_x=jnp.argmax(dist.move_x_logp, axis=-1),
+        move_y=jnp.argmax(dist.move_y_logp, axis=-1),
+        target=jnp.argmax(dist.target_logp, axis=-1),
+    )
+
+
+def log_prob(dist: Dist, action: Action) -> jnp.ndarray:
+    """Joint log-prob: type head always; move grids only under MOVE;
+    target head only under ATTACK."""
+    lp = _gather(dist.type_logp, action.type)
+    is_move = (action.type == ACT_MOVE).astype(lp.dtype)
+    is_attack = (action.type == ACT_ATTACK).astype(lp.dtype)
+    lp += is_move * (_gather(dist.move_x_logp, action.move_x) + _gather(dist.move_y_logp, action.move_y))
+    lp += is_attack * _gather(dist.target_logp, action.target)
+    return lp
+
+
+def entropy(dist: Dist) -> jnp.ndarray:
+    """Exact entropy of the factorized joint distribution."""
+    p = jnp.exp(dist.type_logp)
+    h = _entropy(dist.type_logp)
+    h += p[..., ACT_MOVE] * (_entropy(dist.move_x_logp) + _entropy(dist.move_y_logp))
+    h += p[..., ACT_ATTACK] * _entropy(dist.target_logp)
+    return h

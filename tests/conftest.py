@@ -1,12 +1,21 @@
-"""Test harness: force JAX onto 8 virtual CPU devices BEFORE jax imports.
+"""Test harness: force JAX onto 8 virtual CPU devices BEFORE any test runs.
 
 This proves every mesh/collective code path (dp/tp shardings, psum/pmean
 over the mesh) without TPU hardware, per SURVEY.md §4 item 4.
+
+Note: the image's sitecustomize registers an `axon` TPU backend and
+programmatically sets jax_platforms="axon,cpu", which overrides the
+JAX_PLATFORMS env var — so we must force cpu via jax.config *after*
+import (backend initialization is lazy, so this is still early enough).
+XLA_FLAGS, however, must be set before the first backend init.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,94 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dotaclient_tpu.env.featurizer import ACT_ATTACK, ACT_MOVE, ACT_NOOP
+from dotaclient_tpu.ops import action_dist as ad
+
+
+def make_dist(key=0, batch=(), n_units=6):
+    rngs = jax.random.split(jax.random.PRNGKey(key), 4)
+    shape = tuple(batch)
+    return ad.Dist(
+        type_logp=jax.nn.log_softmax(jax.random.normal(rngs[0], shape + (4,))),
+        move_x_logp=jax.nn.log_softmax(jax.random.normal(rngs[1], shape + (9,))),
+        move_y_logp=jax.nn.log_softmax(jax.random.normal(rngs[2], shape + (9,))),
+        target_logp=jax.nn.log_softmax(jax.random.normal(rngs[3], shape + (n_units,))),
+    )
+
+
+def test_masked_log_softmax_all_masked_is_finite_uniform():
+    logits = jnp.array([1.0, 2.0, 3.0])
+    mask = jnp.zeros(3, bool)
+    lp = ad.masked_log_softmax(logits, mask)
+    assert np.isfinite(np.asarray(lp)).all()
+    # BIG_NEG masking (finite, not -inf) costs ~1e-4 absolute precision at
+    # the 1e9 logit scale; that is by design.
+    np.testing.assert_allclose(np.asarray(lp), np.log(1 / 3) * np.ones(3), atol=1e-3)
+
+
+def test_masked_entries_never_sampled():
+    logits = jnp.array([0.0, 0.0, 0.0, 0.0])
+    mask = jnp.array([True, False, True, False])
+    lp = ad.masked_log_softmax(logits, mask)
+    samples = jax.vmap(lambda k: jax.random.categorical(k, lp))(
+        jax.random.split(jax.random.PRNGKey(0), 500)
+    )
+    assert set(np.unique(np.asarray(samples))) <= {0, 2}
+
+
+def test_log_prob_matches_numpy():
+    dist = make_dist(batch=(3,))
+    action = ad.Action(
+        type=jnp.array([ACT_NOOP, ACT_MOVE, ACT_ATTACK]),
+        move_x=jnp.array([0, 4, 1]),
+        move_y=jnp.array([0, 2, 1]),
+        target=jnp.array([0, 0, 5]),
+    )
+    lp = np.asarray(ad.log_prob(dist, action))
+    t = np.asarray(dist.type_logp)
+    x = np.asarray(dist.move_x_logp)
+    y = np.asarray(dist.move_y_logp)
+    u = np.asarray(dist.target_logp)
+    np.testing.assert_allclose(lp[0], t[0, ACT_NOOP], rtol=1e-6)
+    np.testing.assert_allclose(lp[1], t[1, ACT_MOVE] + x[1, 4] + y[1, 2], rtol=1e-6)
+    np.testing.assert_allclose(lp[2], t[2, ACT_ATTACK] + u[2, 5], rtol=1e-6)
+
+
+def test_entropy_matches_numpy_oracle():
+    dist = make_dist(batch=(2,))
+    h = np.asarray(ad.entropy(dist))
+
+    def H(lp):
+        p = np.exp(lp)
+        return -(p * lp).sum(-1)
+
+    t = np.asarray(dist.type_logp)
+    p = np.exp(t)
+    expected = (
+        H(t)
+        + p[:, ACT_MOVE] * (H(np.asarray(dist.move_x_logp)) + H(np.asarray(dist.move_y_logp)))
+        + p[:, ACT_ATTACK] * H(np.asarray(dist.target_logp))
+    )
+    np.testing.assert_allclose(h, expected, rtol=1e-5)
+    assert (h > 0).all()
+
+
+def test_entropy_finite_with_fully_masked_target_head():
+    dist = make_dist(batch=(2,))
+    masked_target = ad.masked_log_softmax(dist.target_logp, jnp.zeros_like(dist.target_logp, bool))
+    # attack itself masked out of the type head:
+    type_mask = jnp.array([True, True, False, False])
+    masked_type = ad.masked_log_softmax(dist.type_logp, type_mask)
+    d = dist._replace(type_logp=masked_type, target_logp=masked_target)
+    h = np.asarray(ad.entropy(d))
+    lp = np.asarray(ad.log_prob(d, ad.sample(jax.random.PRNGKey(0), d)))
+    assert np.isfinite(h).all() and np.isfinite(lp).all()
+
+
+def test_sample_batch_shapes_and_leading_axes():
+    dist = make_dist(batch=(4, 7))  # works for [B, T] too
+    a = ad.sample(jax.random.PRNGKey(0), dist)
+    assert a.type.shape == (4, 7)
+    assert np.asarray(ad.log_prob(dist, a)).shape == (4, 7)
+    assert np.asarray(ad.entropy(dist)).shape == (4, 7)

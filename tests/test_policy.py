@@ -1,0 +1,123 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import PolicyConfig
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.models import policy as P
+from dotaclient_tpu.ops import action_dist as ad
+
+from tests.test_featurizer import make_world
+
+CFG = PolicyConfig(unit_embed_dim=32, lstm_hidden=32, mlp_hidden=32)
+
+
+def batch_obs(B, key=0):
+    """Random-ish but valid featurized observations, stacked to [B]."""
+    obs = [F.featurize(make_world(n_creeps=1 + i % 3), 0) for i in range(B)]
+    return jax.tree.map(jnp.asarray, F.stack(obs))
+
+
+def seq_obs(B, T):
+    obs = [[F.featurize(make_world(n_creeps=1 + (i + t) % 3), 0) for t in range(T)] for i in range(B)]
+    stacked = [F.stack(row) for row in obs]
+    return jax.tree.map(jnp.asarray, F.stack(stacked))  # [B, T, ...]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return P.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_single_step_shapes(params):
+    net = P.PolicyNet(CFG)
+    obs = batch_obs(3)
+    state = P.initial_state(CFG, (3,))
+    (c, h), out = net.apply(params, state, obs)
+    assert c.shape == (3, CFG.lstm_hidden) and h.shape == (3, CFG.lstm_hidden)
+    assert out.dist.type_logp.shape == (3, F.N_ACTION_TYPES)
+    assert out.dist.target_logp.shape == (3, F.MAX_UNITS)
+    assert out.value.shape == (3,)
+    assert out.value.dtype == jnp.float32
+
+
+def test_unroll_equals_stepwise(params):
+    B, T = 2, 5
+    net = P.PolicyNet(CFG)
+    obs = seq_obs(B, T)
+    state = P.initial_state(CFG, (B,))
+    final_state, out = net.apply(params, state, obs, unroll=True)
+
+    s = P.initial_state(CFG, (B,))
+    step_values, step_type_logp = [], []
+    for t in range(T):
+        obs_t = jax.tree.map(lambda x: x[:, t], obs)
+        s, o = net.apply(params, s, obs_t)
+        step_values.append(o.value)
+        step_type_logp.append(o.dist.type_logp)
+    np.testing.assert_allclose(np.asarray(out.value), np.stack([np.asarray(v) for v in step_values], 1), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out.dist.type_logp), np.stack([np.asarray(v) for v in step_type_logp], 1), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(final_state[0]), np.asarray(s[0]), rtol=2e-3, atol=2e-3)
+
+
+def test_jit_matches_eager(params):
+    net = P.PolicyNet(CFG)
+    obs = batch_obs(2)
+    state = P.initial_state(CFG, (2,))
+    eager = net.apply(params, state, obs)
+    jitted = jax.jit(net.apply)(params, state, obs)
+    for a, b in zip(jax.tree.leaves(eager), jax.tree.leaves(jitted)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_masked_attack_never_sampled(params):
+    net = P.PolicyNet(CFG)
+    w = make_world(n_creeps=0, with_enemy_hero=False)  # no targets at all
+    obs = jax.tree.map(lambda x: jnp.asarray(x)[None], F.featurize(w, 0))
+    state = P.initial_state(CFG, (1,))
+    _, out = net.apply(params, state, obs)
+    samples = jax.vmap(lambda k: ad.sample(k, out.dist).type[0])(
+        jax.random.split(jax.random.PRNGKey(1), 300)
+    )
+    assert F.ACT_ATTACK not in np.unique(np.asarray(samples))
+    assert F.ACT_CAST not in np.unique(np.asarray(samples))
+    lp = ad.log_prob(out.dist, ad.sample(jax.random.PRNGKey(2), out.dist))
+    assert np.isfinite(np.asarray(lp)).all()
+    assert np.isfinite(np.asarray(ad.entropy(out.dist))).all()
+
+
+def test_dead_hero_all_noop_finite(params):
+    net = P.PolicyNet(CFG)
+    obs = jax.tree.map(lambda x: jnp.asarray(x)[None], F.featurize(make_world(hero_alive=False), 0))
+    state = P.initial_state(CFG, (1,))
+    _, out = net.apply(params, state, obs)
+    assert np.isfinite(np.asarray(out.dist.type_logp)).all()
+    a = ad.sample(jax.random.PRNGKey(0), out.dist)
+    assert int(a.type[0]) == F.ACT_NOOP
+
+
+def test_aux_heads_present_when_enabled():
+    cfg = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, aux_heads=True)
+    params = P.init_params(cfg, jax.random.PRNGKey(0))
+    net = P.PolicyNet(cfg)
+    obs = batch_obs(2)
+    _, out = net.apply(params, P.initial_state(cfg, (2,)), obs)
+    assert out.aux is not None
+    assert out.aux.win_logit.shape == (2,)
+
+
+def test_param_count_golden():
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(P.init_params(CFG, jax.random.PRNGKey(0))))
+    # Catches silent architecture drift; update intentionally when the
+    # architecture changes.
+    assert n == 15711, n
+
+
+def test_unroll_is_jittable_with_scan(params):
+    net = P.PolicyNet(CFG)
+    obs = seq_obs(2, 4)
+    state = P.initial_state(CFG, (2,))
+    fn = jax.jit(lambda p, s, o: net.apply(p, s, o, unroll=True))
+    final_state, out = fn(params, state, obs)
+    assert out.value.shape == (2, 4)
