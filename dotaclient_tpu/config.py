@@ -22,9 +22,6 @@ class PolicyConfig:
     mlp_hidden: int = 128
     n_move_bins: int = 9  # 9-way discretized move offsets per axis
     move_step: float = 350.0  # map units per outermost move-grid cell
-    # Must equal featurizer.MAX_UNITS — the featurizer emits fixed
-    # [MAX_UNITS, UNIT_FEATURES] arrays; the policy asserts this at init.
-    max_units: int = 16
     # Auxiliary value heads (benchmark config 5: win-prob, last-hit, net-worth).
     aux_heads: bool = False
     dtype: str = "bfloat16"  # compute dtype on TPU; params stay f32
@@ -81,6 +78,15 @@ class ActorConfig:
     seed: int = 0
 
 
+def _parse_bool(s: str) -> bool:
+    low = s.lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {s!r}")
+
+
 def add_flags(parser: argparse.ArgumentParser, cfg, prefix: str = "") -> None:
     """Register one --flag per (possibly nested) dataclass field."""
     for f in dataclasses.fields(cfg):
@@ -89,7 +95,7 @@ def add_flags(parser: argparse.ArgumentParser, cfg, prefix: str = "") -> None:
         if dataclasses.is_dataclass(val):
             add_flags(parser, val, prefix=f"{name}.")
         elif isinstance(val, bool):
-            parser.add_argument(f"--{name}", type=lambda s: s.lower() in ("1", "true", "yes"), default=val)
+            parser.add_argument(f"--{name}", type=_parse_bool, default=val)
         else:
             parser.add_argument(f"--{name}", type=type(val), default=val)
 

@@ -131,12 +131,14 @@ def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     out[15] = 1.0 if h.is_alive else 0.0
 
 
-def featurize(world: ws.World, player_id: int) -> Observation:
-    """Featurize one worldstate for the hero controlled by `player_id`.
+def featurize_with_handles(world: ws.World, player_id: int):
+    """Featurize one worldstate and return (Observation, handles) where
+    `handles[i]` is the unit handle behind feature slot i (0 = empty).
 
-    Nearest-`MAX_UNITS` units (excluding the controlled hero) sorted by
-    distance; masks computed host-side. If the hero is absent (dead and
-    despawned), returns a zero observation with only NOOP legal.
+    One shared nearest-`MAX_UNITS` sort produces both, so the policy's
+    target-head index → unit-handle mapping cannot drift from the
+    features. If the hero is absent or dead, returns a zero observation
+    (only NOOP legal) and all-zero handles.
     """
     # All stat-derived features are defensively clamped to this range so a
     # corrupt/adversarial worldstate cannot inject huge activations.
@@ -151,14 +153,16 @@ def featurize(world: ws.World, player_id: int) -> Observation:
     gf[4] = 1.0 if world.team_id == 2 else -1.0  # radiant/dire indicator
     gf[5] = world.tick / 1e5
     np.clip(gf, -_CLAMP, _CLAMP, out=gf)
+    handles = np.zeros(MAX_UNITS, np.uint32)
     if hero is None or not hero.is_alive:
-        return obs
+        return obs, handles
 
     _hero_row(hero, obs.hero_feats)
 
     for i, u in enumerate(_sorted_others(world, hero)):
         _unit_row(u, hero, obs.unit_feats[i])
         obs.unit_mask[i] = True
+        handles[i] = u.handle
         obs.target_mask[i] = (
             u.team_id != hero.team_id
             and u.is_alive
@@ -173,19 +177,17 @@ def featurize(world: ws.World, player_id: int) -> Observation:
     obs.action_mask[ACT_MOVE] = True
     obs.action_mask[ACT_ATTACK] = bool(obs.target_mask.any())
     obs.action_mask[ACT_CAST] = castable
-    return obs
+    return obs, handles
+
+
+def featurize(world: ws.World, player_id: int) -> Observation:
+    """Observation only (see featurize_with_handles)."""
+    return featurize_with_handles(world, player_id)[0]
 
 
 def handles_for_slots(world: ws.World, player_id: int) -> np.ndarray:
-    """Unit handle per feature slot (0 = empty) — maps the policy's target
-    head index back to a concrete unit handle for the Actions proto."""
-    hero = find_hero(world, player_id)
-    out = np.zeros(MAX_UNITS, np.uint32)
-    if hero is None or not hero.is_alive:
-        return out
-    for i, u in enumerate(_sorted_others(world, hero)):
-        out[i] = u.handle
-    return out
+    """Unit handle per feature slot only (see featurize_with_handles)."""
+    return featurize_with_handles(world, player_id)[1]
 
 
 def stack(observations) -> Observation:

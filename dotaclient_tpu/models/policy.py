@@ -109,8 +109,9 @@ class PolicyCore(nn.Module):
         trunk = jnp.concatenate([nn.relu(hero), nn.relu(glob), pool_max, pool_mean], axis=-1)
         trunk = nn.relu(nn.Dense(cfg.lstm_hidden, dtype=dt, name="trunk")(trunk))
 
+        # LSTM output stays f32: every head below computes in f32, so a
+        # bf16 round-trip here would be pure precision loss.
         carry, out = LSTMCell(cfg.lstm_hidden, dtype=dt, name="lstm")(carry, trunk)
-        out = out.astype(dt)
 
         # Heads — logits in f32 for stable masking/softmax.
         type_logits = nn.Dense(F.N_ACTION_TYPES, dtype=jnp.float32, name="type_head")(out)
@@ -153,10 +154,6 @@ class PolicyNet(nn.Module):
     cfg: PolicyConfig
 
     def _assert_shapes(self, obs: F.Observation) -> None:
-        assert self.cfg.max_units == F.MAX_UNITS, (
-            f"PolicyConfig.max_units={self.cfg.max_units} must equal "
-            f"featurizer.MAX_UNITS={F.MAX_UNITS}"
-        )
         assert obs.unit_feats.shape[-2:] == (F.MAX_UNITS, F.UNIT_FEATURES)
 
     @nn.compact

@@ -1,0 +1,45 @@
+"""Fixed-shape training batch — the device-side contract.
+
+The reference learner pads variable-length pickled rollouts into [B, T]
+tensors plus a mask before the PPO step (SURVEY.md §3.2). This is the
+jit-facing equivalent: every leaf has a static shape so one compiled
+train step serves every batch.
+
+Shape conventions (B sequences, T action steps):
+- `obs` leaves are [B, T+1, ...]: slot T.. holds the *bootstrap*
+  observation (the one after the last action), so the learner's
+  teacher-forced unroll produces V(s_{t}) for t in [0, T] in one scan and
+  GAE needs no second forward pass.
+- everything else is [B, T]; `mask[b, t]` marks real (non-padding) steps.
+- `initial_state` is the actor-side LSTM state at the chunk start,
+  shipped with the rollout (SURVEY.md §7 "LSTM state handoff").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from dotaclient_tpu.env.featurizer import Observation
+from dotaclient_tpu.ops.action_dist import Action
+
+
+class AuxTargets(NamedTuple):
+    """Targets for the auxiliary value heads (benchmark config 5)."""
+
+    win: jnp.ndarray  # [B, T] — ±1 final result (0 while unknown)
+    last_hit: jnp.ndarray  # [B, T] — normalized last-hit count
+    net_worth: jnp.ndarray  # [B, T] — normalized net worth
+
+
+class TrainBatch(NamedTuple):
+    obs: Observation  # leaves [B, T+1, ...]
+    actions: Action  # leaves [B, T]
+    behavior_logp: jnp.ndarray  # [B, T] f32 — actor-side joint log-prob
+    behavior_value: jnp.ndarray  # [B, T] f32 — actor-side value estimate
+    rewards: jnp.ndarray  # [B, T] f32
+    dones: jnp.ndarray  # [B, T] f32 — 1.0 where the episode terminated
+    mask: jnp.ndarray  # [B, T] f32 — 1.0 on real steps
+    initial_state: tuple  # (c, h) each [B, H] f32
+    aux: Optional[AuxTargets] = None  # present iff cfg.policy.aux_heads

@@ -1,0 +1,57 @@
+"""Masked Generalized Advantage Estimation as a reverse `lax.scan`.
+
+The reference computes GAE(γ, λ) advantages and discounted returns per
+padded sequence inside optimizer.py's train step (SURVEY.md §3.2). TPU
+re-design: a single reverse-time `lax.scan` over the batch — no Python
+loop, static shapes, masked so padding contributes exactly nothing
+(masked-mean, not mean-of-padded — SURVEY.md §7 "#1 correctness trap").
+
+Inputs follow the TrainBatch convention: `values` has T+1 entries per row
+(the last being the bootstrap value of the observation after the final
+action), so variable-length chunks need no per-row dynamic gather: for a
+row of true length L < T, `mask[t] = 0` for t >= L zeroes both the
+advantage at padded steps and the carry flowing from them, making the
+effective bootstrap V(s_L) — exactly the value at obs slot L.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gae(
+    rewards: jnp.ndarray,  # [B, T]
+    values: jnp.ndarray,  # [B, T+1] — includes bootstrap value
+    dones: jnp.ndarray,  # [B, T] — 1.0 where episode terminated at t
+    mask: jnp.ndarray,  # [B, T] — 1.0 on real steps
+    gamma: float,
+    lam: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (advantages [B, T], returns [B, T]); padded steps are 0."""
+    nonterminal = 1.0 - dones
+    delta = (rewards + gamma * nonterminal * values[:, 1:] - values[:, :-1]) * mask
+
+    def step(carry, xs):
+        d_t, nt_t, m_t = xs
+        a_t = (d_t + gamma * lam * nt_t * carry) * m_t
+        return a_t, a_t
+
+    # scan over time, reversed; leaves are [T, B].
+    xs = (delta.T, nonterminal.T, mask.T)
+    _, adv_rev = jax.lax.scan(step, jnp.zeros(rewards.shape[0], rewards.dtype), xs, reverse=True)
+    advantages = adv_rev.T
+    returns = advantages + values[:, :-1] * mask
+    return advantages, returns
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def masked_std(x: jnp.ndarray, mask: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray:
+    mean = masked_mean(x, mask)
+    var = masked_mean((x - mean) ** 2, mask)
+    return jnp.sqrt(var + eps)

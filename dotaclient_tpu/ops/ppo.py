@@ -1,0 +1,102 @@
+"""PPO clipped-surrogate loss over teacher-forced LSTM re-evaluation.
+
+Mirrors the reference learner's loss (SURVEY.md §3.2): re-run the policy
+over the shipped sequences with the shipped initial hidden state, form
+ratio = exp(logp_new − logp_old) against the actor-side log-probs, and
+combine clipped surrogate + value loss + entropy bonus — all masked means
+over real steps. Value loss is clipped against the actor-side value
+(PPO2-style) to bound value-function drift under stale experience.
+
+Everything here is a pure function of (params, batch) — the train step
+wrapper in parallel/train_step.py owns optax and the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from dotaclient_tpu.config import PPOConfig
+from dotaclient_tpu.ops import action_dist as ad
+from dotaclient_tpu.ops.batch import TrainBatch
+from dotaclient_tpu.ops.gae import gae, masked_mean, masked_std
+
+import jax
+
+
+def ppo_loss(
+    params,
+    apply_fn,
+    batch: TrainBatch,
+    cfg: PPOConfig,
+    aux_coef: float = 0.25,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Returns (scalar loss, metrics dict). `apply_fn(params, state, obs,
+    unroll=True)` is PolicyNet.apply."""
+    mask = batch.mask
+    T = batch.rewards.shape[1]
+
+    _, out = apply_fn(params, batch.initial_state, batch.obs, unroll=True)
+    values = out.value  # [B, T+1]
+    dist_t = jax.tree.map(lambda x: x[:, :T], out.dist)
+
+    new_logp = ad.log_prob(dist_t, batch.actions)
+    ratio = jnp.exp(new_logp - batch.behavior_logp)
+
+    advantages, returns = gae(
+        batch.rewards, jax.lax.stop_gradient(values), batch.dones, mask, cfg.gamma, cfg.gae_lambda
+    )
+    norm_adv = (advantages - masked_mean(advantages, mask)) / masked_std(advantages, mask)
+    norm_adv = jax.lax.stop_gradient(norm_adv * mask)
+
+    unclipped = ratio * norm_adv
+    clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps) * norm_adv
+    policy_loss = -masked_mean(jnp.minimum(unclipped, clipped), mask)
+
+    v_pred = values[:, :T]
+    v_clipped = batch.behavior_value + jnp.clip(
+        v_pred - batch.behavior_value, -cfg.value_clip, cfg.value_clip
+    )
+    v_err = jnp.maximum((v_pred - returns) ** 2, (v_clipped - returns) ** 2)
+    value_loss = 0.5 * masked_mean(v_err, mask)
+
+    entropy = masked_mean(ad.entropy(dist_t), mask)
+
+    loss = policy_loss + cfg.value_coef * value_loss - cfg.entropy_coef * entropy
+
+    metrics = {
+        "loss": loss,
+        "policy_loss": policy_loss,
+        "value_loss": value_loss,
+        "entropy": entropy,
+        "ratio_mean": masked_mean(ratio, mask),
+        "ratio_clip_frac": masked_mean(
+            (jnp.abs(ratio - 1.0) > cfg.clip_eps).astype(jnp.float32), mask
+        ),
+        "approx_kl": masked_mean(batch.behavior_logp - new_logp, mask),
+        "advantage_mean": masked_mean(advantages, mask),
+        "return_mean": masked_mean(returns, mask),
+        "value_mean": masked_mean(v_pred, mask),
+    }
+
+    if batch.aux is not None and out.aux is not None:
+        aux_t = jax.tree.map(lambda x: x[:, :T], out.aux)
+        win_prob_loss = masked_mean(
+            # ±1 labels → BCE on the win logit; 0 labels mean "unknown yet"
+            # and are masked out.
+            jnp.where(
+                batch.aux.win != 0.0,
+                jnp.logaddexp(0.0, -batch.aux.win * aux_t.win_logit),
+                0.0,
+            ),
+            mask,
+        )
+        lh_loss = masked_mean((aux_t.last_hit - batch.aux.last_hit) ** 2, mask)
+        nw_loss = masked_mean((aux_t.net_worth - batch.aux.net_worth) ** 2, mask)
+        aux_loss = win_prob_loss + lh_loss + nw_loss
+        loss = loss + aux_coef * aux_loss
+        metrics["loss"] = loss
+        metrics["aux_loss"] = aux_loss
+
+    return loss, metrics

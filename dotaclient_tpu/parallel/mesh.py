@@ -1,0 +1,86 @@
+"""Device mesh + sharding layout.
+
+The reference's only device-level parallelism is a single-GPU learner;
+scale came from actor data-parallelism (SURVEY.md §2 "Parallelism
+strategies"). The TPU-native learner instead compiles ONE train step over
+a `jax.sharding.Mesh` and lets XLA insert the collectives:
+
+- `dp` axis: batch data-parallelism — gradients are reduced over ICI by
+  the compiler (the pmean the reference never needed because it had one
+  device).
+- `tp` axis: Megatron-style tensor parallelism over the feature dims of
+  the Dense/LSTM kernels. At the reference's ~128-hidden LSTM scale tp=1
+  is the right setting, but the layout falls out of sharding annotations
+  so the same code serves a grown model (SURVEY.md §2 rebuild
+  disposition for TP).
+
+PP/SP/EP are deliberately absent: the time axis stays inside one device
+(`lax.scan`), chunk length ~16 makes sequence parallelism N/A, and the
+model has no experts (SURVEY.md §5 "Long-context / sequence parallelism").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def parse_mesh_spec(spec: str, n_devices: int) -> Dict[str, int]:
+    """Parse "dp=4,tp=2" (value -1 = all remaining devices) into axis sizes."""
+    axes: Dict[str, int] = {}
+    wild = None
+    for part in spec.replace(" ", "").split(","):
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        size = int(val)
+        if size == -1:
+            if wild is not None:
+                raise ValueError(f"multiple -1 axes in mesh spec {spec!r}")
+            wild = name
+            axes[name] = -1
+        else:
+            axes[name] = size
+    fixed = int(np.prod([s for s in axes.values() if s != -1])) if axes else 1
+    if wild is not None:
+        if n_devices % fixed:
+            raise ValueError(f"{n_devices} devices not divisible by {fixed} ({spec!r})")
+        axes[wild] = n_devices // fixed
+    if int(np.prod(list(axes.values()))) != n_devices:
+        raise ValueError(f"mesh spec {spec!r} does not cover {n_devices} devices")
+    return axes
+
+
+def make_mesh(spec: str = "dp=-1", devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    axes = parse_mesh_spec(spec, len(devices))
+    names = tuple(axes)
+    shape = tuple(axes[n] for n in names)
+    return Mesh(np.asarray(devices).reshape(shape), names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over dp; replicate everything else."""
+    return NamedSharding(mesh, P("dp" if "dp" in mesh.axis_names else None))
+
+
+def _leaf_spec(leaf, tp: int) -> P:
+    shape = getattr(leaf, "shape", ())
+    if tp > 1 and len(shape) >= 1 and shape[-1] % tp == 0 and int(np.prod(shape)) >= tp * 128:
+        # Shard the output-feature dim of kernels/biases over tp; XLA
+        # inserts the matching all-gathers/reduce-scatters around matmuls.
+        return P(*([None] * (len(shape) - 1) + ["tp"]))
+    return P()
+
+
+def param_shardings(mesh: Mesh, tree):
+    """Per-leaf NamedShardings for a params/opt-state pytree (tp-aware)."""
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+    return jax.tree.map(lambda leaf: NamedSharding(mesh, _leaf_spec(leaf, tp)), tree)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

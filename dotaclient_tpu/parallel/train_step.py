@@ -1,0 +1,183 @@
+"""The compiled PPO train step over a device mesh.
+
+Reference flow (SURVEY.md §3.2): consume → pad/stack → teacher-forced
+re-eval → GAE → PPO backward → Adam → grad clip → publish. Here the whole
+device-side portion is ONE `jax.jit`-compiled SPMD program over the mesh:
+
+- batch enters sharded over `dp` (leading axis), params/opt-state enter
+  in their (possibly tp-sharded) layout;
+- XLA inserts the gradient all-reduce over ICI — the explicit
+  pmean/NCCL-allreduce of hand-written data-parallel learners is implicit
+  in the sharding propagation;
+- the optimizer update runs sharded in the same program (no separate
+  host round-trip), and metrics come back as replicated scalars.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import optax
+
+from dotaclient_tpu.config import LearnerConfig
+from dotaclient_tpu.models.policy import PolicyNet, init_params
+from dotaclient_tpu.ops.batch import TrainBatch
+from dotaclient_tpu.ops.ppo import ppo_loss
+from dotaclient_tpu.parallel import mesh as mesh_lib
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array  # int32 scalar — doubles as the published model version
+
+
+def make_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.ppo.max_grad_norm),
+        optax.adam(cfg.ppo.lr, eps=cfg.ppo.adam_eps),
+    )
+
+
+def init_train_state(cfg: LearnerConfig, rng: jax.Array) -> TrainState:
+    params = init_params(cfg.policy, rng)
+    opt_state = make_optimizer(cfg).init(params)
+    import jax.numpy as jnp
+
+    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+
+def build_train_step(cfg: LearnerConfig, mesh):
+    """Returns (train_step, state_shardings, batch_sharding).
+
+    `train_step(state, batch) -> (state', metrics)` is jit-compiled with
+    explicit in/out shardings over `mesh`.
+    """
+    net = PolicyNet(cfg.policy)
+    opt = make_optimizer(cfg)
+
+    def step_fn(state: TrainState, batch: TrainBatch) -> Tuple[TrainState, Dict]:
+        (loss, metrics), grads = jax.value_and_grad(ppo_loss, has_aux=True)(
+            state.params, net.apply, batch, cfg.ppo
+        )
+        updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    # Shardings: derive from a concrete-shape template without materializing.
+    state_template = jax.eval_shape(lambda: init_train_state(cfg, jax.random.PRNGKey(0)))
+    state_shardings = TrainState(
+        params=mesh_lib.param_shardings(mesh, state_template.params),
+        opt_state=mesh_lib.param_shardings(mesh, state_template.opt_state),
+        step=mesh_lib.replicated(mesh),
+    )
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    batch_shardings = jax.tree.map(lambda _: batch_sh, _batch_template(cfg))
+    metrics_sharding = mesh_lib.replicated(mesh)
+
+    train_step = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=(state_shardings, metrics_sharding),
+        donate_argnums=(0,),
+    )
+    return train_step, state_shardings, batch_sh
+
+
+def _batch_template(cfg: LearnerConfig):
+    """A TrainBatch-shaped pytree of placeholders (None leaves dropped)."""
+    from dotaclient_tpu.env import featurizer as F
+    from dotaclient_tpu.ops.action_dist import Action
+    from dotaclient_tpu.ops.batch import AuxTargets
+    import numpy as np
+
+    B, T = cfg.batch_size, cfg.seq_len
+    obs = F.Observation(
+        global_feats=np.zeros((B, T + 1, F.GLOBAL_FEATURES), np.float32),
+        hero_feats=np.zeros((B, T + 1, F.HERO_FEATURES), np.float32),
+        unit_feats=np.zeros((B, T + 1, F.MAX_UNITS, F.UNIT_FEATURES), np.float32),
+        unit_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
+        target_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
+        action_mask=np.zeros((B, T + 1, F.N_ACTION_TYPES), bool),
+    )
+    z = np.zeros((B, T), np.float32)
+    zi = np.zeros((B, T), np.int32)
+    aux = AuxTargets(win=z, last_hit=z, net_worth=z) if cfg.policy.aux_heads else None
+    H = cfg.policy.lstm_hidden
+    return TrainBatch(
+        obs=obs,
+        actions=Action(type=zi, move_x=zi, move_y=zi, target=zi),
+        behavior_logp=z,
+        behavior_value=z,
+        rewards=z,
+        dones=z,
+        mask=z,
+        initial_state=(np.zeros((B, H), np.float32), np.zeros((B, H), np.float32)),
+        aux=aux,
+    )
+
+
+def make_train_batch(cfg: LearnerConfig, rng_seed: int = 0) -> TrainBatch:
+    """Random but self-consistent batch (tests / benchmarks / dry runs)."""
+    import numpy as np
+
+    from dotaclient_tpu.env import featurizer as F
+    from dotaclient_tpu.ops.action_dist import Action
+    from dotaclient_tpu.ops.batch import AuxTargets
+
+    r = np.random.RandomState(rng_seed)
+    B, T = cfg.batch_size, cfg.seq_len
+    U = F.MAX_UNITS
+    unit_mask = r.rand(B, T + 1, U) < 0.6
+    target_mask = unit_mask & (r.rand(B, T + 1, U) < 0.5)
+    action_mask = np.ones((B, T + 1, F.N_ACTION_TYPES), bool)
+    action_mask[..., F.ACT_ATTACK] = target_mask.any(-1)
+    action_mask[..., F.ACT_CAST] = False
+    obs = F.Observation(
+        global_feats=r.randn(B, T + 1, F.GLOBAL_FEATURES).astype(np.float32),
+        hero_feats=r.randn(B, T + 1, F.HERO_FEATURES).astype(np.float32),
+        unit_feats=r.randn(B, T + 1, U, F.UNIT_FEATURES).astype(np.float32),
+        unit_mask=unit_mask,
+        target_mask=target_mask,
+        action_mask=action_mask,
+    )
+    lengths = r.randint(max(1, T // 2), T + 1, size=B)
+    mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    dones = np.zeros((B, T), np.float32)
+    dones[r.rand(B) < 0.3, -1] = 1.0
+    dones *= mask
+    # Only legal actions, like a real actor: ATTACK only where a target
+    # exists, and targets drawn from the valid slots.
+    can_attack = target_mask[:, :T].any(-1)
+    atype = r.randint(0, 2, size=(B, T)).astype(np.int32)
+    atype = np.where(can_attack & (r.rand(B, T) < 0.33), F.ACT_ATTACK, atype).astype(np.int32)
+    first_valid = np.argmax(target_mask[:, :T], axis=-1).astype(np.int32)
+    target = np.where(can_attack, first_valid, 0).astype(np.int32)
+    H = cfg.policy.lstm_hidden
+    aux = (
+        AuxTargets(
+            win=np.sign(r.randn(B, T)).astype(np.float32),
+            last_hit=r.rand(B, T).astype(np.float32),
+            net_worth=r.rand(B, T).astype(np.float32),
+        )
+        if cfg.policy.aux_heads
+        else None
+    )
+    return TrainBatch(
+        obs=obs,
+        actions=Action(
+            type=atype,
+            move_x=r.randint(0, cfg.policy.n_move_bins, (B, T)).astype(np.int32),
+            move_y=r.randint(0, cfg.policy.n_move_bins, (B, T)).astype(np.int32),
+            target=target,
+        ),
+        behavior_logp=(-1.5 + 0.1 * r.randn(B, T)).astype(np.float32),
+        behavior_value=r.randn(B, T).astype(np.float32) * 0.1,
+        rewards=r.randn(B, T).astype(np.float32) * 0.1 * mask,
+        dones=dones,
+        mask=mask,
+        initial_state=(np.zeros((B, H), np.float32), np.zeros((B, H), np.float32)),
+        aux=aux,
+    )

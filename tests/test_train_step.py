@@ -1,0 +1,83 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.parallel import mesh as mesh_lib
+from dotaclient_tpu.parallel.train_step import (
+    build_train_step,
+    init_train_state,
+    make_train_batch,
+)
+
+SMALL = PolicyConfig(unit_embed_dim=32, lstm_hidden=32, mlp_hidden=32, dtype="float32")
+
+
+def make_cfg(**kw):
+    return LearnerConfig(batch_size=8, seq_len=5, policy=SMALL, **kw)
+
+
+def test_parse_mesh_spec():
+    assert mesh_lib.parse_mesh_spec("dp=-1", 8) == {"dp": 8}
+    assert mesh_lib.parse_mesh_spec("dp=4,tp=2", 8) == {"dp": 4, "tp": 2}
+    assert mesh_lib.parse_mesh_spec("dp=-1,tp=2", 8) == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        mesh_lib.parse_mesh_spec("dp=3", 8)
+    with pytest.raises(ValueError):
+        mesh_lib.parse_mesh_spec("dp=-1,tp=-1", 8)
+
+
+def run_steps(mesh_spec, n_steps=3, seed=7):
+    cfg = make_cfg()
+    mesh = mesh_lib.make_mesh(mesh_spec)
+    train_step, state_sh, _ = build_train_step(cfg, mesh)
+    state = init_train_state(cfg, jax.random.PRNGKey(0))
+    state = jax.device_put(state, state_sh)
+    batch = jax.tree.map(jnp.asarray, make_train_batch(cfg, rng_seed=seed))
+    ms = []
+    for _ in range(n_steps):
+        state, metrics = train_step(state, batch)
+        ms.append(metrics)
+    return state, ms
+
+
+def test_dp_mesh_runs_and_updates():
+    state, ms = run_steps("dp=-1")
+    assert int(state.step) == 3
+    assert all(np.isfinite(float(m["loss"])) for m in ms)
+    assert float(ms[0]["grad_norm"]) > 0
+
+
+def test_dp_tp_mesh_matches_single_device():
+    """The sharded result must equal the same program on one device —
+    proves the compiler-inserted collectives compute the right thing."""
+    cfg = make_cfg()
+    batch = jax.tree.map(jnp.asarray, make_train_batch(cfg, rng_seed=7))
+
+    results = {}
+    for spec, devices in [("dp=1", jax.devices()[:1]), ("dp=4,tp=2", None)]:
+        mesh = mesh_lib.make_mesh(spec, devices=devices)
+        train_step, state_sh, _ = build_train_step(cfg, mesh)
+        state = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh)
+        state, metrics = train_step(state, batch)
+        results[spec] = (jax.device_get(state.params), float(metrics["loss"]))
+
+    p1, l1 = results["dp=1"]
+    p8, l8 = results["dp=4,tp=2"]
+    np.testing.assert_allclose(l1, l8, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p8)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
+
+
+def test_loss_decreases_on_fixed_batch():
+    _, ms = run_steps("dp=-1", n_steps=12)
+    assert float(ms[-1]["loss"]) < float(ms[0]["loss"])
+
+
+def test_tp_params_actually_sharded():
+    cfg = make_cfg()
+    mesh = mesh_lib.make_mesh("dp=4,tp=2")
+    _, state_sh, _ = build_train_step(cfg, mesh)
+    specs = [s.spec for s in jax.tree.leaves(state_sh.params)]
+    assert any("tp" in str(s) for s in specs), "no parameter got tp-sharded"
