@@ -43,3 +43,42 @@ class TrainBatch(NamedTuple):
     mask: jnp.ndarray  # [B, T] f32 — 1.0 on real steps
     initial_state: tuple  # (c, h) each [B, H] f32
     aux: Optional[AuxTargets] = None  # present iff cfg.policy.aux_heads
+
+
+def zeros_train_batch(B: int, T: int, lstm_hidden: int, with_aux: bool) -> TrainBatch:
+    """The one canonical all-zeros numpy TrainBatch skeleton.
+
+    Single source of truth for the batch layout: the staging packer fills
+    it in, the train step derives its sharding template from it, and the
+    random-batch generator starts from it — so a field change cannot
+    silently diverge between them. Padded rows keep NOOP legal in the
+    action mask so masked log-softmax stays uniform-safe.
+    """
+    import numpy as np
+
+    from dotaclient_tpu.env import featurizer as F
+
+    obs = Observation(
+        global_feats=np.zeros((B, T + 1, F.GLOBAL_FEATURES), np.float32),
+        hero_feats=np.zeros((B, T + 1, F.HERO_FEATURES), np.float32),
+        unit_feats=np.zeros((B, T + 1, F.MAX_UNITS, F.UNIT_FEATURES), np.float32),
+        unit_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
+        target_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
+        action_mask=np.tile(F.zeros_observation().action_mask, (B, T + 1, 1)),
+    )
+    z = np.zeros((B, T), np.float32)
+    zi = np.zeros((B, T), np.int32)
+    return TrainBatch(
+        obs=obs,
+        actions=Action(type=zi.copy(), move_x=zi.copy(), move_y=zi.copy(), target=zi.copy()),
+        behavior_logp=z.copy(),
+        behavior_value=z.copy(),
+        rewards=z.copy(),
+        dones=z.copy(),
+        mask=z.copy(),
+        initial_state=(
+            np.zeros((B, lstm_hidden), np.float32),
+            np.zeros((B, lstm_hidden), np.float32),
+        ),
+        aux=AuxTargets(win=z.copy(), last_hit=z.copy(), net_worth=z.copy()) if with_aux else None,
+    )

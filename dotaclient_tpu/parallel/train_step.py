@@ -87,36 +87,10 @@ def build_train_step(cfg: LearnerConfig, mesh):
 
 
 def _batch_template(cfg: LearnerConfig):
-    """A TrainBatch-shaped pytree of placeholders (None leaves dropped)."""
-    from dotaclient_tpu.env import featurizer as F
-    from dotaclient_tpu.ops.action_dist import Action
-    from dotaclient_tpu.ops.batch import AuxTargets
-    import numpy as np
+    """A TrainBatch-shaped pytree for sharding derivation."""
+    from dotaclient_tpu.ops.batch import zeros_train_batch
 
-    B, T = cfg.batch_size, cfg.seq_len
-    obs = F.Observation(
-        global_feats=np.zeros((B, T + 1, F.GLOBAL_FEATURES), np.float32),
-        hero_feats=np.zeros((B, T + 1, F.HERO_FEATURES), np.float32),
-        unit_feats=np.zeros((B, T + 1, F.MAX_UNITS, F.UNIT_FEATURES), np.float32),
-        unit_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
-        target_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
-        action_mask=np.zeros((B, T + 1, F.N_ACTION_TYPES), bool),
-    )
-    z = np.zeros((B, T), np.float32)
-    zi = np.zeros((B, T), np.int32)
-    aux = AuxTargets(win=z, last_hit=z, net_worth=z) if cfg.policy.aux_heads else None
-    H = cfg.policy.lstm_hidden
-    return TrainBatch(
-        obs=obs,
-        actions=Action(type=zi, move_x=zi, move_y=zi, target=zi),
-        behavior_logp=z,
-        behavior_value=z,
-        rewards=z,
-        dones=z,
-        mask=z,
-        initial_state=(np.zeros((B, H), np.float32), np.zeros((B, H), np.float32)),
-        aux=aux,
-    )
+    return zeros_train_batch(cfg.batch_size, cfg.seq_len, cfg.policy.lstm_hidden, cfg.policy.aux_heads)
 
 
 def make_train_batch(cfg: LearnerConfig, rng_seed: int = 0) -> TrainBatch:

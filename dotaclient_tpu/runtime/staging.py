@@ -1,0 +1,181 @@
+"""Host-side staging buffer: broker frames → padded device batches.
+
+This is the piece the north star adds to the reference design: the
+consumer side of the RMQ pipe gains a TPU host-staging buffer that packs
+variable-length trajectories into fixed [B, T] padded, masked,
+version-filtered batches (BASELINE.json north_star; SURVEY.md §3.2
+device-boundary note). Structure:
+
+- a consumer thread drains the broker and deserializes frames;
+- rollouts older than `max_staleness` learner versions are dropped here,
+  on the host, before they cost any device time (SURVEY.md §7
+  "Staleness/backpressure");
+- a packer assembles ready batches into a bounded queue (depth 2) so
+  packing the next batch overlaps the device step on the current one
+  (double buffering);
+- single-writer ownership: only the consumer thread touches the pending
+  list, only get_batch pops ready batches (SURVEY.md §5 race-detection
+  note — structural avoidance, mirrored from the reference's
+  single-threaded consumers).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dotaclient_tpu.config import LearnerConfig
+from dotaclient_tpu.ops.batch import TrainBatch, zeros_train_batch
+
+_log = logging.getLogger(__name__)
+from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.serialize import Rollout, deserialize_rollout
+
+
+def pack_rollouts(rollouts: List[Rollout], seq_len: int, with_aux: bool) -> TrainBatch:
+    """Pad B variable-length rollouts into one fixed [B, T] TrainBatch.
+
+    Rollouts longer than `seq_len` are a config mismatch and rejected.
+    Padding rows reuse zero observations; `mask` marks real steps. All
+    leaves are numpy — `jax.device_put` with the dp sharding happens at
+    the caller.
+    """
+    B, T = len(rollouts), seq_len
+    H = rollouts[0].initial_state[0].shape[-1]
+    batch = zeros_train_batch(B, T, H, with_aux)
+    obs, actions, aux = batch.obs, batch.actions, batch.aux
+
+    for b, r in enumerate(rollouts):
+        L = r.length
+        if L > T:
+            raise ValueError(f"rollout length {L} exceeds learner seq_len {T}")
+        for field in range(len(obs)):
+            obs[field][b, : L + 1] = r.obs[field][: L + 1]
+        for field in range(len(actions)):
+            actions[field][b, :L] = r.actions[field][:L]
+        batch.behavior_logp[b, :L] = r.behavior_logp
+        batch.behavior_value[b, :L] = r.behavior_value
+        batch.rewards[b, :L] = r.rewards
+        batch.dones[b, :L] = r.dones
+        batch.mask[b, :L] = 1.0
+        batch.initial_state[0][b] = r.initial_state[0]
+        batch.initial_state[1][b] = r.initial_state[1]
+        if aux is not None and r.aux is not None:
+            aux.win[b, :L] = r.aux.win
+            aux.last_hit[b, :L] = r.aux.last_hit
+            aux.net_worth[b, :L] = r.aux.net_worth
+
+    return batch
+
+
+class StagingBuffer:
+    """Consume → filter → pack pipeline feeding the train loop."""
+
+    def __init__(
+        self,
+        cfg: LearnerConfig,
+        broker: Broker,
+        version_fn: Callable[[], int] = lambda: 0,
+    ):
+        self.cfg = cfg
+        self.broker = broker
+        self.version_fn = version_fn
+        self._pending: List[Rollout] = []
+        self._ready: "queue.Queue[TrainBatch]" = queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "consumed": 0,
+            "dropped_stale": 0,
+            "dropped_bad": 0,
+            "batches": 0,
+            "episode_return_sum": 0.0,
+            "episodes": 0,
+            "consumer_errors": 0,
+        }
+
+    # -- consumer thread -------------------------------------------------
+
+    def start(self) -> "StagingBuffer":
+        self._thread = threading.Thread(target=self._run, daemon=True, name="staging-consumer")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        B = self.cfg.batch_size
+        while not self._stop.is_set():
+            try:
+                frames = self.broker.consume_experience(max_items=B, timeout=0.2)
+                if frames:
+                    self._ingest(frames)
+                while len(self._pending) >= B:
+                    batch = pack_rollouts(self._pending[:B], self.cfg.seq_len, self.cfg.policy.aux_heads)
+                    del self._pending[:B]
+                    with self._stats_lock:
+                        self._stats["batches"] += 1
+                    while not self._stop.is_set():
+                        try:
+                            self._ready.put(batch, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+            except Exception:
+                # The consumer thread must never die silently — a dead
+                # consumer hangs the learner in get_batch forever.
+                _log.exception("staging consumer error; continuing")
+                with self._stats_lock:
+                    self._stats["consumer_errors"] += 1
+
+    def _ingest(self, frames: List[bytes]) -> None:
+        min_version = self.version_fn() - self.cfg.ppo.max_staleness
+        H = self.cfg.policy.lstm_hidden
+        consumed = dropped_stale = dropped_bad = episodes = 0
+        ep_ret = 0.0
+        for frame in frames:
+            consumed += 1
+            try:
+                r = deserialize_rollout(frame)
+            except (ValueError, KeyError):
+                dropped_bad += 1
+                continue
+            # Per-frame config validation happens HERE so one misconfigured
+            # actor can only ever cost its own frames, never the pack step.
+            if r.length > self.cfg.seq_len or r.initial_state[0].shape[-1] != H:
+                dropped_bad += 1
+                continue
+            if r.version < min_version:
+                dropped_stale += 1
+                continue
+            if r.length and r.dones[-1] > 0:
+                episodes += 1
+                ep_ret += r.episode_return
+            self._pending.append(r)
+        with self._stats_lock:
+            self._stats["consumed"] += consumed
+            self._stats["dropped_stale"] += dropped_stale
+            self._stats["dropped_bad"] += dropped_bad
+            self._stats["episodes"] += episodes
+            self._stats["episode_return_sum"] += ep_ret
+
+    # -- learner side ----------------------------------------------------
+
+    def get_batch(self, timeout: Optional[float] = None) -> Optional[TrainBatch]:
+        try:
+            return self._ready.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stats(self) -> Dict[str, float]:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["ready_batches"] = self._ready.qsize()
+        out["pending_rollouts"] = len(self._pending)
+        return out
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

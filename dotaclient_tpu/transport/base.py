@@ -1,0 +1,67 @@
+"""Broker abstraction — the actor↔learner plugin boundary.
+
+The reference's transport is RabbitMQ: a durable `experience` queue
+(actors → learner) and a `model` fanout exchange (learner → actors)
+(SURVEY.md §1 L3). That boundary is kept as the plugin surface; three
+interchangeable implementations exist behind one URL scheme:
+
+- `mem://<name>`     — in-process (tests, single-host runs)
+- `tcp://host:port`  — this framework's own lightweight broker
+                        (transport/tcp.py), for clusters without RabbitMQ
+- `amqp://...`       — real RabbitMQ via pika (gated import; matches the
+                        reference deployment)
+
+Semantics all implementations honor:
+- experience: bounded FIFO queue, oldest dropped on overflow (stale
+  experience is worthless to PPO — bounding the queue IS the
+  backpressure policy, SURVEY.md §7 "Staleness/backpressure");
+- weights: fanout with latest-wins — subscribers poll and only ever see
+  the newest version, never a backlog.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Optional
+
+
+class Broker(abc.ABC):
+    @abc.abstractmethod
+    def publish_experience(self, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
+        """Up to `max_items` frames; blocks up to `timeout` (None = forever)
+        for the FIRST frame, then drains without waiting."""
+
+    @abc.abstractmethod
+    def publish_weights(self, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def poll_weights(self) -> Optional[bytes]:
+        """Latest weight frame if newer than the last one returned to this
+        client, else None."""
+
+    def experience_depth(self) -> int:
+        """Current queue depth, if the implementation can know it cheaply."""
+        return -1
+
+    def close(self) -> None:
+        pass
+
+
+def connect(url: str, **kw) -> Broker:
+    if url.startswith("mem://"):
+        from dotaclient_tpu.transport.memory import MemoryBroker
+
+        return MemoryBroker(url[len("mem://") :] or "default", **kw)
+    if url.startswith("tcp://"):
+        from dotaclient_tpu.transport.tcp import TcpBroker
+
+        host, _, port = url[len("tcp://") :].partition(":")
+        return TcpBroker(host or "127.0.0.1", int(port or 13370), **kw)
+    if url.startswith("amqp://"):
+        from dotaclient_tpu.transport.rmq import RmqBroker
+
+        return RmqBroker(url, **kw)
+    raise ValueError(f"unknown broker url scheme: {url!r}")

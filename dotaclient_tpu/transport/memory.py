@@ -1,0 +1,81 @@
+"""In-process broker — the test/single-host stand-in for RabbitMQ
+(SURVEY.md §4 item 3: "a fake broker (in-memory queue implementing the
+publish/consume surface) replaces RMQ")."""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dotaclient_tpu.transport.base import Broker
+
+_REGISTRY: Dict[str, "_Hub"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+class _Hub:
+    """Shared state for all MemoryBroker handles with the same name."""
+
+    def __init__(self, maxlen: int):
+        self.lock = threading.Lock()
+        self.not_empty = threading.Condition(self.lock)
+        self.experience: collections.deque = collections.deque(maxlen=maxlen)
+        self.dropped = 0
+        self.weights: Optional[Tuple[int, bytes]] = None  # (seq, frame)
+        self.weights_seq = 0
+
+
+def _hub(name: str, maxlen: int) -> _Hub:
+    with _REGISTRY_LOCK:
+        if name not in _REGISTRY:
+            _REGISTRY[name] = _Hub(maxlen)
+        return _REGISTRY[name]
+
+
+def reset(name: str = "default") -> None:
+    """Drop a hub (test isolation)."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.pop(name, None)
+
+
+class MemoryBroker(Broker):
+    def __init__(self, name: str = "default", maxlen: int = 4096):
+        self._hub = _hub(name, maxlen)
+        self._seen_weights_seq = 0
+
+    def publish_experience(self, data: bytes) -> None:
+        h = self._hub
+        with h.lock:
+            if len(h.experience) == h.experience.maxlen:
+                h.dropped += 1
+            h.experience.append(data)
+            h.not_empty.notify()
+
+    def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
+        h = self._hub
+        out: List[bytes] = []
+        with h.not_empty:
+            if not h.experience:
+                h.not_empty.wait(timeout)
+            while h.experience and len(out) < max_items:
+                out.append(h.experience.popleft())
+        return out
+
+    def publish_weights(self, data: bytes) -> None:
+        h = self._hub
+        with h.lock:
+            h.weights_seq += 1
+            h.weights = (h.weights_seq, data)
+
+    def poll_weights(self) -> Optional[bytes]:
+        h = self._hub
+        with h.lock:
+            if h.weights is None or h.weights[0] <= self._seen_weights_seq:
+                return None
+            self._seen_weights_seq = h.weights[0]
+            return h.weights[1]
+
+    def experience_depth(self) -> int:
+        with self._hub.lock:
+            return len(self._hub.experience)

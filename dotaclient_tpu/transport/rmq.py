@@ -1,0 +1,93 @@
+"""RabbitMQ broker — drop-in for deployments that run the reference's
+transport (SURVEY.md §1 L3: durable `experience` queue, `model` fanout
+exchange). Requires `pika`, which is intentionally a soft dependency: the
+image this framework develops in does not ship it, and mem:///tcp://
+cover every test and single-cluster path. Import errors surface with a
+clear message instead of at module import time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from dotaclient_tpu.transport.base import Broker
+
+EXPERIENCE_QUEUE = "experience"
+MODEL_EXCHANGE = "model"
+
+
+class RmqBroker(Broker):
+    def __init__(self, url: str, prefetch: int = 512):
+        try:
+            import pika  # noqa: F401
+        except ImportError as e:  # pragma: no cover - exercised only with pika
+            raise ImportError(
+                "amqp:// broker URLs require the 'pika' package; use mem:// "
+                "or tcp:// (dotaclient_tpu.transport.tcp_server) instead"
+            ) from e
+        import pika
+
+        self._pika = pika
+        self._params = pika.URLParameters(url)
+        self._lock = threading.Lock()
+        self._conn = pika.BlockingConnection(self._params)
+        self._ch = self._conn.channel()
+        self._ch.queue_declare(queue=EXPERIENCE_QUEUE, durable=True)
+        self._ch.exchange_declare(exchange=MODEL_EXCHANGE, exchange_type="fanout")
+        self._ch.basic_qos(prefetch_count=prefetch)
+        # Per-subscriber exclusive queue bound to the model fanout.
+        res = self._ch.queue_declare(queue="", exclusive=True)
+        self._model_queue = res.method.queue
+        self._ch.queue_bind(exchange=MODEL_EXCHANGE, queue=self._model_queue)
+
+    def publish_experience(self, data: bytes) -> None:
+        with self._lock:
+            self._ch.basic_publish(
+                exchange="",
+                routing_key=EXPERIENCE_QUEUE,
+                body=data,
+                properties=self._pika.BasicProperties(delivery_mode=2),
+            )
+
+    def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
+        # Contract (transport.base): block up to `timeout` (None = forever)
+        # for the FIRST frame only, then drain without waiting.
+        out: List[bytes] = []
+        with self._lock:
+            for _method, _props, body in self._ch.consume(
+                EXPERIENCE_QUEUE, inactivity_timeout=timeout, auto_ack=True
+            ):
+                if body is not None:
+                    out.append(body)
+                break  # first frame (or first-wait timeout) only
+            self._ch.cancel()
+            while len(out) < max_items:
+                _method, _props, body = self._ch.basic_get(EXPERIENCE_QUEUE, auto_ack=True)
+                if body is None:
+                    break
+                out.append(body)
+        return out
+
+    def publish_weights(self, data: bytes) -> None:
+        with self._lock:
+            self._ch.basic_publish(exchange=MODEL_EXCHANGE, routing_key="", body=data)
+
+    def poll_weights(self) -> Optional[bytes]:
+        latest = None
+        with self._lock:
+            while True:
+                method, _props, body = self._ch.basic_get(self._model_queue, auto_ack=True)
+                if body is None:
+                    break
+                latest = body  # drain to the newest (latest-wins fanout)
+        return latest
+
+    def experience_depth(self) -> int:
+        with self._lock:
+            res = self._ch.queue_declare(queue=EXPERIENCE_QUEUE, durable=True, passive=True)
+        return res.method.message_count
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,244 @@
+"""Wire format for experience rollouts and weight broadcasts.
+
+The reference pickles rollout dicts and state_dicts onto RabbitMQ
+(SURVEY.md §2 "Experience/weight transport"). We deliberately do NOT use
+pickle: the format below is a fixed-layout binary framing of numpy arrays —
+faster to pack/unpack at 50k steps/s, safe to parse from untrusted peers,
+and language-neutral so the native (C++) batch packer can read it without
+a Python runtime.
+
+Rollout frame layout (little-endian):
+  magic  b'DTR1'
+  u32    model_version
+  u16    L          — number of action steps (obs arrays carry L+1 rows)
+  u16    lstm_hidden
+  u8     flags      — bit0: aux targets present; other bits reserved (0)
+  u32    actor_id
+  f32    episode_return (metrics only)
+  then the arrays, in fixed order, raw bytes (shapes derivable from L/H).
+
+Weight frame layout:
+  magic  b'DTW1'
+  u32    version
+  u32    n_leaves
+  per leaf: u16 name_len, name bytes, u8 ndim, u32 dims…, u8 dtype_code,
+            raw data.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.ops.action_dist import Action
+
+_ROLLOUT_MAGIC = b"DTR1"
+_WEIGHTS_MAGIC = b"DTW1"
+_HDR = struct.Struct("<4sIHHBIf")
+
+_FLAG_AUX = 1
+
+
+class RolloutAux(NamedTuple):
+    win: np.ndarray  # [L] f32 ±1 final result, 0 unknown
+    last_hit: np.ndarray  # [L] f32
+    net_worth: np.ndarray  # [L] f32
+
+
+class Rollout(NamedTuple):
+    """One variable-length trajectory chunk as shipped by an actor.
+
+    `obs` leaves have L+1 rows — the extra row is the bootstrap
+    observation after the last action (TrainBatch convention).
+    """
+
+    obs: F.Observation  # leaves [L+1, ...]
+    actions: Action  # leaves [L] i32
+    behavior_logp: np.ndarray  # [L] f32
+    behavior_value: np.ndarray  # [L] f32
+    rewards: np.ndarray  # [L] f32
+    dones: np.ndarray  # [L] f32
+    initial_state: Tuple[np.ndarray, np.ndarray]  # (c, h) each [H] f32
+    version: int
+    actor_id: int = 0
+    episode_return: float = 0.0
+    aux: Optional[RolloutAux] = None
+
+    @property
+    def length(self) -> int:
+        return int(self.rewards.shape[0])
+
+
+def _obs_arrays(obs: F.Observation) -> List[np.ndarray]:
+    return [
+        np.ascontiguousarray(obs.global_feats, np.float32),
+        np.ascontiguousarray(obs.hero_feats, np.float32),
+        np.ascontiguousarray(obs.unit_feats, np.float32),
+        np.ascontiguousarray(obs.unit_mask, np.uint8),
+        np.ascontiguousarray(obs.target_mask, np.uint8),
+        np.ascontiguousarray(obs.action_mask, np.uint8),
+    ]
+
+
+def serialize_rollout(r: Rollout) -> bytes:
+    L = r.length
+    H = r.initial_state[0].shape[-1]
+    flags = _FLAG_AUX if r.aux is not None else 0
+    parts = [_HDR.pack(_ROLLOUT_MAGIC, r.version, L, H, flags, r.actor_id, r.episode_return)]
+    arrays = _obs_arrays(r.obs)
+    arrays += [np.ascontiguousarray(a, np.int32) for a in r.actions]
+    arrays += [
+        np.ascontiguousarray(r.behavior_logp, np.float32),
+        np.ascontiguousarray(r.behavior_value, np.float32),
+        np.ascontiguousarray(r.rewards, np.float32),
+        np.ascontiguousarray(r.dones, np.float32),
+        np.ascontiguousarray(r.initial_state[0], np.float32),
+        np.ascontiguousarray(r.initial_state[1], np.float32),
+    ]
+    if r.aux is not None:
+        arrays += [np.ascontiguousarray(a, np.float32) for a in r.aux]
+    parts.extend(a.tobytes() for a in arrays)
+    return b"".join(parts)
+
+
+def _expected_layout(L: int, H: int, flags: int):
+    """(shape, dtype) per array, in serialization order."""
+    T1 = L + 1
+    layout = [
+        ((T1, F.GLOBAL_FEATURES), np.float32),
+        ((T1, F.HERO_FEATURES), np.float32),
+        ((T1, F.MAX_UNITS, F.UNIT_FEATURES), np.float32),
+        ((T1, F.MAX_UNITS), np.uint8),
+        ((T1, F.MAX_UNITS), np.uint8),
+        ((T1, F.N_ACTION_TYPES), np.uint8),
+    ]
+    layout += [((L,), np.int32)] * 4
+    layout += [((L,), np.float32)] * 4
+    layout += [((H,), np.float32)] * 2
+    if flags & _FLAG_AUX:
+        layout += [((L,), np.float32)] * 3
+    return layout
+
+
+def deserialize_rollout(data: bytes) -> Rollout:
+    if len(data) < _HDR.size or data[:4] != _ROLLOUT_MAGIC:
+        raise ValueError("bad rollout frame")
+    magic, version, L, H, flags, actor_id, ep_ret = _HDR.unpack_from(data)
+    off = _HDR.size
+    arrays = []
+    for shape, dtype in _expected_layout(L, H, flags):
+        n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if off + n > len(data):
+            raise ValueError("truncated rollout frame")
+        arrays.append(np.frombuffer(data, dtype, count=int(np.prod(shape)), offset=off).reshape(shape))
+        off += n
+    if off != len(data):
+        raise ValueError("trailing bytes in rollout frame")
+    obs = F.Observation(
+        global_feats=arrays[0],
+        hero_feats=arrays[1],
+        unit_feats=arrays[2],
+        unit_mask=arrays[3].astype(bool),
+        target_mask=arrays[4].astype(bool),
+        action_mask=arrays[5].astype(bool),
+    )
+    aux = RolloutAux(*arrays[16:19]) if flags & _FLAG_AUX else None
+    return Rollout(
+        obs=obs,
+        actions=Action(*arrays[6:10]),
+        behavior_logp=arrays[10],
+        behavior_value=arrays[11],
+        rewards=arrays[12],
+        dones=arrays[13],
+        initial_state=(arrays[14], arrays[15]),
+        version=version,
+        actor_id=actor_id,
+        episode_return=ep_ret,
+        aux=aux,
+    )
+
+
+# --- weights -----------------------------------------------------------
+
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.uint8}
+
+
+def _dtype_code(dt) -> int:
+    dt = np.dtype(dt)
+    if dt == np.float32:
+        return 0
+    if dt == np.int32:
+        return 1
+    if dt == np.uint8:
+        return 2
+    raise ValueError(f"unsupported weight dtype {dt}")
+
+
+def serialize_weights(named_arrays: List[Tuple[str, np.ndarray]], version: int) -> bytes:
+    parts = [struct.pack("<4sII", _WEIGHTS_MAGIC, version, len(named_arrays))]
+    for name, arr in named_arrays:
+        arr = np.ascontiguousarray(arr)
+        nb = name.encode()
+        parts.append(struct.pack("<H", len(nb)))
+        parts.append(nb)
+        parts.append(struct.pack("<B", arr.ndim))
+        parts.append(struct.pack(f"<{arr.ndim}I", *arr.shape) if arr.ndim else b"")
+        parts.append(struct.pack("<B", _dtype_code(arr.dtype)))
+        parts.append(arr.tobytes())
+    return b"".join(parts)
+
+
+def deserialize_weights(data: bytes) -> Tuple[List[Tuple[str, np.ndarray]], int]:
+    magic, version, n = struct.unpack_from("<4sII", data)
+    if magic != _WEIGHTS_MAGIC:
+        raise ValueError("bad weights frame")
+    off = struct.calcsize("<4sII")
+    out = []
+    for _ in range(n):
+        (name_len,) = struct.unpack_from("<H", data, off)
+        off += 2
+        name = data[off : off + name_len].decode()
+        off += name_len
+        (ndim,) = struct.unpack_from("<B", data, off)
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}I", data, off) if ndim else ()
+        off += 4 * ndim
+        (code,) = struct.unpack_from("<B", data, off)
+        off += 1
+        dtype = _DTYPES[code]
+        count = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(data, dtype, count=count, offset=off).reshape(shape)
+        off += count * np.dtype(dtype).itemsize
+        out.append((name, arr))
+    return out, version
+
+
+def flatten_params(params) -> List[Tuple[str, np.ndarray]]:
+    """Flax params pytree → sorted (path, f32 array) list."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out.append((name, np.asarray(leaf, np.float32)))
+    return sorted(out)
+
+
+def unflatten_params(named_arrays, template):
+    """Inverse of flatten_params given a params template pytree."""
+    import jax
+
+    lookup = dict(named_arrays)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arr = lookup[name]
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(f"shape mismatch for {name}: {arr.shape} vs {leaf.shape}")
+        leaves.append(arr.astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(template), leaves)

@@ -1,0 +1,266 @@
+"""Self-contained TCP experience broker — this framework's native
+replacement for the RabbitMQ server when one isn't available.
+
+The reference assumes a stock RabbitMQ deployment (SURVEY.md §1 L3). In
+environments without it, `python -m dotaclient_tpu.transport.tcp_server`
+provides the same two primitives over one TCP port: a bounded
+drop-oldest experience queue and a latest-wins weight fanout. The client
+(`TcpBroker`) implements the standard Broker interface, so actors and
+learner are agnostic to which broker backs the URL.
+
+Framing: every message is  u32 payload_len | u8 type | payload.
+  0x01 PUB_EXP   payload = experience frame            (no reply)
+  0x02 CONSUME   payload = u16 max_items, f32 timeout  → 0x82 reply
+  0x03 PUB_W     payload = weight frame                (no reply)
+  0x04 GET_W     payload = u32 last_seen_seq           → 0x84 reply
+  0x05 DEPTH     no payload                            → 0x85 reply
+  0x82 reply     u16 count, then per frame u32 len + bytes
+  0x84 reply     u32 seq (0 = nothing newer), frame bytes
+  0x85 reply     u32 depth, u32 dropped
+
+The client keeps two independent connections — one for the experience
+path, one for the weight path — so a long blocking consume never stalls
+weight publishes/polls from another thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional
+
+from dotaclient_tpu.transport.base import Broker
+
+_LEN = struct.Struct("<I")
+_TYPE = struct.Struct("<B")
+
+PUB_EXP, CONSUME, PUB_W, GET_W, DEPTH = 0x01, 0x02, 0x03, 0x04, 0x05
+R_CONSUME, R_GET_W, R_DEPTH = 0x82, 0x84, 0x85
+
+MAX_FRAME = 256 * 1024 * 1024
+_POLL_SLICE = 30.0  # max per-request server-side wait when blocking forever
+
+
+# --------------------------------------------------------------------- server
+
+
+class BrokerServer:
+    """Asyncio broker server; `start()` runs it in a daemon thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 13370, maxlen: int = 4096):
+        self.host, self.port, self.maxlen = host, port, maxlen
+        self.experience: collections.deque = collections.deque(maxlen=maxlen)
+        self.dropped = 0
+        self.weights: Optional[bytes] = None
+        self.weights_seq = 0
+        self._cond: Optional[asyncio.Condition] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._boot_error: Optional[BaseException] = None
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                hdr = await reader.readexactly(_LEN.size + _TYPE.size)
+                (n,) = _LEN.unpack_from(hdr)
+                (mtype,) = _TYPE.unpack_from(hdr, _LEN.size)
+                if n > MAX_FRAME:
+                    raise ValueError("frame too large")
+                payload = await reader.readexactly(n) if n else b""
+                await self._dispatch(mtype, payload, writer)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _dispatch(self, mtype: int, payload: bytes, writer: asyncio.StreamWriter):
+        assert self._cond is not None
+        if mtype == PUB_EXP:
+            async with self._cond:
+                if len(self.experience) == self.experience.maxlen:
+                    self.dropped += 1
+                self.experience.append(payload)
+                self._cond.notify_all()
+        elif mtype == CONSUME:
+            max_items, timeout = struct.unpack("<Hf", payload)
+            async with self._cond:
+                if not self.experience and timeout > 0:
+                    try:
+                        await asyncio.wait_for(
+                            self._cond.wait_for(lambda: len(self.experience) > 0), timeout
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                frames = []
+                while self.experience and len(frames) < max_items:
+                    frames.append(self.experience.popleft())
+            out = [struct.pack("<H", len(frames))]
+            for f in frames:
+                out.append(_LEN.pack(len(f)))
+                out.append(f)
+            await self._reply(writer, R_CONSUME, b"".join(out))
+        elif mtype == PUB_W:
+            self.weights_seq += 1
+            self.weights = payload
+        elif mtype == GET_W:
+            (seen,) = struct.unpack("<I", payload)
+            if self.weights is not None and self.weights_seq > seen:
+                await self._reply(writer, R_GET_W, struct.pack("<I", self.weights_seq) + self.weights)
+            else:
+                await self._reply(writer, R_GET_W, struct.pack("<I", 0))
+        elif mtype == DEPTH:
+            await self._reply(writer, R_DEPTH, struct.pack("<II", len(self.experience), self.dropped))
+        else:
+            raise ValueError(f"unknown message type {mtype:#x}")
+
+    async def _reply(self, writer: asyncio.StreamWriter, mtype: int, payload: bytes):
+        writer.write(_LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload)
+        await writer.drain()
+
+    async def _main(self):
+        self._cond = asyncio.Condition()
+        self._stop_ev = asyncio.Event()
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+        async with self._server:
+            await self._stop_ev.wait()
+
+    def start(self) -> "BrokerServer":
+        self._thread = threading.Thread(target=self._run, daemon=True, name="broker-server")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("broker server failed to start (timeout)")
+        if self._boot_error is not None:
+            raise RuntimeError(f"broker server failed to start: {self._boot_error}") from self._boot_error
+        return self
+
+    def _run(self):
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(self._main())
+            # Drain leftover connection handlers before closing the loop so
+            # shutdown is silent (no "Event loop is closed" from tasks).
+            pending = asyncio.all_tasks(loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(asyncio.gather(*pending, return_exceptions=True))
+        except BaseException as e:
+            self._boot_error = e
+            self._started.set()
+        finally:
+            loop.close()
+
+    def stop(self):
+        if self._loop is not None and not self._loop.is_closed():
+            try:
+                self._loop.call_soon_threadsafe(self._stop_ev.set)
+            except RuntimeError:
+                pass  # loop exited between the check and the call
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+# --------------------------------------------------------------------- client
+
+
+class _Conn:
+    """One blocking framed connection with its own lock."""
+
+    def __init__(self, addr, connect_timeout: float):
+        self.lock = threading.Lock()
+        self.sock = socket.create_connection(addr, timeout=connect_timeout)
+        self.sock.settimeout(None)
+
+    def request(self, mtype: int, payload: bytes, expected_reply: Optional[int]) -> Optional[bytes]:
+        with self.lock:
+            self.sock.sendall(_LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload)
+            if expected_reply is None:
+                return None
+            hdr = self._recv_exact(_LEN.size + _TYPE.size)
+            (n,) = _LEN.unpack_from(hdr)
+            (rtype,) = _TYPE.unpack_from(hdr, _LEN.size)
+            if rtype != expected_reply:
+                raise ValueError(f"unexpected reply type {rtype:#x}")
+            return self._recv_exact(n) if n else b""
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self.sock.recv(n)
+            if not chunk:
+                raise ConnectionError("broker connection closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def close(self):
+        with self.lock:
+            self.sock.close()
+
+
+class TcpBroker(Broker):
+    """Blocking, thread-safe client of BrokerServer."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 13370, connect_timeout: float = 10.0):
+        self._exp = _Conn((host, port), connect_timeout)
+        self._w = _Conn((host, port), connect_timeout)
+        self._seen_weights_seq = 0
+
+    def publish_experience(self, data: bytes) -> None:
+        self._exp.request(PUB_EXP, data, None)
+
+    def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if deadline is None:
+                wait = _POLL_SLICE
+            else:
+                wait = max(0.0, deadline - time.monotonic())
+            payload = self._exp.request(
+                CONSUME, struct.pack("<Hf", max_items, min(wait, _POLL_SLICE)), R_CONSUME
+            )
+            assert payload is not None
+            (count,) = struct.unpack_from("<H", payload)
+            if count or (deadline is not None and time.monotonic() >= deadline):
+                break
+        off = 2
+        frames = []
+        for _ in range(count):
+            (n,) = _LEN.unpack_from(payload, off)
+            off += _LEN.size
+            frames.append(payload[off : off + n])
+            off += n
+        return frames
+
+    def publish_weights(self, data: bytes) -> None:
+        self._w.request(PUB_W, data, None)
+
+    def poll_weights(self) -> Optional[bytes]:
+        payload = self._w.request(GET_W, struct.pack("<I", self._seen_weights_seq), R_GET_W)
+        assert payload is not None
+        (seq,) = struct.unpack_from("<I", payload)
+        if seq == 0:
+            return None
+        self._seen_weights_seq = seq
+        return payload[4:]
+
+    def experience_depth(self) -> int:
+        payload = self._w.request(DEPTH, b"", R_DEPTH)
+        assert payload is not None
+        depth, _dropped = struct.unpack("<II", payload)
+        return depth
+
+    def close(self) -> None:
+        self._exp.close()
+        self._w.close()

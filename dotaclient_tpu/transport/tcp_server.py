@@ -1,0 +1,31 @@
+"""Broker server binary: python -m dotaclient_tpu.transport.tcp_server
+
+Deploys where the reference deploys its RabbitMQ pod (SURVEY.md §3.5) when
+a real RabbitMQ isn't wanted; `amqp://` URLs still work via transport/rmq.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from dotaclient_tpu.transport.tcp import BrokerServer
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="dotaclient-tpu experience broker")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=13370)
+    p.add_argument("--maxlen", type=int, default=4096, help="experience queue bound (drop-oldest)")
+    args = p.parse_args(argv)
+    server = BrokerServer(args.host, args.port, args.maxlen).start()
+    print(f"broker listening on {args.host}:{server.port} (queue bound {args.maxlen})", flush=True)
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+import time
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.runtime.staging import StagingBuffer, pack_rollouts
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect
+from dotaclient_tpu.transport.serialize import serialize_rollout
+
+from tests.test_transport import make_rollout
+
+CFG = LearnerConfig(
+    batch_size=4,
+    seq_len=8,
+    policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16),
+)
+
+
+def test_pack_pads_and_masks():
+    rollouts = [make_rollout(L=L, H=8, seed=L) for L in (3, 8, 5, 1)]
+    batch = pack_rollouts(rollouts, seq_len=8, with_aux=False)
+    assert batch.mask.shape == (4, 8)
+    np.testing.assert_array_equal(batch.mask.sum(1), [3, 8, 5, 1])
+    # row 0: data matches up to L, zero beyond
+    r0 = rollouts[0]
+    np.testing.assert_array_equal(batch.rewards[0, :3], r0.rewards)
+    assert (batch.rewards[0, 3:] == 0).all()
+    np.testing.assert_array_equal(batch.obs.unit_feats[0, :4], r0.obs.unit_feats)
+    assert (batch.obs.unit_feats[0, 4:] == 0).all()
+    # padded action_mask rows keep NOOP legal (uniform-safe under masking)
+    assert batch.obs.action_mask[0, 5:, 0].all()
+    np.testing.assert_array_equal(batch.initial_state[0][0], r0.initial_state[0])
+
+
+def test_pack_rejects_overlong():
+    with pytest.raises(ValueError):
+        pack_rollouts([make_rollout(L=9)], seq_len=8, with_aux=False)
+
+
+def test_pack_aux_fill():
+    rollouts = [make_rollout(L=4, aux=True), make_rollout(L=2, aux=False)]
+    batch = pack_rollouts(rollouts, seq_len=6, with_aux=True)
+    assert batch.aux is not None
+    np.testing.assert_array_equal(batch.aux.win[0, :4], rollouts[0].aux.win)
+    assert (batch.aux.win[1] == 0).all()  # missing aux → zeros (unknown)
+
+
+def test_staging_end_to_end_with_staleness():
+    mem.reset("stage")
+    broker = connect("mem://stage")
+    version = [10]
+    buf = StagingBuffer(CFG, connect("mem://stage"), version_fn=lambda: version[0]).start()
+    try:
+        # 2 stale (version 1 < 10-4), 6 fresh → exactly one batch of 4
+        for v, n in ((1, 2), (9, 6)):
+            for i in range(n):
+                broker.publish_experience(serialize_rollout(make_rollout(L=4, H=8, version=v, seed=v * 10 + i)))
+        batch = buf.get_batch(timeout=5)
+        assert batch is not None
+        assert batch.mask.shape == (4, 8)
+        deadline = time.time() + 5
+        while buf.stats()["consumed"] < 8 and time.time() < deadline:
+            time.sleep(0.05)
+        stats = buf.stats()
+        assert stats["consumed"] == 8
+        assert stats["dropped_stale"] == 2
+        assert stats["batches"] == 1
+        assert stats["pending_rollouts"] == 2  # 6 fresh - 4 packed
+    finally:
+        buf.stop()
+
+
+def test_staging_drops_garbage_frames():
+    mem.reset("stage2")
+    broker = connect("mem://stage2")
+    buf = StagingBuffer(CFG, connect("mem://stage2")).start()
+    try:
+        broker.publish_experience(b"not a rollout")
+        for i in range(4):
+            broker.publish_experience(serialize_rollout(make_rollout(L=2, H=8, version=0, seed=i)))
+        batch = buf.get_batch(timeout=5)
+        assert batch is not None
+        assert buf.stats()["dropped_bad"] == 1
+    finally:
+        buf.stop()
+
+
+def test_staging_double_buffer_bounded():
+    mem.reset("stage3")
+    broker = connect("mem://stage3")
+    buf = StagingBuffer(CFG, connect("mem://stage3")).start()
+    try:
+        for i in range(CFG.batch_size * 10):
+            broker.publish_experience(serialize_rollout(make_rollout(L=3, H=8, version=0, seed=i)))
+        time.sleep(1.0)
+        stats = buf.stats()
+        assert stats["ready_batches"] <= 2  # bounded: packing waits for consumer
+        got = 0
+        while buf.get_batch(timeout=1) is not None:
+            got += 1
+        assert got >= 3
+    finally:
+        buf.stop()
+
+
+def test_misconfigured_actor_frames_dropped_not_fatal():
+    # frames that deserialize fine but violate learner config (L > seq_len,
+    # wrong lstm H) must be counted dropped_bad, and good frames still flow.
+    mem.reset("stage4")
+    broker = connect("mem://stage4")
+    buf = StagingBuffer(CFG, connect("mem://stage4")).start()
+    try:
+        broker.publish_experience(serialize_rollout(make_rollout(L=12, H=8)))  # L > 8
+        broker.publish_experience(serialize_rollout(make_rollout(L=4, H=32)))  # H != 8
+        for i in range(4):
+            broker.publish_experience(serialize_rollout(make_rollout(L=3, H=8, seed=i)))
+        batch = buf.get_batch(timeout=5)
+        assert batch is not None
+        assert buf.stats()["dropped_bad"] == 2
+        assert buf.stats()["consumer_errors"] == 0
+    finally:
+        buf.stop()

@@ -1,0 +1,246 @@
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.ops.action_dist import Action
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect
+from dotaclient_tpu.transport.serialize import (
+    Rollout,
+    RolloutAux,
+    deserialize_rollout,
+    deserialize_weights,
+    flatten_params,
+    serialize_rollout,
+    serialize_weights,
+    unflatten_params,
+)
+from dotaclient_tpu.transport.tcp import BrokerServer, TcpBroker
+
+
+def make_rollout(L=5, H=8, version=3, actor_id=11, aux=False, seed=0):
+    r = np.random.RandomState(seed)
+    T1 = L + 1
+    obs = F.Observation(
+        global_feats=r.randn(T1, F.GLOBAL_FEATURES).astype(np.float32),
+        hero_feats=r.randn(T1, F.HERO_FEATURES).astype(np.float32),
+        unit_feats=r.randn(T1, F.MAX_UNITS, F.UNIT_FEATURES).astype(np.float32),
+        unit_mask=r.rand(T1, F.MAX_UNITS) < 0.5,
+        target_mask=r.rand(T1, F.MAX_UNITS) < 0.3,
+        action_mask=r.rand(T1, F.N_ACTION_TYPES) < 0.8,
+    )
+    return Rollout(
+        obs=obs,
+        actions=Action(
+            type=r.randint(0, 4, L).astype(np.int32),
+            move_x=r.randint(0, 9, L).astype(np.int32),
+            move_y=r.randint(0, 9, L).astype(np.int32),
+            target=r.randint(0, F.MAX_UNITS, L).astype(np.int32),
+        ),
+        behavior_logp=r.randn(L).astype(np.float32),
+        behavior_value=r.randn(L).astype(np.float32),
+        rewards=r.randn(L).astype(np.float32),
+        dones=np.concatenate([np.zeros(L - 1, np.float32), np.ones(1, np.float32)]),
+        initial_state=(r.randn(H).astype(np.float32), r.randn(H).astype(np.float32)),
+        version=version,
+        actor_id=actor_id,
+        episode_return=1.25,
+        aux=RolloutAux(
+            win=np.sign(r.randn(L)).astype(np.float32),
+            last_hit=r.rand(L).astype(np.float32),
+            net_worth=r.rand(L).astype(np.float32),
+        )
+        if aux
+        else None,
+    )
+
+
+@pytest.mark.parametrize("aux", [False, True])
+def test_rollout_roundtrip(aux):
+    r0 = make_rollout(aux=aux)
+    data = serialize_rollout(r0)
+    r1 = deserialize_rollout(data)
+    assert r1.version == 3 and r1.actor_id == 11 and r1.length == 5
+    assert abs(r1.episode_return - 1.25) < 1e-6
+    for a, b in zip(
+        [*r0.obs, *r0.actions, r0.behavior_logp, r0.rewards, *r0.initial_state],
+        [*r1.obs, *r1.actions, r1.behavior_logp, r1.rewards, *r1.initial_state],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    if aux:
+        np.testing.assert_array_equal(r0.aux.win, r1.aux.win)
+    else:
+        assert r1.aux is None
+
+
+def test_rollout_rejects_garbage():
+    with pytest.raises(ValueError):
+        deserialize_rollout(b"garbage")
+    good = serialize_rollout(make_rollout())
+    with pytest.raises(ValueError):
+        deserialize_rollout(good[: len(good) // 2])
+    with pytest.raises(ValueError):
+        deserialize_rollout(good + b"x")
+
+
+def test_weights_roundtrip_with_params_tree():
+    import jax
+
+    from dotaclient_tpu.config import PolicyConfig
+    from dotaclient_tpu.models.policy import init_params
+
+    cfg = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    flat = flatten_params(params)
+    data = serialize_weights(flat, version=42)
+    named, version = deserialize_weights(data)
+    assert version == 42
+    rebuilt = unflatten_params(named, params)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(rebuilt)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestMemoryBroker:
+    def setup_method(self):
+        mem.reset("t")
+
+    def test_pub_consume(self):
+        b = connect("mem://t")
+        b.publish_experience(b"a")
+        b.publish_experience(b"b")
+        assert b.consume_experience(10, timeout=0.1) == [b"a", b"b"]
+        assert b.consume_experience(10, timeout=0.05) == []
+
+    def test_bounded_drop_oldest(self):
+        b = mem.MemoryBroker("t", maxlen=2)
+        for x in (b"1", b"2", b"3"):
+            b.publish_experience(x)
+        assert b.consume_experience(10, timeout=0.1) == [b"2", b"3"]
+
+    def test_weights_latest_wins(self):
+        pub, sub = connect("mem://t"), connect("mem://t")
+        assert sub.poll_weights() is None
+        pub.publish_weights(b"v1")
+        pub.publish_weights(b"v2")
+        assert sub.poll_weights() == b"v2"
+        assert sub.poll_weights() is None  # nothing newer
+        pub.publish_weights(b"v3")
+        assert sub.poll_weights() == b"v3"
+
+    def test_consume_blocks_until_publish(self):
+        b = connect("mem://t")
+        got = []
+
+        def consumer():
+            got.extend(b.consume_experience(1, timeout=5))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.1)
+        b.publish_experience(b"x")
+        t.join(timeout=5)
+        assert got == [b"x"]
+
+
+class TestTcpBroker:
+    @pytest.fixture(scope="class")
+    def server(self):
+        s = BrokerServer(port=0, maxlen=64).start()
+        yield s
+        s.stop()
+
+    def test_roundtrip(self, server):
+        a = TcpBroker(port=server.port)
+        b = TcpBroker(port=server.port)
+        a.publish_experience(b"hello")
+        a.publish_experience(b"world" * 1000)
+        frames = b.consume_experience(10, timeout=1)
+        assert frames == [b"hello", b"world" * 1000]
+        assert b.consume_experience(10, timeout=0.05) == []
+        a.close(), b.close()
+
+    def test_weights(self, server):
+        pub = TcpBroker(port=server.port)
+        sub = TcpBroker(port=server.port)
+        assert sub.poll_weights() is None
+        pub.publish_weights(b"W1")
+        pub.publish_weights(b"W2")
+        assert sub.poll_weights() == b"W2"
+        assert sub.poll_weights() is None
+        pub.close(), sub.close()
+
+    def test_consume_blocks_for_first_frame(self, server):
+        pub = TcpBroker(port=server.port)
+        sub = TcpBroker(port=server.port)
+        sub.consume_experience(100, timeout=0.05)  # drain
+        got = []
+
+        def consumer():
+            got.extend(sub.consume_experience(1, timeout=5))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.2)
+        pub.publish_experience(b"late")
+        t.join(timeout=5)
+        assert got == [b"late"]
+        pub.close(), sub.close()
+
+    def test_depth(self, server):
+        c = TcpBroker(port=server.port)
+        c.consume_experience(1000, timeout=0.05)
+        c.publish_experience(b"d1")
+        c.publish_experience(b"d2")
+        time.sleep(0.05)
+        assert c.experience_depth() == 2
+        c.consume_experience(10, timeout=0.5)
+        c.close()
+
+    def test_bounded_drop_oldest(self, server):
+        c = TcpBroker(port=server.port)
+        c.consume_experience(1000, timeout=0.05)
+        for i in range(server.maxlen + 10):
+            c.publish_experience(f"{i}".encode())
+        time.sleep(0.1)
+        frames = []
+        while True:
+            got = c.consume_experience(1000, timeout=0.2)
+            if not got:
+                break
+            frames.extend(got)
+        assert len(frames) == server.maxlen
+        assert frames[0] == b"10"  # oldest 10 dropped
+        c.close()
+
+    def test_concurrent_producers(self, server):
+        brokers = [TcpBroker(port=server.port) for _ in range(4)]
+        sub = TcpBroker(port=server.port)
+        sub.consume_experience(1000, timeout=0.05)
+
+        def produce(br, i):
+            # 4×15 = 60 < server.maxlen, so nothing is dropped
+            for j in range(15):
+                br.publish_experience(f"{i}:{j}".encode())
+
+        threads = [threading.Thread(target=produce, args=(br, i)) for i, br in enumerate(brokers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = []
+        deadline = time.time() + 5
+        while len(got) < 60 and time.time() < deadline:
+            got.extend(sub.consume_experience(100, timeout=0.5))
+        assert len(got) == 60
+        assert len(set(got)) == 60
+        for br in brokers:
+            br.close()
+        sub.close()
+
+
+def test_connect_unknown_scheme():
+    with pytest.raises(ValueError):
+        connect("bogus://x")
