@@ -76,6 +76,11 @@ class ActorConfig:
     opponent: str = "scripted"  # "scripted" | "self"
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     seed: int = 0
+    actor_id: int = 0
+    # Actors are CPU processes (reference architecture: the accelerator
+    # belongs to the learner). "cpu" also defeats environments that
+    # force-register an accelerator backend for every python process.
+    platform: str = "cpu"
 
 
 def _parse_bool(s: str) -> bool:

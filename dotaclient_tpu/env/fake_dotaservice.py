@@ -1,0 +1,357 @@
+"""In-process fake dotaservice: a synthetic 1v1-mid MDP behind the real
+gRPC API.
+
+SURVEY.md §4 item 3 prescribes exactly this: "a fake dotaservice — an
+in-process gRPC server replaying recorded worldstate traces and accepting
+any Actions — drives the real actor loop". The real dotaservice (a
+headless Dota 2 dedicated server wrapper, SURVEY.md §1 L0) cannot run in
+CI; this fake speaks the same protos through the same stubs so every
+actor-side line of code is exercised unmodified.
+
+The MDP ("last-hit lane"): the controlled hero faces a lane of enemy
+creeps plus a scripted enemy hero.
+
+- Creep waves spawn every 30 dota-seconds; creeps drift toward the
+  hero's tower and lose hp to the (implicit) friendly wave.
+- ATTACK on a creep deals damage; the killing blow grants last_hit,
+  gold and xp — the dominant shaped-reward signal, exactly like real
+  1v1 laning.
+- The scripted enemy hero advances and attacks when the hero is in
+  range; standing in range bleeds hp, so the policy must learn to
+  trade: step in to last-hit, step out to survive.
+- Killing the enemy hero (or surviving to max_dota_time with more
+  net worth) wins; dying loses.
+
+Determinism: all randomness flows from GameConfig.seed.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from dotaclient_tpu.env.service import DotaServiceServicer
+from dotaclient_tpu.protos import dotaservice_pb2 as ds
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+
+TEAM_RADIANT, TEAM_DIRE = 2, 3
+
+_HERO_HANDLE = 1
+_ENEMY_HERO_HANDLE = 2
+_TICKS_PER_SEC = 30.0
+
+_CREEP_HP = 550.0
+_CREEP_DMG = 21.0
+_HERO_HP = 650.0
+_HERO_DMG = 53.0
+_HERO_RANGE = 600.0
+_HERO_SPEED = 310.0
+_WAVE_PERIOD = 30.0
+_CREEP_AGGRO_RADIUS = 150.0
+_ENEMY_PURSUE_RADIUS = 700.0
+_WAVE_SIZE = 4
+_XP_PER_CREEP = 60
+_GOLD_PER_CREEP = 40
+
+
+class _Unit:
+    __slots__ = ("handle", "unit_type", "team", "x", "y", "hp", "hp_max", "alive", "player_id")
+
+    def __init__(self, handle, unit_type, team, x, y, hp, player_id=-1):
+        self.handle = handle
+        self.unit_type = unit_type
+        self.team = team
+        self.x, self.y = x, y
+        self.hp = self.hp_max = hp
+        self.alive = True
+        self.player_id = player_id
+
+
+class LastHitLaneGame:
+    """Pure-python MDP state; stepped by FakeDotaService."""
+
+    def __init__(self, config: ds.GameConfig):
+        self.rng = np.random.RandomState(config.seed or 0)
+        self.dt = max(config.ticks_per_observation, 1) / _TICKS_PER_SEC
+        self.max_time = config.max_dota_time if config.max_dota_time > 0 else 120.0
+        self.dota_time = 0.0
+        self.tick = 0
+        self.next_handle = 100
+        self.next_wave_time = 0.0
+        self.winning_team = 0
+        self.hero = _Unit(_HERO_HANDLE, ws.Unit.HERO, TEAM_RADIANT, -1500.0, 0.0, _HERO_HP, player_id=0)
+        self.enemy_hero = _Unit(_ENEMY_HERO_HANDLE, ws.Unit.HERO, TEAM_DIRE, 1500.0, 0.0, _HERO_HP, player_id=5)
+        self.creeps: list[_Unit] = []
+        self.stats = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
+        self.enemy_stats = {"xp": 0, "gold": 600, "last_hits": 0, "kills": 0, "deaths": 0}
+        # pending action for the controlled hero, applied on next step
+        self.pending: Optional[ds.Action] = None
+        self._maybe_spawn_wave()
+
+    # ------------------------------------------------------------- stepping
+
+    def step(self) -> None:
+        """Advance the world by one observation interval."""
+        if self.winning_team:
+            return
+        dt = self.dt
+        self.dota_time += dt
+        self.tick += int(dt * _TICKS_PER_SEC)
+        self._maybe_spawn_wave()
+        self._apply_hero_action(dt)
+        self._scripted_enemy(dt)
+        self._creep_combat(dt)
+        self._regen(dt)
+        self._check_end()
+
+    def _maybe_spawn_wave(self) -> None:
+        if self.dota_time >= self.next_wave_time:
+            self.next_wave_time += _WAVE_PERIOD
+            for i in range(_WAVE_SIZE):
+                x = 200.0 + 40.0 * i + self.rng.uniform(-20, 20)
+                y = self.rng.uniform(-120, 120)
+                self.creeps.append(
+                    _Unit(self.next_handle, ws.Unit.LANE_CREEP, TEAM_DIRE, x, y, _CREEP_HP)
+                )
+                self.next_handle += 1
+
+    def _apply_hero_action(self, dt: float) -> None:
+        act = self.pending
+        self.pending = None
+        h = self.hero
+        if not h.alive or act is None:
+            return
+        if act.type == ds.Action.MOVE:
+            self._move_toward(h, act.move_x, act.move_y, _HERO_SPEED * dt)
+        elif act.type == ds.Action.ATTACK:
+            target = self._find(act.target_handle)
+            if target is not None and target.alive and target.team != h.team:
+                if self._dist(h, target) <= _HERO_RANGE:
+                    dmg = _HERO_DMG * dt * 1.4 * (1.0 + 0.1 * self.rng.randn())
+                    target.hp -= max(dmg, 0.0)
+                    if target.hp <= 0:
+                        target.alive = False
+                        if target.unit_type == ws.Unit.LANE_CREEP:
+                            self.stats["last_hits"] += 1
+                            self.stats["gold"] += _GOLD_PER_CREEP
+                            self.stats["xp"] += _XP_PER_CREEP
+                        elif target is self.enemy_hero:
+                            self.stats["kills"] += 1
+                            self.enemy_stats["deaths"] += 1
+                else:
+                    # out of range: walk toward the target (attack-move)
+                    self._move_toward(h, target.x, target.y, _HERO_SPEED * dt)
+
+    def _scripted_enemy(self, dt: float) -> None:
+        e = self.enemy_hero
+        h = self.hero
+        if not e.alive:
+            return
+        if h.alive and self._dist(e, h) <= _HERO_RANGE:
+            h.hp -= _HERO_DMG * dt * (1.0 + 0.1 * self.rng.randn())
+            if h.hp <= 0:
+                h.alive = False
+                self.stats["deaths"] += 1
+                self.enemy_stats["kills"] += 1
+        elif h.alive and self._dist(e, h) < _ENEMY_PURSUE_RADIUS:
+            self._move_toward(e, h.x, h.y, _HERO_SPEED * 0.8 * dt)
+        else:
+            # hold position under its own tower — diving it is punished,
+            # farming the creep line in the middle of the lane is safe
+            self._move_toward(e, 1200.0, 0.0, _HERO_SPEED * 0.5 * dt)
+
+    def _creep_combat(self, dt: float) -> None:
+        # implicit friendly wave whittles enemy creeps; creeps poke the hero
+        h = self.hero
+        for c in self.creeps:
+            if not c.alive:
+                continue
+            c.hp -= (14.0 + 6.0 * self.rng.rand()) * dt  # friendly-wave dps
+            if c.hp <= 0:
+                c.alive = False  # denied by the wave — no last-hit credit
+                continue
+            self._move_toward(c, -800.0, 0.0, 40.0 * dt)
+            if h.alive and self._dist(c, h) <= _CREEP_AGGRO_RADIUS:
+                h.hp -= _CREEP_DMG * dt * 0.2
+                if h.hp <= 0:
+                    h.alive = False
+                    self.stats["deaths"] += 1
+        self.creeps = [c for c in self.creeps if c.alive and c.x > -1800.0]
+
+    def _regen(self, dt: float) -> None:
+        for u in (self.hero, self.enemy_hero):
+            if u.alive:
+                u.hp = min(u.hp + 4.0 * dt, u.hp_max)
+        # passive xp trickle so standing safely far away is weakly positive
+        self.stats["xp"] += int(2 * dt)
+
+    def _check_end(self) -> None:
+        if not self.hero.alive:
+            self.winning_team = TEAM_DIRE
+        elif not self.enemy_hero.alive:
+            self.winning_team = TEAM_RADIANT
+        elif self.dota_time >= self.max_time:
+            mine = self.stats["gold"] + self.stats["xp"]
+            theirs = self.enemy_stats["gold"] + self.enemy_stats["xp"]
+            self.winning_team = TEAM_RADIANT if mine >= theirs else TEAM_DIRE
+
+    # ------------------------------------------------------------- helpers
+
+    def _find(self, handle: int) -> Optional[_Unit]:
+        if handle == _HERO_HANDLE:
+            return self.hero
+        if handle == _ENEMY_HERO_HANDLE:
+            return self.enemy_hero
+        for c in self.creeps:
+            if c.handle == handle:
+                return c
+        return None
+
+    @staticmethod
+    def _dist(a: _Unit, b: _Unit) -> float:
+        return math.hypot(a.x - b.x, a.y - b.y)
+
+    @staticmethod
+    def _move_toward(u: _Unit, x: float, y: float, dist: float) -> None:
+        dx, dy = x - u.x, y - u.y
+        norm = math.hypot(dx, dy)
+        if norm <= dist or norm == 0:
+            u.x, u.y = x, y
+        else:
+            u.x += dx / norm * dist
+            u.y += dy / norm * dist
+
+    # ---------------------------------------------------------- worldstate
+
+    def worldstate(self, team_id: int) -> ws.World:
+        w = ws.World(
+            dota_time=self.dota_time,
+            game_state=5,
+            tick=self.tick,
+            team_id=team_id,
+            winning_team=self.winning_team,
+        )
+        w.player_ids.append(0 if team_id == TEAM_RADIANT else 5)
+        for u, stats in ((self.hero, self.stats), (self.enemy_hero, self.enemy_stats)):
+            p = w.units.add(
+                handle=u.handle,
+                unit_type=ws.Unit.HERO,
+                team_id=u.team,
+                player_id=u.player_id,
+                x=u.x,
+                y=u.y,
+                health=max(u.hp, 0.0),
+                health_max=u.hp_max,
+                health_regen=2.0,
+                mana=300.0,
+                mana_max=300.0,
+                attack_damage=_HERO_DMG,
+                attack_range=_HERO_RANGE,
+                speed=_HERO_SPEED,
+                is_alive=u.alive,
+                level=1 + stats["xp"] // 240,
+                gold=stats["gold"],
+                xp=stats["xp"],
+                last_hits=stats.get("last_hits", 0),
+                denies=stats.get("denies", 0),
+                kills=stats["kills"],
+                deaths=stats["deaths"],
+            )
+            del p  # fields set via add()
+        for c in self.creeps:
+            w.units.add(
+                handle=c.handle,
+                unit_type=ws.Unit.LANE_CREEP,
+                team_id=c.team,
+                x=c.x,
+                y=c.y,
+                health=max(c.hp, 0.0),
+                health_max=c.hp_max,
+                attack_damage=_CREEP_DMG,
+                attack_range=120.0,
+                speed=325.0,
+                is_alive=c.alive,
+            )
+        return w
+
+
+class FakeDotaService(DotaServiceServicer):
+    """gRPC servicer wrapping LastHitLaneGame.
+
+    Matches the reference dotaservice loop semantics (SURVEY.md §3.1):
+    `reset` starts a fresh game and returns the first observation;
+    `act` queues the hero's action; `observe` advances one observation
+    interval and returns the new worldstate (EPISODE_DONE once ended).
+    Trace replay (feeding recorded real-game protos) plugs in here later
+    by swapping LastHitLaneGame for a trace reader.
+    """
+
+    _MAX_SESSIONS = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # One independent game per gRPC peer, so N actors can share one
+        # fake server without interleaving each other's episodes (the real
+        # dotaservice is one-game-per-instance; peers emulate instances).
+        self._games: Dict[str, LastHitLaneGame] = {}
+
+    @staticmethod
+    def _key(context) -> str:
+        return context.peer() if context is not None else "local"
+
+    def reset(self, request: ds.GameConfig, context=None) -> ds.Observation:
+        with self._lock:
+            if len(self._games) >= self._MAX_SESSIONS:
+                self._games.pop(next(iter(self._games)))
+            game = LastHitLaneGame(request)
+            self._games[self._key(context)] = game
+            return ds.Observation(
+                status=ds.Observation.OK,
+                world_state=game.worldstate(TEAM_RADIANT),
+                team_id=TEAM_RADIANT,
+            )
+
+    def observe(self, request: ds.ObserveRequest, context=None) -> ds.Observation:
+        team = request.team_id or TEAM_RADIANT
+        with self._lock:
+            game = self._games.get(self._key(context))
+            if game is None:
+                return ds.Observation(status=ds.Observation.RESOURCE_EXHAUSTED)
+            game.step()
+            status = ds.Observation.EPISODE_DONE if game.winning_team else ds.Observation.OK
+            return ds.Observation(status=status, world_state=game.worldstate(team), team_id=team)
+
+    def act(self, request: ds.Actions, context=None) -> ds.Empty:
+        with self._lock:
+            game = self._games.get(self._key(context))
+            if game is not None:
+                for a in request.actions:
+                    if a.player_id == 0:
+                        game.pending = a
+        return ds.Empty()
+
+
+def main(argv=None):
+    """Standalone fake env server: python -m dotaclient_tpu.env.fake_dotaservice"""
+    import argparse
+    import time
+
+    from dotaclient_tpu.env.service import serve
+
+    p = argparse.ArgumentParser(description="fake dotaservice (synthetic 1v1 lane MDP)")
+    p.add_argument("--port", type=int, default=13337)
+    args = p.parse_args(argv)
+    server, port = serve(FakeDotaService(), port=args.port)
+    print(f"fake dotaservice listening on 127.0.0.1:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        server.stop(0)
+
+
+if __name__ == "__main__":
+    main()

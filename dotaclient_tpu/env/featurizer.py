@@ -110,6 +110,16 @@ def _unit_row(u: ws.Unit, hero: ws.Unit, out: np.ndarray) -> None:
     out[15] = 1.0 if u.is_alive else 0.0
 
 
+def norm_gold(gold: float) -> float:
+    """Shared gold/net-worth normalization (features AND aux targets)."""
+    return math.log1p(max(gold, 0)) / 10.0
+
+
+def norm_last_hits(last_hits: float) -> float:
+    """Shared last-hit-count normalization (features AND aux targets)."""
+    return last_hits / 100.0
+
+
 def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     hp_max = max(h.health_max, 1.0)
     mana_max = max(h.mana_max, 1.0)
@@ -125,9 +135,9 @@ def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     out[9] = h.attack_damage / 200.0
     out[10] = h.attack_range / 1000.0
     out[11] = h.speed / 500.0
-    out[12] = math.log1p(max(h.gold, 0)) / 10.0
+    out[12] = norm_gold(h.gold)
     out[13] = math.log1p(max(h.xp, 0)) / 10.0
-    out[14] = h.last_hits / 100.0
+    out[14] = norm_last_hits(h.last_hits)
     out[15] = 1.0 if h.is_alive else 0.0
 
 

@@ -1,0 +1,114 @@
+"""Hand-written gRPC plumbing for the DotaService API.
+
+The reference imports protoc-generated `DotaService_pb2_grpc` stubs from
+the dotaservice pip package (SURVEY.md §1 L1). This image has no
+`grpc_tools`, so the equivalent stubs are written against grpc's generic
+handler API — same wire behavior (`/dotaclient_tpu.DotaService/<method>`
+unary-unary calls carrying the protos from dotaservice.proto), no
+generated code.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from dotaclient_tpu.protos import dotaservice_pb2 as ds
+
+SERVICE_NAME = "dotaclient_tpu.DotaService"
+
+_METHODS = {
+    # name: (request class, response class)
+    "reset": (ds.GameConfig, ds.Observation),
+    "observe": (ds.ObserveRequest, ds.Observation),
+    "act": (ds.Actions, ds.Empty),
+}
+
+
+class DotaServiceServicer:
+    """Subclass and override; mirrors the reference's servicer surface."""
+
+    def reset(self, request: ds.GameConfig, context) -> ds.Observation:
+        raise NotImplementedError
+
+    def observe(self, request: ds.ObserveRequest, context) -> ds.Observation:
+        raise NotImplementedError
+
+    def act(self, request: ds.Actions, context) -> ds.Empty:
+        raise NotImplementedError
+
+
+def add_servicer_to_server(servicer: DotaServiceServicer, server: grpc.Server) -> None:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
+        for name, (req, resp) in _METHODS.items()
+    }
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+
+
+def serve(servicer: DotaServiceServicer, port: int = 0, max_workers: int = 4):
+    """Start an insecure server; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_servicer_to_server(servicer, server)
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+class DotaServiceStub:
+    """Sync client stub (tests, tools)."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (req, resp) in _METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                ),
+            )
+
+
+class AsyncDotaServiceStub:
+    """grpc.aio client stub — what the asyncio actor loop uses."""
+
+    def __init__(self, channel: "grpc.aio.Channel"):
+        for name, (req, resp) in _METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                ),
+            )
+
+
+_uid = 0
+
+
+def _unique_options():
+    """gRPC fuses channels to the same target onto one shared TCP
+    connection; a distinct channel arg forces a private connection so the
+    server sees a distinct peer per client (the fake env keys sessions by
+    peer)."""
+    global _uid
+    _uid += 1
+    return [("dotaclient.channel_uid", _uid)]
+
+
+def connect(addr: str) -> DotaServiceStub:
+    return DotaServiceStub(grpc.insecure_channel(addr, options=_unique_options()))
+
+
+def connect_async(addr: str) -> AsyncDotaServiceStub:
+    return AsyncDotaServiceStub(grpc.aio.insecure_channel(addr, options=_unique_options()))

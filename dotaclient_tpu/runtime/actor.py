@@ -1,0 +1,309 @@
+"""Asyncio actor loop — the re-design of the reference's agent.py
+(SURVEY.md §2 "Actor loop", §3.1 call stack).
+
+Per-step hot loop, exactly the reference's shape: observe() over gRPC →
+featurize → policy step with carried LSTM state → mask/sample →
+act() over gRPC → shaped reward from worldstate deltas → append to the
+rollout chunk; every `rollout_len` steps (or at episode end) the chunk
+ships to the broker with the chunk-start LSTM state and the model
+version; fresh weights hot-swap in from the weight fanout at chunk
+boundaries.
+
+TPU-first differences from the reference:
+- inference is ONE jit-compiled function (featurized obs + LSTM state +
+  rng → action ints, log-prob, value, new state) — sampling happens
+  inside jit so no logits ever cross the host boundary;
+- the actor initializes params deterministically from the same seed as
+  the learner, so it can act from step zero without waiting for the
+  first weight broadcast (the reference downloads a pretrained
+  state_dict or waits);
+- rollouts go out in the pickle-free wire format (transport/serialize).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dotaclient_tpu.config import ActorConfig
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env import rewards as R
+from dotaclient_tpu.env.service import AsyncDotaServiceStub, connect_async
+from dotaclient_tpu.models import policy as P
+from dotaclient_tpu.ops import action_dist as ad
+from dotaclient_tpu.protos import dotaservice_pb2 as ds
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.serialize import (
+    Rollout,
+    RolloutAux,
+    deserialize_weights,
+    serialize_rollout,
+    unflatten_params,
+)
+
+_log = logging.getLogger(__name__)
+
+
+def make_actor_step(cfg: ActorConfig):
+    """jit'd single-step inference: sampling stays on device."""
+    net = P.PolicyNet(cfg.policy)
+
+    @jax.jit
+    def step(params, state, obs, rng):
+        new_state, out = net.apply(params, state, obs)
+        action = ad.sample(rng, out.dist)
+        logp = ad.log_prob(out.dist, action)
+        return new_state, action, logp, out.value
+
+    return step
+
+
+def build_actions_proto(
+    cfg: ActorConfig,
+    action: ad.Action,
+    handles: np.ndarray,
+    hero: Optional[ws.Unit],
+    team_id: int,
+    player_id: int,
+    dota_time: float,
+) -> ds.Actions:
+    """Map sampled head indices back to a concrete Actions proto."""
+    a = ds.Action(player_id=player_id)
+    atype = int(action.type[0])
+    if atype == F.ACT_MOVE and hero is not None:
+        n = cfg.policy.n_move_bins
+        grid = (np.arange(n) - n // 2) / max(n // 2, 1)
+        a.type = ds.Action.MOVE
+        a.move_x = hero.x + float(grid[int(action.move_x[0])]) * cfg.policy.move_step
+        a.move_y = hero.y + float(grid[int(action.move_y[0])]) * cfg.policy.move_step
+    elif atype == F.ACT_ATTACK:
+        a.type = ds.Action.ATTACK
+        a.target_handle = int(handles[int(action.target[0])])
+    elif atype == F.ACT_CAST:
+        a.type = ds.Action.CAST
+        a.ability_slot = 0
+        a.target_handle = int(handles[int(action.target[0])])
+    else:
+        a.type = ds.Action.NOOP
+    return ds.Actions(actions=[a], team_id=team_id, dota_time=dota_time)
+
+
+class _Chunk:
+    """Accumulates one rollout chunk between broker publishes."""
+
+    def __init__(self, initial_state: Tuple[np.ndarray, np.ndarray]):
+        self.initial_state = (np.asarray(initial_state[0][0]), np.asarray(initial_state[1][0]))
+        self.obs: List[F.Observation] = []
+        self.actions: List[ad.Action] = []
+        self.logp: List[float] = []
+        self.value: List[float] = []
+        self.rewards: List[float] = []
+        self.dones: List[float] = []
+        self.aux_lh: List[float] = []
+        self.aux_nw: List[float] = []
+
+    def __len__(self) -> int:
+        return len(self.actions)
+
+    def to_rollout(
+        self,
+        bootstrap_obs: F.Observation,
+        version: int,
+        actor_id: int,
+        episode_return: float,
+        win: float,
+        with_aux: bool,
+    ) -> Rollout:
+        L = len(self)
+        obs = F.stack(self.obs + [bootstrap_obs])
+        acts = ad.Action(
+            type=np.asarray([int(a.type[0]) for a in self.actions], np.int32),
+            move_x=np.asarray([int(a.move_x[0]) for a in self.actions], np.int32),
+            move_y=np.asarray([int(a.move_y[0]) for a in self.actions], np.int32),
+            target=np.asarray([int(a.target[0]) for a in self.actions], np.int32),
+        )
+        aux = None
+        if with_aux:
+            aux = RolloutAux(
+                win=np.full(L, win, np.float32),
+                last_hit=np.asarray(self.aux_lh, np.float32),
+                net_worth=np.asarray(self.aux_nw, np.float32),
+            )
+        return Rollout(
+            obs=obs,
+            actions=acts,
+            behavior_logp=np.asarray(self.logp, np.float32),
+            behavior_value=np.asarray(self.value, np.float32),
+            rewards=np.asarray(self.rewards, np.float32),
+            dones=np.asarray(self.dones, np.float32),
+            initial_state=self.initial_state,
+            version=version,
+            actor_id=actor_id,
+            episode_return=episode_return,
+            aux=aux,
+        )
+
+
+class Actor:
+    """One self-play actor process (player_id 0 on team radiant)."""
+
+    def __init__(
+        self,
+        cfg: ActorConfig,
+        broker: Broker,
+        actor_id: int = 0,
+        stub: Optional[AsyncDotaServiceStub] = None,
+    ):
+        self.cfg = cfg
+        self.broker = broker
+        self.actor_id = actor_id
+        # grpc.aio channels bind to the running event loop — create lazily
+        # inside run_episode, not here (__init__ runs outside the loop).
+        self._stub = stub
+        self.params = P.init_params(cfg.policy, jax.random.PRNGKey(cfg.seed))
+        self.version = 0
+        self.step_fn = make_actor_step(cfg)
+        self.rng = jax.random.PRNGKey(cfg.seed * 9973 + actor_id)
+        # all host-side randomness (per-episode env seeds) flows from here,
+        # so identical --seed/--actor_id replays identical episode sequences
+        self.np_rng = np.random.RandomState(cfg.seed * 1000003 + actor_id)
+        self.player_id = 0
+        self.team_id = 2
+        self.steps_done = 0
+        self.episodes_done = 0
+        self.rollouts_published = 0
+
+    # ------------------------------------------------------------- weights
+
+    def maybe_update_weights(self) -> bool:
+        frame = self.broker.poll_weights()
+        if frame is None:
+            return False
+        try:
+            named, version = deserialize_weights(frame)
+            self.params = unflatten_params(named, self.params)
+            self.version = version
+            return True
+        except Exception as e:  # truncated frames raise struct.error etc. —
+            # a bad broadcast must never kill the actor
+            _log.warning("actor %d: bad weight frame: %s", self.actor_id, e)
+            return False
+
+    # ------------------------------------------------------------- episode
+
+    @property
+    def stub(self) -> AsyncDotaServiceStub:
+        if self._stub is None:
+            self._stub = connect_async(self.cfg.env_addr)
+        return self._stub
+
+    async def run_episode(self) -> float:
+        cfg = self.cfg
+        config = ds.GameConfig(
+            host_timescale=cfg.host_timescale,
+            ticks_per_observation=cfg.ticks_per_observation,
+            max_dota_time=cfg.max_dota_time,
+            seed=self.np_rng.randint(1 << 30),
+            hero_picks=[
+                ds.HeroPick(team_id=2, hero_name=cfg.hero, control_mode=1),
+                ds.HeroPick(team_id=3, hero_name=cfg.hero, control_mode=0 if cfg.opponent == "scripted" else 1),
+            ],
+        )
+        resp = await self.stub.reset(config)
+        world = resp.world_state
+        state = P.initial_state(cfg.policy, (1,))
+        chunk = _Chunk(state)
+        last_hero: Optional[ws.Unit] = None
+        episode_return = 0.0
+        done = False
+        # each worldstate is featurized exactly once; the pair rolls forward
+        obs, handles = F.featurize_with_handles(world, self.player_id)
+
+        while not done:
+            obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
+            self.rng, key = jax.random.split(self.rng)
+            state, action, logp, value = self.step_fn(self.params, state, obs_b, key)
+
+            hero = F.find_hero(world, self.player_id)
+            if hero is not None:
+                snap = ws.Unit()
+                snap.CopyFrom(hero)
+                last_hero = snap
+            await self.stub.act(
+                build_actions_proto(cfg, jax.device_get(action), handles, hero, self.team_id, self.player_id, world.dota_time)
+            )
+            resp = await self.stub.observe(ds.ObserveRequest(team_id=self.team_id))
+            next_world = resp.world_state
+            next_obs, next_handles = F.featurize_with_handles(next_world, self.player_id)
+            done = resp.status == ds.Observation.EPISODE_DONE
+            r = R.reward(world, next_world, self.player_id, last_hero)
+            episode_return += r
+
+            chunk.obs.append(obs)
+            chunk.actions.append(jax.device_get(action))
+            chunk.logp.append(float(logp[0]))
+            chunk.value.append(float(value[0]))
+            chunk.rewards.append(r)
+            chunk.dones.append(1.0 if done else 0.0)
+            if cfg.policy.aux_heads:
+                chunk.aux_lh.append(F.norm_last_hits(hero.last_hits) if hero else 0.0)
+                chunk.aux_nw.append(F.norm_gold(hero.gold) if hero else 0.0)
+            self.steps_done += 1
+
+            if len(chunk) >= cfg.rollout_len or done:
+                win = 0.0
+                if done and next_world.winning_team:
+                    win = 1.0 if next_world.winning_team == self.team_id else -1.0
+                rollout = chunk.to_rollout(
+                    next_obs,
+                    self.version,
+                    self.actor_id,
+                    episode_return if done else 0.0,
+                    win,
+                    cfg.policy.aux_heads,
+                )
+                self.broker.publish_experience(serialize_rollout(rollout))
+                self.rollouts_published += 1
+                chunk = _Chunk(state)
+                self.maybe_update_weights()
+
+            world = next_world
+            obs, handles = next_obs, next_handles
+
+        self.episodes_done += 1
+        return episode_return
+
+    async def run(self, num_episodes: Optional[int] = None) -> None:
+        while num_episodes is None or self.episodes_done < num_episodes:
+            ret = await self.run_episode()
+            _log.info(
+                "actor %d: episode %d return %.2f (version %d, %d steps)",
+                self.actor_id,
+                self.episodes_done,
+                ret,
+                self.version,
+                self.steps_done,
+            )
+
+
+def main(argv=None):
+    from dotaclient_tpu.config import parse_config
+    from dotaclient_tpu.transport.base import connect as broker_connect
+
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_config(ActorConfig(), argv)
+    if cfg.platform:
+        jax.config.update("jax_platforms", cfg.platform)
+    broker = broker_connect(cfg.broker_url)
+    actor = Actor(cfg, broker, actor_id=cfg.actor_id)
+    asyncio.run(actor.run())
+
+
+if __name__ == "__main__":
+    main()

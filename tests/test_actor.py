@@ -1,0 +1,111 @@
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import ActorConfig, PolicyConfig
+from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+from dotaclient_tpu.env.service import connect_async, serve
+from dotaclient_tpu.models.policy import init_params
+from dotaclient_tpu.runtime.actor import Actor
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect as broker_connect
+from dotaclient_tpu.transport.serialize import (
+    deserialize_rollout,
+    flatten_params,
+    serialize_weights,
+)
+
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+@pytest.fixture()
+def env():
+    server, port = serve(FakeDotaService())
+    yield f"127.0.0.1:{port}"
+    server.stop(0)
+
+
+def make_actor(env_addr, broker_name, **kw):
+    mem.reset(broker_name)
+    cfg = ActorConfig(
+        env_addr=env_addr,
+        rollout_len=8,
+        max_dota_time=30.0,
+        policy=SMALL,
+        seed=1,
+        **kw,
+    )
+    broker = broker_connect(f"mem://{broker_name}")
+    actor = Actor(cfg, broker_connect(f"mem://{broker_name}"), actor_id=3)
+    return actor, broker, cfg
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_actor_episode_publishes_valid_rollouts(env):
+    actor, broker, cfg = make_actor(env, "actor_t1")
+    ret = run(actor.run_episode())
+    assert actor.rollouts_published >= 1
+    frames = broker.consume_experience(1000, timeout=0.2)
+    assert len(frames) == actor.rollouts_published
+    lengths = []
+    for f in frames:
+        r = deserialize_rollout(f)
+        assert r.actor_id == 3
+        assert r.version == 0
+        assert 1 <= r.length <= cfg.rollout_len
+        assert r.obs.global_feats.shape[0] == r.length + 1
+        assert np.isfinite(r.behavior_logp).all()
+        assert np.isfinite(r.rewards).all()
+        lengths.append(r.length)
+    # last chunk carries the terminal done and the episode return
+    last = deserialize_rollout(frames[-1])
+    assert last.dones[-1] == 1.0
+    assert abs(last.episode_return - ret) < 1e-4
+    # all chunks before the last are full-length
+    assert all(l == cfg.rollout_len for l in lengths[:-1])
+    # intermediate chunks are not marked done
+    for f in frames[:-1]:
+        assert deserialize_rollout(f).dones[-1] == 0.0
+
+
+def test_actor_hot_swaps_weights(env):
+    actor, broker, cfg = make_actor(env, "actor_t2")
+    new_params = init_params(cfg.policy, jax.random.PRNGKey(99))
+    broker.publish_weights(serialize_weights(flatten_params(new_params), version=17))
+    run(actor.run_episode())
+    assert actor.version == 17
+    for a, b in zip(jax.tree.leaves(actor.params), jax.tree.leaves(new_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # chunks published after the swap carry the new version
+    frames = broker.consume_experience(1000, timeout=0.2)
+    versions = [deserialize_rollout(f).version for f in frames]
+    assert versions[-1] == 17
+
+
+def test_actor_aux_targets(env):
+    actor, broker, cfg = make_actor(env, "actor_t3")
+    actor.cfg.policy = PolicyConfig(
+        unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32", aux_heads=True
+    )
+    actor.params = init_params(actor.cfg.policy, jax.random.PRNGKey(1))
+    from dotaclient_tpu.runtime.actor import make_actor_step
+
+    actor.step_fn = make_actor_step(actor.cfg)
+    run(actor.run_episode())
+    frames = broker.consume_experience(1000, timeout=0.2)
+    last = deserialize_rollout(frames[-1])
+    assert last.aux is not None
+    assert set(np.unique(last.aux.win)) <= {-1.0, 0.0, 1.0}
+    assert (last.aux.win != 0).all()  # final chunk knows the result
+
+
+def test_actor_multi_episode_counts(env):
+    actor, broker, cfg = make_actor(env, "actor_t4")
+    run(actor.run(num_episodes=2))
+    assert actor.episodes_done == 2
+    assert actor.steps_done > 0
