@@ -1,0 +1,152 @@
+"""Benchmark: end-to-end PPO learner throughput (host pipeline + TPU step).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Metric of record (BASELINE.md): learner env-steps/sec. This measures the
+FULL learner path — broker consume → deserialize → staleness filter →
+pack/pad → device_put (dp-sharded) → compiled SPMD PPO train step — fed
+by an in-process producer republishing pre-serialized rollout frames, at
+the flagship configuration (128-hidden LSTM policy, bf16 compute, batch
+256 × seq 16). The device-only step rate is reported inside `unit` for
+context; the headline value is the end-to-end rate, which is what
+saturating actors could actually achieve against this learner host.
+
+Baseline: 50k aggregate env-steps/sec on a v5e-8 (north star), scaled to
+the visible chip count (50k/8 per chip).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+
+from dotaclient_tpu.config import LearnerConfig
+from dotaclient_tpu.parallel import mesh as mesh_lib
+from dotaclient_tpu.parallel.train_step import (
+    build_train_step,
+    init_train_state,
+    make_train_batch,
+)
+from dotaclient_tpu.runtime.staging import StagingBuffer
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect
+
+BASELINE_AGGREGATE = 50_000.0  # env-steps/sec on a v5e-8 (BASELINE.md)
+BASELINE_PER_CHIP = BASELINE_AGGREGATE / 8.0
+
+
+def _make_frames(cfg: LearnerConfig, n_frames: int):
+    """Pre-serialized realistic rollout frames (length = seq_len)."""
+    from dotaclient_tpu.ops.batch import TrainBatch  # noqa: F401
+    from dotaclient_tpu.transport.serialize import Rollout, serialize_rollout
+    from dotaclient_tpu.env import featurizer as F
+    from dotaclient_tpu.ops.action_dist import Action
+
+    frames = []
+    T = cfg.seq_len
+    H = cfg.policy.lstm_hidden
+    r = np.random.RandomState(0)
+    for i in range(n_frames):
+        T1 = T + 1
+        obs = F.Observation(
+            global_feats=r.randn(T1, F.GLOBAL_FEATURES).astype(np.float32),
+            hero_feats=r.randn(T1, F.HERO_FEATURES).astype(np.float32),
+            unit_feats=r.randn(T1, F.MAX_UNITS, F.UNIT_FEATURES).astype(np.float32),
+            unit_mask=r.rand(T1, F.MAX_UNITS) < 0.6,
+            target_mask=r.rand(T1, F.MAX_UNITS) < 0.3,
+            action_mask=np.ones((T1, F.N_ACTION_TYPES), bool),
+        )
+        rollout = Rollout(
+            obs=obs,
+            actions=Action(
+                type=r.randint(0, 2, T).astype(np.int32),
+                move_x=r.randint(0, 9, T).astype(np.int32),
+                move_y=r.randint(0, 9, T).astype(np.int32),
+                target=np.zeros(T, np.int32),
+            ),
+            behavior_logp=(-1.5 + 0.1 * r.randn(T)).astype(np.float32),
+            behavior_value=r.randn(T).astype(np.float32) * 0.1,
+            rewards=(r.randn(T) * 0.1).astype(np.float32),
+            dones=np.zeros(T, np.float32),
+            initial_state=(np.zeros(H, np.float32), np.zeros(H, np.float32)),
+            version=0,
+            actor_id=i,
+        )
+        frames.append(serialize_rollout(rollout))
+    return frames
+
+
+def main() -> None:
+    n_dev = len(jax.devices())
+    cfg = LearnerConfig(batch_size=256, seq_len=16, mesh_shape="dp=-1")
+    mesh = mesh_lib.make_mesh(cfg.mesh_shape)
+    train_step, state_sh, batch_sh = build_train_step(cfg, mesh)
+    state = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh)
+
+    # ---- device-only rate (context): pre-packed batch, no host pipeline
+    batch = jax.device_put(jax.tree.map(np.asarray, make_train_batch(cfg, 0)), batch_sh)
+    state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(20):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    device_rate = cfg.batch_size * cfg.seq_len * 20 / (time.perf_counter() - t0)
+
+    # ---- end-to-end rate: producer thread → broker → staging → device
+    mem.reset("bench")
+    producer_conn = connect("mem://bench", maxlen=cfg.batch_size * 4)
+    frames = _make_frames(cfg, 512)
+    stop = threading.Event()
+
+    def producer():
+        i = 0
+        while not stop.is_set():
+            producer_conn.publish_experience(frames[i % len(frames)])
+            i += 1
+
+    staging = StagingBuffer(cfg, connect("mem://bench"), version_fn=lambda: 0).start()
+    threads = [threading.Thread(target=producer, daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+
+    n_iters = 12
+    warm = staging.get_batch(timeout=120.0)  # first batch out of the pipe
+    state, metrics = train_step(state, jax.device_put(warm, batch_sh))
+    jax.block_until_ready(metrics["loss"])
+    env_steps = 0
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        b = staging.get_batch(timeout=120.0)
+        env_steps += int(np.sum(b.mask))
+        state, metrics = train_step(state, jax.device_put(b, batch_sh))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+    stop.set()
+    staging.stop()
+
+    e2e_rate = env_steps / dt
+    baseline = BASELINE_PER_CHIP * n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_learner_env_steps_per_sec",
+                "value": round(e2e_rate, 1),
+                "unit": (
+                    f"env-steps/sec end-to-end ({n_dev} chip(s), batch "
+                    f"{cfg.batch_size}x{cfg.seq_len}; device-step-only rate "
+                    f"{round(device_rate, 1)})"
+                ),
+                "vs_baseline": round(e2e_rate / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

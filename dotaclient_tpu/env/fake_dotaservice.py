@@ -87,8 +87,11 @@ class LastHitLaneGame:
         self.creeps: list[_Unit] = []
         self.stats = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
         self.enemy_stats = {"xp": 0, "gold": 600, "last_hits": 0, "kills": 0, "deaths": 0}
+        self._xp_trickle = 0.0
         # pending action for the controlled hero, applied on next step
         self.pending: Optional[ds.Action] = None
+        # per-game lock so N peers step their games concurrently
+        self.lock = threading.Lock()
         self._maybe_spawn_wave()
 
     # ------------------------------------------------------------- stepping
@@ -186,7 +189,13 @@ class LastHitLaneGame:
             if u.alive:
                 u.hp = min(u.hp + 4.0 * dt, u.hp_max)
         # passive xp trickle so standing safely far away is weakly positive
-        self.stats["xp"] += int(2 * dt)
+        # (float-accumulated so the rate survives any dt, then credited in
+        # whole points since the proto field is integral)
+        self._xp_trickle += 2.0 * dt
+        whole = int(self._xp_trickle)
+        if whole:
+            self.stats["xp"] += whole
+            self._xp_trickle -= whole
 
     def _check_end(self) -> None:
         if not self.hero.alive:
@@ -302,12 +311,24 @@ class FakeDotaService(DotaServiceServicer):
     def _key(context) -> str:
         return context.peer() if context is not None else "local"
 
+    def _evict_if_full(self) -> None:
+        """Prefer evicting finished games; fall back to the oldest. Reconnects
+        change a client's peer key, so finished/abandoned sessions accumulate
+        and must be reclaimable without destroying someone's live game."""
+        if len(self._games) < self._MAX_SESSIONS:
+            return
+        for key, game in self._games.items():
+            if game.winning_team:
+                self._games.pop(key)
+                return
+        self._games.pop(next(iter(self._games)))
+
     def reset(self, request: ds.GameConfig, context=None) -> ds.Observation:
+        game = LastHitLaneGame(request)
         with self._lock:
-            if len(self._games) >= self._MAX_SESSIONS:
-                self._games.pop(next(iter(self._games)))
-            game = LastHitLaneGame(request)
+            self._evict_if_full()
             self._games[self._key(context)] = game
+        with game.lock:
             return ds.Observation(
                 status=ds.Observation.OK,
                 world_state=game.worldstate(TEAM_RADIANT),
@@ -318,8 +339,9 @@ class FakeDotaService(DotaServiceServicer):
         team = request.team_id or TEAM_RADIANT
         with self._lock:
             game = self._games.get(self._key(context))
-            if game is None:
-                return ds.Observation(status=ds.Observation.RESOURCE_EXHAUSTED)
+        if game is None:
+            return ds.Observation(status=ds.Observation.RESOURCE_EXHAUSTED)
+        with game.lock:  # games step concurrently; only the dict is global
             game.step()
             status = ds.Observation.EPISODE_DONE if game.winning_team else ds.Observation.OK
             return ds.Observation(status=status, world_state=game.worldstate(team), team_id=team)
@@ -327,7 +349,8 @@ class FakeDotaService(DotaServiceServicer):
     def act(self, request: ds.Actions, context=None) -> ds.Empty:
         with self._lock:
             game = self._games.get(self._key(context))
-            if game is not None:
+        if game is not None:
+            with game.lock:
                 for a in request.actions:
                     if a.player_id == 0:
                         game.pending = a

@@ -62,9 +62,11 @@ def serve(servicer: DotaServiceServicer, port: int = 0, max_workers: int = 4):
 
 
 class DotaServiceStub:
-    """Sync client stub (tests, tools)."""
+    """Client stub; works over a sync channel (tests, tools) or a
+    grpc.aio channel (the asyncio actor loop) — unary_unary has the same
+    construction signature on both."""
 
-    def __init__(self, channel: grpc.Channel):
+    def __init__(self, channel):
         for name, (req, resp) in _METHODS.items():
             setattr(
                 self,
@@ -77,20 +79,8 @@ class DotaServiceStub:
             )
 
 
-class AsyncDotaServiceStub:
-    """grpc.aio client stub — what the asyncio actor loop uses."""
-
-    def __init__(self, channel: "grpc.aio.Channel"):
-        for name, (req, resp) in _METHODS.items():
-            setattr(
-                self,
-                name,
-                channel.unary_unary(
-                    f"/{SERVICE_NAME}/{name}",
-                    request_serializer=req.SerializeToString,
-                    response_deserializer=resp.FromString,
-                ),
-            )
+# Same class serves both channel kinds; alias kept for call-site clarity.
+AsyncDotaServiceStub = DotaServiceStub
 
 
 _uid = 0
@@ -110,5 +100,5 @@ def connect(addr: str) -> DotaServiceStub:
     return DotaServiceStub(grpc.insecure_channel(addr, options=_unique_options()))
 
 
-def connect_async(addr: str) -> AsyncDotaServiceStub:
-    return AsyncDotaServiceStub(grpc.aio.insecure_channel(addr, options=_unique_options()))
+def connect_async(addr: str) -> DotaServiceStub:
+    return DotaServiceStub(grpc.aio.insecure_channel(addr, options=_unique_options()))

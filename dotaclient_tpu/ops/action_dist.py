@@ -24,7 +24,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from dotaclient_tpu.env.featurizer import ACT_ATTACK, ACT_MOVE
+from dotaclient_tpu.env.featurizer import ACT_ATTACK, ACT_CAST, ACT_MOVE
 
 BIG_NEG = -1e9
 
@@ -89,12 +89,13 @@ def mode(dist: Dist) -> Action:
 
 def log_prob(dist: Dist, action: Action) -> jnp.ndarray:
     """Joint log-prob: type head always; move grids only under MOVE;
-    target head only under ATTACK."""
+    target head under ATTACK and CAST (both are unit-targeted — the cast
+    target must be visible to PPO or the gradient can never credit it)."""
     lp = _gather(dist.type_logp, action.type)
     is_move = (action.type == ACT_MOVE).astype(lp.dtype)
-    is_attack = (action.type == ACT_ATTACK).astype(lp.dtype)
+    is_targeted = ((action.type == ACT_ATTACK) | (action.type == ACT_CAST)).astype(lp.dtype)
     lp += is_move * (_gather(dist.move_x_logp, action.move_x) + _gather(dist.move_y_logp, action.move_y))
-    lp += is_attack * _gather(dist.target_logp, action.target)
+    lp += is_targeted * _gather(dist.target_logp, action.target)
     return lp
 
 
@@ -103,5 +104,5 @@ def entropy(dist: Dist) -> jnp.ndarray:
     p = jnp.exp(dist.type_logp)
     h = _entropy(dist.type_logp)
     h += p[..., ACT_MOVE] * (_entropy(dist.move_x_logp) + _entropy(dist.move_y_logp))
-    h += p[..., ACT_ATTACK] * _entropy(dist.target_logp)
+    h += (p[..., ACT_ATTACK] + p[..., ACT_CAST]) * _entropy(dist.target_logp)
     return h

@@ -54,6 +54,12 @@ def build_train_step(cfg: LearnerConfig, mesh):
     `train_step(state, batch) -> (state', metrics)` is jit-compiled with
     explicit in/out shardings over `mesh`.
     """
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+    if cfg.batch_size % max(dp, 1):
+        raise ValueError(
+            f"batch_size={cfg.batch_size} must be divisible by the mesh dp "
+            f"axis ({dp}); adjust --batch_size or --mesh_shape"
+        )
     net = PolicyNet(cfg.policy)
     opt = make_optimizer(cfg)
 

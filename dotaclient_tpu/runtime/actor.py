@@ -239,6 +239,12 @@ class Actor:
                 build_actions_proto(cfg, jax.device_get(action), handles, hero, self.team_id, self.player_id, world.dota_time)
             )
             resp = await self.stub.observe(ds.ObserveRequest(team_id=self.team_id))
+            if resp.status == ds.Observation.RESOURCE_EXHAUSTED:
+                # session lost (server restart/eviction): abandon the episode
+                # and the partial chunk instead of publishing garbage steps
+                _log.warning("actor %d: env session lost; abandoning episode", self.actor_id)
+                self.episodes_done += 1
+                return episode_return
             next_world = resp.world_state
             next_obs, next_handles = F.featurize_with_handles(next_world, self.player_id)
             done = resp.status == ds.Observation.EPISODE_DONE

@@ -1,0 +1,46 @@
+"""Durable checkpoint/resume via orbax.
+
+The reference uploads `model_%09d.pt` state_dicts to GCS and resumes via
+a --pretrained flag (SURVEY.md §5 "Checkpoint / resume"). Here the full
+TrainState (params + optimizer state + step/version counter) goes
+through an orbax CheckpointManager, so a learner restart resumes
+training exactly — including Adam moments — not just the policy. The
+directory can be local or a gcs:// path (orbax handles both); actors
+never read checkpoints, they get weights over the broker fanout.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from etils import epath
+import orbax.checkpoint as ocp
+
+_log = logging.getLogger(__name__)
+
+
+class Checkpointer:
+    def __init__(self, directory: str, max_to_keep: int = 5):
+        self._mngr = ocp.CheckpointManager(
+            epath.Path(directory),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, state, step: int, wait: bool = False) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def restore_latest(self, template) -> Optional[object]:
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()

@@ -1,0 +1,141 @@
+"""Learner loop — the re-design of the reference's optimizer.py
+(SURVEY.md §2 "Learner", §3.2 call stack).
+
+Reference flow per iteration: consume N rollouts → pad/stack →
+teacher-forced re-eval → GAE → PPO step → publish versioned weights →
+checkpoint → TensorBoard. Here the device-side middle is ONE compiled
+SPMD program over the mesh (parallel/train_step.py) and the host side
+is the staging buffer (runtime/staging.py); this module owns the loop:
+
+    staging.get_batch → device_put(dp-sharded) → train_step
+    → every publish_every steps: device_get params → weight fanout
+    → every checkpoint_every steps: orbax checkpoint
+    → metrics (reference scalar names) + steps/s + staleness stats
+
+The python-side `version` counter mirrors state.step without forcing a
+device sync every iteration; it is the version actors stamp on their
+rollouts and the learner's staleness filter reads.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dotaclient_tpu.config import LearnerConfig
+from dotaclient_tpu.parallel import mesh as mesh_lib
+from dotaclient_tpu.parallel.train_step import (
+    TrainState,
+    build_train_step,
+    init_train_state,
+)
+from dotaclient_tpu.runtime.metrics import MetricsLogger
+from dotaclient_tpu.runtime.staging import StagingBuffer
+from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.serialize import flatten_params, serialize_weights
+
+_log = logging.getLogger(__name__)
+
+
+class Learner:
+    def __init__(self, cfg: LearnerConfig, broker: Broker, mesh=None):
+        self.cfg = cfg
+        self.broker = broker
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg.mesh_shape)
+        self.train_step, self.state_shardings, self.batch_sharding = build_train_step(cfg, self.mesh)
+        self.version = 0
+        state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
+        self.state: TrainState = jax.device_put(state, self.state_shardings)
+        self.staging = StagingBuffer(cfg, broker, version_fn=lambda: self.version)
+        self.metrics = MetricsLogger(cfg.log_dir)
+        self.checkpointer = None
+        if cfg.checkpoint_dir:
+            from dotaclient_tpu.runtime.checkpoint import Checkpointer
+
+            self.checkpointer = Checkpointer(cfg.checkpoint_dir)
+            restored = self.checkpointer.restore_latest(self.state)
+            if restored is not None:
+                self.state = jax.device_put(restored, self.state_shardings)
+                self.version = int(jax.device_get(restored.step))
+                _log.info("restored checkpoint at step %d", self.version)
+
+    # ---------------------------------------------------------------- ops
+
+    def publish_weights(self) -> None:
+        params = jax.device_get(self.state.params)
+        frame = serialize_weights(flatten_params(params), version=self.version)
+        self.broker.publish_weights(frame)
+
+    def checkpoint(self) -> None:
+        if self.checkpointer is not None:
+            self.checkpointer.save(jax.device_get(self.state), step=self.version)
+
+    # --------------------------------------------------------------- loop
+
+    def run(self, num_steps: Optional[int] = None, batch_timeout: float = 60.0) -> int:
+        """Train until num_steps (None = forever); returns steps done."""
+        cfg = self.cfg
+        self.staging.start()
+        self.publish_weights()  # version 0 so actors align immediately
+        env_steps_per_batch = None
+        done_steps = 0
+        t_last = time.perf_counter()
+        try:
+            while num_steps is None or done_steps < num_steps:
+                batch = self.staging.get_batch(timeout=batch_timeout)
+                if batch is None:
+                    _log.warning("no batch within %.0fs; waiting", batch_timeout)
+                    continue
+                if env_steps_per_batch is None:
+                    env_steps_per_batch = float(np.sum(batch.mask))
+                batch_dev = jax.device_put(batch, self.batch_sharding)
+                self.state, metrics = self.train_step(self.state, batch_dev)
+                self.version += 1
+                done_steps += 1
+
+                if self.version % cfg.publish_every == 0:
+                    self.publish_weights()
+                if self.checkpointer is not None and self.version % cfg.checkpoint_every == 0:
+                    self.checkpoint()
+
+                now = time.perf_counter()
+                scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                stats = self.staging.stats()
+                scalars["env_steps_per_sec"] = float(np.sum(batch.mask)) / max(now - t_last, 1e-9)
+                scalars["staleness_dropped"] = stats["dropped_stale"]
+                scalars["queue_ready"] = stats["ready_batches"]
+                scalars["episodes"] = stats["episodes"]
+                if stats["episodes"] > 0:
+                    scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
+                self.metrics.log(self.version, scalars)
+                t_last = now
+        finally:
+            self.staging.stop()
+            self.metrics.close()
+        return done_steps
+
+
+def main(argv=None):
+    from dotaclient_tpu.config import parse_config
+    from dotaclient_tpu.transport.base import connect as broker_connect
+
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_config(LearnerConfig(), argv)
+    broker = broker_connect(cfg.broker_url)
+    learner = Learner(cfg, broker)
+    _log.info(
+        "learner up: mesh=%s batch=%dx%d devices=%d",
+        cfg.mesh_shape,
+        cfg.batch_size,
+        cfg.seq_len,
+        len(jax.devices()),
+    )
+    learner.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Metrics/observability — TensorBoard + JSONL.
+
+The reference logs scalars through tensorboardX's SummaryWriter in
+optimizer.py (SURVEY.md §5 "Metrics"): losses, entropy, grad norm,
+reward components, steps/s, win rate. Scalar names are kept identical so
+training curves are directly comparable. The TB dependency is soft
+(torch's SummaryWriter if importable); a JSONL stream is always written
+so headless runs and tests can assert on metrics without TB.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: str = "", flush_every: int = 20):
+        self._tb = None
+        self._jsonl = None
+        self._flush_every = flush_every
+        self._writes = 0
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a", buffering=1)
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir)
+            except Exception:
+                self._tb = None
+
+    def log(self, step: int, scalars: Dict[str, float]) -> None:
+        if self._jsonl is not None:
+            rec = {"step": step, "time": time.time()}
+            rec.update({k: float(v) for k, v in scalars.items()})
+            self._jsonl.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, float(v), step)
+            self._writes += 1
+            if self._writes % self._flush_every == 0:
+                self._tb.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+            self._tb.close()
+        if self._jsonl is not None:
+            self._jsonl.close()

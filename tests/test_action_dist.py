@@ -68,7 +68,7 @@ def test_entropy_matches_numpy_oracle():
     expected = (
         H(t)
         + p[:, ACT_MOVE] * (H(np.asarray(dist.move_x_logp)) + H(np.asarray(dist.move_y_logp)))
-        + p[:, ACT_ATTACK] * H(np.asarray(dist.target_logp))
+        + (p[:, ACT_ATTACK] + p[:, 3]) * H(np.asarray(dist.target_logp))
     )
     np.testing.assert_allclose(h, expected, rtol=1e-5)
     assert (h > 0).all()
