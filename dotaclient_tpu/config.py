@@ -83,6 +83,17 @@ class ActorConfig:
     platform: str = "cpu"
 
 
+@dataclass
+class EvalConfig:
+    """Evaluator binary (eval/evaluator.py): plays frozen-policy episodes
+    vs the scripted bot on each fresh weight broadcast."""
+
+    actor: ActorConfig = field(default_factory=ActorConfig)
+    episodes: int = 16  # episodes per evaluation round
+    eval_every: int = 10  # learner versions between evaluations
+    log_dir: str = ""
+
+
 def _parse_bool(s: str) -> bool:
     low = s.lower()
     if low in ("1", "true", "yes", "on"):

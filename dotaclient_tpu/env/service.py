@@ -67,6 +67,7 @@ class DotaServiceStub:
     construction signature on both."""
 
     def __init__(self, channel):
+        self.channel = channel  # owners close it on teardown
         for name, (req, resp) in _METHODS.items():
             setattr(
                 self,

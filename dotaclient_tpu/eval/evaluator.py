@@ -1,0 +1,196 @@
+"""Evaluation: frozen-params win-rate + TrueSkill vs the scripted bot.
+
+The reference measures skill as win-rate / TrueSkill against Dota's
+built-in scripted bots, logged from the training loop (SURVEY.md §2
+"Eval / rating", §6 skill metric). Here evaluation is a standalone
+subscriber of the weight fanout — the same position an actor occupies in
+the architecture — so it never steals learner or actor cycles:
+
+    learner ──weights fanout──▶ evaluator ──gRPC──▶ env (scripted bot)
+                                     └─▶ metrics.jsonl / TensorBoard
+
+Library use (tests, league): `Evaluator.evaluate(params, n_episodes)`.
+Binary use: `python -m dotaclient_tpu.eval.evaluator --broker_url ...`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import List, Optional
+
+from dotaclient_tpu.config import ActorConfig
+from dotaclient_tpu.eval.rating import Rating, RatingTable
+from dotaclient_tpu.transport.base import Broker
+
+_log = logging.getLogger(__name__)
+
+
+class NullBroker(Broker):
+    """Drops experience, never yields weights — evaluation plays pure
+    episodes through the real actor loop without feeding the learner."""
+
+    def publish_experience(self, data: bytes) -> None:
+        pass
+
+    def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
+        return []
+
+    def publish_weights(self, data: bytes) -> None:
+        pass
+
+    def poll_weights(self) -> Optional[bytes]:
+        return None
+
+
+@dataclass
+class EvalResult:
+    version: int
+    episodes: int  # decided episodes (abandoned ones excluded)
+    wins: int
+    losses: int
+    draws: int
+    mean_return: float
+    rating: Rating
+    abandoned: int = 0
+
+    @property
+    def win_rate(self) -> float:
+        return self.wins / max(self.episodes, 1)
+
+    @property
+    def skill(self) -> float:
+        return self.rating.conservative
+
+
+class Evaluator:
+    """Plays frozen-policy episodes vs the scripted opponent and keeps a
+    TrueSkill table with the scripted bot anchored at the default rating
+    (a fixed yardstick — SURVEY.md §6 "TrueSkill above hard bot" means
+    the agent's conservative skill clears the anchor's)."""
+
+    SCRIPTED = "scripted"
+
+    def __init__(self, cfg: ActorConfig, name: str = "agent"):
+        from dotaclient_tpu.runtime.actor import Actor
+
+        self.cfg = cfg
+        self.name = name
+        self.table = RatingTable()
+        self.table.add(self.SCRIPTED, Rating(), anchored=True)
+        self.table.add(name)
+        # One persistent loop + actor so the jit cache and the gRPC channel
+        # survive across evaluate() calls (fresh loops would orphan the
+        # aio channel; fresh actors would recompile the step fn).
+        self._loop = asyncio.new_event_loop()
+        self._actor = Actor(cfg, NullBroker(), actor_id=10_000 + cfg.actor_id)
+
+    def evaluate(self, params, n_episodes: int = 10, version: int = 0) -> EvalResult:
+        actor = self._actor
+        actor.params = params
+        wins = losses = draws = 0
+        returns = []
+
+        abandoned = 0
+
+        async def run():
+            nonlocal wins, losses, draws, abandoned
+            for _ in range(n_episodes):
+                ret = await actor.run_episode()
+                if actor.last_win is None:
+                    abandoned += 1  # env session lost: no result, no return
+                    continue
+                returns.append(ret)
+                if actor.last_win > 0:
+                    wins += 1
+                    self.table.record(self.name, self.SCRIPTED)
+                elif actor.last_win < 0:
+                    losses += 1
+                    self.table.record(self.SCRIPTED, self.name)
+                else:  # decided draw (episode ended, no winning team)
+                    draws += 1
+                    self.table.record(self.name, self.SCRIPTED, draw=True)
+
+        self._loop.run_until_complete(run())
+        return EvalResult(
+            version=version,
+            episodes=n_episodes - abandoned,
+            wins=wins,
+            losses=losses,
+            draws=draws,
+            abandoned=abandoned,
+            mean_return=sum(returns) / max(len(returns), 1),
+            rating=self.table.get(self.name),
+        )
+
+    def close(self) -> None:
+        if self._actor._stub is not None:
+            # the aio channel's tasks are bound to our private loop — close
+            # it there, before the loop itself goes away
+            self._loop.run_until_complete(self._actor._stub.channel.close())
+        self._loop.close()
+
+
+def main(argv=None):
+    import time
+
+    import jax
+
+    from dotaclient_tpu.config import EvalConfig, parse_config
+    from dotaclient_tpu.runtime.metrics import MetricsLogger
+    from dotaclient_tpu.transport.base import connect as broker_connect
+    from dotaclient_tpu.transport.serialize import deserialize_weights, unflatten_params
+
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_config(EvalConfig(), argv)
+    if cfg.actor.platform:
+        jax.config.update("jax_platforms", cfg.actor.platform)
+    broker = broker_connect(cfg.actor.broker_url)
+    metrics = MetricsLogger(cfg.log_dir)
+    evaluator = Evaluator(cfg.actor)
+    params = evaluator._actor.params
+    last_eval = -cfg.eval_every  # evaluate version 0 immediately
+    version = 0
+    try:
+        while True:
+            frame = broker.poll_weights()
+            if frame is not None:
+                try:
+                    named, version = deserialize_weights(frame)
+                    params = unflatten_params(named, params)
+                except Exception as e:  # a bad broadcast must never kill
+                    # the evaluator (same stance as the actor's guard)
+                    _log.warning("bad weight frame: %s", e)
+            if version - last_eval >= cfg.eval_every:
+                res = evaluator.evaluate(params, n_episodes=cfg.episodes, version=version)
+                last_eval = version
+                metrics.log(
+                    version,
+                    {
+                        "win_rate": res.win_rate,
+                        "mean_eval_return": res.mean_return,
+                        "trueskill_mu": res.rating.mu,
+                        "trueskill_sigma": res.rating.sigma,
+                        "skill": res.skill,
+                    },
+                )
+                _log.info(
+                    "eval v%d: win_rate %.2f skill %.2f (mu %.2f ± %.2f)",
+                    version,
+                    res.win_rate,
+                    res.skill,
+                    res.rating.mu,
+                    res.rating.sigma,
+                )
+            else:
+                time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        metrics.close()
+        evaluator.close()
+
+
+if __name__ == "__main__":
+    main()

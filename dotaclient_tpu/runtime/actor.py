@@ -178,6 +178,11 @@ class Actor:
         self.steps_done = 0
         self.episodes_done = 0
         self.rollouts_published = 0
+        # ±1 result of the last finished episode, 0.0 for a decided draw
+        # (episode ended with no winning team), None while in flight or
+        # after an abandoned episode — read by the evaluator and the
+        # self-play league.
+        self.last_win: Optional[float] = None
 
     # ------------------------------------------------------------- weights
 
@@ -205,6 +210,7 @@ class Actor:
 
     async def run_episode(self) -> float:
         cfg = self.cfg
+        self.last_win = None
         config = ds.GameConfig(
             host_timescale=cfg.host_timescale,
             ticks_per_observation=cfg.ticks_per_observation,
@@ -266,6 +272,8 @@ class Actor:
                 win = 0.0
                 if done and next_world.winning_team:
                     win = 1.0 if next_world.winning_team == self.team_id else -1.0
+                if done:
+                    self.last_win = win
                 rollout = chunk.to_rollout(
                     next_obs,
                     self.version,

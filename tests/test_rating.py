@@ -1,0 +1,100 @@
+"""TrueSkill rating + evaluator tests (SURVEY.md §2 "Eval / rating")."""
+
+import math
+
+import pytest
+
+from dotaclient_tpu.eval.rating import (
+    BETA,
+    Rating,
+    RatingTable,
+    draw_margin,
+    rate_1v1,
+    win_probability,
+)
+
+
+def test_canonical_newcomer_update():
+    # The canonical TrueSkill 1v1 example (Herbrich et al. defaults,
+    # draw_prob 0.10): two fresh (25, 25/3) players.
+    w, l = rate_1v1(Rating(), Rating())
+    assert w.mu == pytest.approx(29.396, abs=1e-3)
+    assert w.sigma == pytest.approx(7.171, abs=1e-3)
+    assert l.mu == pytest.approx(20.604, abs=1e-3)
+    assert l.sigma == pytest.approx(7.171, abs=1e-3)
+
+
+def test_canonical_draw_update():
+    w, l = rate_1v1(Rating(), Rating(), draw=True)
+    assert w.mu == pytest.approx(25.0, abs=1e-9)
+    assert l.mu == pytest.approx(25.0, abs=1e-9)
+    assert w.sigma == pytest.approx(6.458, abs=1e-3)
+
+
+def test_upset_moves_more_than_expected_win():
+    strong, weak = Rating(35.0, 3.0), Rating(15.0, 3.0)
+    # expected result barely moves the ratings
+    s2, w2 = rate_1v1(strong, weak)
+    assert s2.mu - strong.mu < 0.1
+    # upset moves them a lot
+    w3, s3 = rate_1v1(weak, strong)
+    assert w3.mu - weak.mu > 1.0
+    assert strong.mu - s3.mu > 1.0
+
+
+def test_sigma_always_shrinks_and_draw_pulls_together():
+    a, b = Rating(30.0, 5.0), Rating(20.0, 5.0)
+    na, nb = rate_1v1(a, b, draw=True)
+    assert na.sigma < a.sigma and nb.sigma < b.sigma
+    assert na.mu < a.mu and nb.mu > b.mu  # draw vs weaker player drags down
+
+
+def test_fix_loser_anchors_opponent():
+    agent, bot = Rating(), Rating()
+    new_agent, new_bot = rate_1v1(agent, bot, fix_loser=True)
+    assert new_bot == bot
+    assert new_agent.mu > agent.mu
+
+
+def test_win_probability_symmetry_and_monotonicity():
+    assert win_probability(Rating(), Rating()) == pytest.approx(0.5)
+    p = win_probability(Rating(30, 1), Rating(20, 1))
+    assert 0.9 < p < 1.0
+    assert win_probability(Rating(20, 1), Rating(30, 1)) == pytest.approx(1 - p)
+
+
+def test_draw_margin_zero_and_positive():
+    assert draw_margin(0.0) == 0.0
+    eps = draw_margin(0.10, BETA)
+    assert eps > 0
+    # round-trip: margin chosen so the draw window has the right mass
+    from dotaclient_tpu.eval.rating import _cdf
+
+    mass = _cdf(eps / (math.sqrt(2) * BETA)) - _cdf(-eps / (math.sqrt(2) * BETA))
+    assert mass == pytest.approx(0.10, abs=1e-6)
+
+
+def test_rating_table_anchored_and_leaderboard():
+    t = RatingTable()
+    t.add("scripted", anchored=True)
+    for _ in range(20):
+        t.record("agent", "scripted")
+    assert t.get("scripted") == Rating()  # anchor never moves
+    agent = t.get("agent")
+    assert agent.mu > 30.0
+    board = t.leaderboard()
+    assert board[0][0] == "agent"
+    assert t.games["agent"] == 20
+    # re-adding an existing name must not reset the rating or un-anchor
+    t.add("agent")
+    t.add("scripted", anchored=False)
+    assert t.get("agent") == agent
+    for _ in range(3):
+        t.record("agent", "scripted")
+    assert t.get("scripted") == Rating()
+
+
+def test_extreme_upset_no_nan():
+    w, l = rate_1v1(Rating(0.0, 0.5), Rating(50.0, 0.5))
+    assert math.isfinite(w.mu) and math.isfinite(w.sigma)
+    assert w.sigma > 0 and l.sigma > 0
