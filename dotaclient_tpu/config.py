@@ -73,7 +73,15 @@ class ActorConfig:
     ticks_per_observation: int = 30
     max_dota_time: float = 600.0
     hero: str = "npc_dota_hero_nevermore"
-    opponent: str = "scripted"  # "scripted" | "self"
+    # "scripted":      1v1 vs the env's passive scripted bot (runtime/actor.py)
+    # "scripted_hard": 1v1 vs the hard scripted bot (farms + retreats) — the
+    #                  north-star TrueSkill yardstick
+    # "self":          mirror self-play, both sides live weights (runtime/selfplay.py)
+    # "league":        PFSP league self-play vs frozen snapshots (eval/league.py)
+    opponent: str = "scripted"
+    league_capacity: int = 8  # max snapshots in the local league pool
+    league_snapshot_every: int = 20  # learner versions between snapshots
+    pfsp_mode: str = "hard"  # "hard" | "even" | "uniform"
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     seed: int = 0
     actor_id: int = 0

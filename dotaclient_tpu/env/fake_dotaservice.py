@@ -8,19 +8,32 @@ headless Dota 2 dedicated server wrapper, SURVEY.md §1 L0) cannot run in
 CI; this fake speaks the same protos through the same stubs so every
 actor-side line of code is exercised unmodified.
 
-The MDP ("last-hit lane"): the controlled hero faces a lane of enemy
-creeps plus a scripted enemy hero.
+The MDP ("last-hit lane"): two heroes face each other over a two-sided
+creep lane.
 
-- Creep waves spawn every 30 dota-seconds; creeps drift toward the
-  hero's tower and lose hp to the (implicit) friendly wave.
-- ATTACK on a creep deals damage; the killing blow grants last_hit,
-  gold and xp — the dominant shaped-reward signal, exactly like real
-  1v1 laning.
-- The scripted enemy hero advances and attacks when the hero is in
-  range; standing in range bleeds hp, so the policy must learn to
+- Both teams' creep waves spawn every 30 dota-seconds and advance toward
+  the enemy side; each wave chips the opposing wave down, opening
+  last-hit windows — killing blows grant last_hits, gold and xp, the
+  dominant shaped-reward signal, exactly like real 1v1 laning.
+- Each hero is either policy-controlled or scripted, per
+  `GameConfig.hero_picks[].control_mode`:
+    0 = scripted (passive laner: pursues and trades when the enemy hero
+        is close, otherwise holds its side of the lane);
+    1 = policy-controlled (actions applied per player_id from `act`);
+    2 = scripted HARD (also last-hits low creeps in range and retreats
+        at low hp) — the "hard scripted bot" yardstick the north-star
+        TrueSkill metric is measured against.
+- Standing in range of enemies bleeds hp, so a policy must learn to
   trade: step in to last-hit, step out to survive.
-- Killing the enemy hero (or surviving to max_dota_time with more
-  net worth) wins; dying loses.
+- Killing the enemy hero wins; at max_dota_time the higher net worth
+  (gold+xp) wins.
+
+Self-play: both heroes controlled (control_mode=1 for both picks), one
+process driving both player_ids through the same session. `observe`
+advances the world only when the requesting team has already seen the
+current tick, so two teams each observing per tick step the world exactly
+once (mirroring the real dotaservice's one-worldstate-per-team-per-tick
+stream semantics).
 
 Determinism: all randomness flows from GameConfig.seed.
 """
@@ -38,6 +51,13 @@ from dotaclient_tpu.protos import dotaservice_pb2 as ds
 from dotaclient_tpu.protos import worldstate_pb2 as ws
 
 TEAM_RADIANT, TEAM_DIRE = 2, 3
+
+# Scripted-AI control modes (HeroPick.control_mode values).
+CONTROL_SCRIPTED = 0
+CONTROL_POLICY = 1
+CONTROL_SCRIPTED_HARD = 2
+
+RADIANT_PLAYER, DIRE_PLAYER = 0, 5
 
 _HERO_HANDLE = 1
 _ENEMY_HERO_HANDLE = 2
@@ -81,15 +101,31 @@ class LastHitLaneGame:
         self.tick = 0
         self.next_handle = 100
         self.next_wave_time = 0.0
-        self.winning_team = 0
-        self.hero = _Unit(_HERO_HANDLE, ws.Unit.HERO, TEAM_RADIANT, -1500.0, 0.0, _HERO_HP, player_id=0)
-        self.enemy_hero = _Unit(_ENEMY_HERO_HANDLE, ws.Unit.HERO, TEAM_DIRE, 1500.0, 0.0, _HERO_HP, player_id=5)
+        self.winning_team = 0  # 0 while running, and still 0 on a draw
+        self.ended = False
+        self.hero = _Unit(
+            _HERO_HANDLE, ws.Unit.HERO, TEAM_RADIANT, -1500.0, 0.0, _HERO_HP, player_id=RADIANT_PLAYER
+        )
+        self.enemy_hero = _Unit(
+            _ENEMY_HERO_HANDLE, ws.Unit.HERO, TEAM_DIRE, 1500.0, 0.0, _HERO_HP, player_id=DIRE_PLAYER
+        )
+        self.heroes: Dict[int, _Unit] = {RADIANT_PLAYER: self.hero, DIRE_PLAYER: self.enemy_hero}
         self.creeps: list[_Unit] = []
         self.stats = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
-        self.enemy_stats = {"xp": 0, "gold": 600, "last_hits": 0, "kills": 0, "deaths": 0}
-        self._xp_trickle = 0.0
-        # pending action for the controlled hero, applied on next step
-        self.pending: Optional[ds.Action] = None
+        self.enemy_stats = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
+        self.stats_by: Dict[int, dict] = {RADIANT_PLAYER: self.stats, DIRE_PLAYER: self.enemy_stats}
+        # control mode per player: radiant defaults to policy, dire to
+        # scripted (back-compat with 1v1-vs-bot configs without picks).
+        self.control: Dict[int, int] = {RADIANT_PLAYER: CONTROL_POLICY, DIRE_PLAYER: CONTROL_SCRIPTED}
+        for pick in config.hero_picks:
+            pid = RADIANT_PLAYER if pick.team_id == TEAM_RADIANT else DIRE_PLAYER
+            self.control[pid] = pick.control_mode
+        self._xp_trickle: Dict[int, float] = {RADIANT_PLAYER: 0.0, DIRE_PLAYER: 0.0}
+        # pending action per player, applied on next step
+        self.pending: Dict[int, ds.Action] = {}
+        # highest tick each team has been served (observe steps the world
+        # only when the requesting team is already up to date)
+        self.seen_tick: Dict[int, int] = {TEAM_RADIANT: -1, TEAM_DIRE: -1}
         # per-game lock so N peers step their games concurrently
         self.lock = threading.Lock()
         self._maybe_spawn_wave()
@@ -98,14 +134,17 @@ class LastHitLaneGame:
 
     def step(self) -> None:
         """Advance the world by one observation interval."""
-        if self.winning_team:
+        if self.ended:
             return
         dt = self.dt
         self.dota_time += dt
         self.tick += int(dt * _TICKS_PER_SEC)
         self._maybe_spawn_wave()
-        self._apply_hero_action(dt)
-        self._scripted_enemy(dt)
+        for pid in (RADIANT_PLAYER, DIRE_PLAYER):
+            if self.control[pid] == CONTROL_POLICY:
+                self._apply_hero_action(pid, dt)
+            else:
+                self._scripted_hero(pid, dt, hard=self.control[pid] == CONTROL_SCRIPTED_HARD)
         self._creep_combat(dt)
         self._regen(dt)
         self._check_end()
@@ -113,99 +152,134 @@ class LastHitLaneGame:
     def _maybe_spawn_wave(self) -> None:
         if self.dota_time >= self.next_wave_time:
             self.next_wave_time += _WAVE_PERIOD
-            for i in range(_WAVE_SIZE):
-                x = 200.0 + 40.0 * i + self.rng.uniform(-20, 20)
-                y = self.rng.uniform(-120, 120)
-                self.creeps.append(
-                    _Unit(self.next_handle, ws.Unit.LANE_CREEP, TEAM_DIRE, x, y, _CREEP_HP)
-                )
-                self.next_handle += 1
+            for team in (TEAM_DIRE, TEAM_RADIANT):
+                sign = -1.0 if team == TEAM_RADIANT else 1.0
+                for i in range(_WAVE_SIZE):
+                    x = sign * (200.0 + 40.0 * i) + self.rng.uniform(-20, 20)
+                    y = self.rng.uniform(-120, 120)
+                    self.creeps.append(
+                        _Unit(self.next_handle, ws.Unit.LANE_CREEP, team, x, y, _CREEP_HP)
+                    )
+                    self.next_handle += 1
 
-    def _apply_hero_action(self, dt: float) -> None:
-        act = self.pending
-        self.pending = None
-        h = self.hero
+    # ------------------------------------------------------------ hero acts
+
+    def _hero_attack(self, pid: int, target: _Unit, dt: float) -> None:
+        """Attack-or-approach; killing blows credit `pid`'s stats."""
+        h = self.heroes[pid]
+        stats = self.stats_by[pid]
+        if self._dist(h, target) <= _HERO_RANGE:
+            dmg = _HERO_DMG * dt * 1.4 * (1.0 + 0.1 * self.rng.randn())
+            target.hp -= max(dmg, 0.0)
+            if target.hp <= 0:
+                target.alive = False
+                if target.unit_type == ws.Unit.LANE_CREEP:
+                    if target.team != h.team:
+                        stats["last_hits"] += 1
+                        stats["gold"] += _GOLD_PER_CREEP
+                        stats["xp"] += _XP_PER_CREEP
+                    else:  # denied own creep: counter only, no gold/xp
+                        stats["denies"] += 1
+                elif target.unit_type == ws.Unit.HERO:
+                    stats["kills"] += 1
+                    self.stats_by[target.player_id]["deaths"] += 1
+        else:
+            self._move_toward(h, target.x, target.y, _HERO_SPEED * dt)
+
+    def _apply_hero_action(self, pid: int, dt: float) -> None:
+        act = self.pending.pop(pid, None)
+        h = self.heroes[pid]
         if not h.alive or act is None:
             return
         if act.type == ds.Action.MOVE:
             self._move_toward(h, act.move_x, act.move_y, _HERO_SPEED * dt)
         elif act.type == ds.Action.ATTACK:
             target = self._find(act.target_handle)
-            if target is not None and target.alive and target.team != h.team:
-                if self._dist(h, target) <= _HERO_RANGE:
-                    dmg = _HERO_DMG * dt * 1.4 * (1.0 + 0.1 * self.rng.randn())
-                    target.hp -= max(dmg, 0.0)
-                    if target.hp <= 0:
-                        target.alive = False
-                        if target.unit_type == ws.Unit.LANE_CREEP:
-                            self.stats["last_hits"] += 1
-                            self.stats["gold"] += _GOLD_PER_CREEP
-                            self.stats["xp"] += _XP_PER_CREEP
-                        elif target is self.enemy_hero:
-                            self.stats["kills"] += 1
-                            self.enemy_stats["deaths"] += 1
-                else:
-                    # out of range: walk toward the target (attack-move)
-                    self._move_toward(h, target.x, target.y, _HERO_SPEED * dt)
+            if target is not None and target.alive and target is not h:
+                self._hero_attack(pid, target, dt)
 
-    def _scripted_enemy(self, dt: float) -> None:
-        e = self.enemy_hero
-        h = self.hero
-        if not e.alive:
+    def _scripted_hero(self, pid: int, dt: float, hard: bool = False) -> None:
+        """Scripted laner. Base: trade with the enemy hero when close,
+        otherwise hold lane. Hard additionally retreats at low hp and
+        last-hits low-hp enemy creeps in range (it farms, so beating it
+        on net worth requires genuinely better laning)."""
+        me = self.heroes[pid]
+        foe = self.heroes[DIRE_PLAYER if pid == RADIANT_PLAYER else RADIANT_PLAYER]
+        if not me.alive:
             return
-        if h.alive and self._dist(e, h) <= _HERO_RANGE:
-            h.hp -= _HERO_DMG * dt * (1.0 + 0.1 * self.rng.randn())
-            if h.hp <= 0:
-                h.alive = False
-                self.stats["deaths"] += 1
-                self.enemy_stats["kills"] += 1
-        elif h.alive and self._dist(e, h) < _ENEMY_PURSUE_RADIUS:
-            self._move_toward(e, h.x, h.y, _HERO_SPEED * 0.8 * dt)
+        home_x = -1200.0 if me.team == TEAM_RADIANT else 1200.0
+        if hard and me.hp < 0.25 * me.hp_max:
+            self._move_toward(me, home_x * 1.3, 0.0, _HERO_SPEED * dt)
+            return
+        if hard:
+            lastable = [
+                c
+                for c in self.creeps
+                if c.alive
+                and c.team != me.team
+                and c.hp <= 2.2 * _HERO_DMG * dt * 1.4
+                and self._dist(me, c) <= _HERO_RANGE
+            ]
+            if lastable:
+                self._hero_attack(pid, min(lastable, key=lambda c: c.hp), dt)
+                return
+        if foe.alive and self._dist(me, foe) <= _HERO_RANGE:
+            self._hero_attack(pid, foe, dt)
+        elif foe.alive and self._dist(me, foe) < _ENEMY_PURSUE_RADIUS:
+            self._move_toward(me, foe.x, foe.y, _HERO_SPEED * 0.8 * dt)
         else:
-            # hold position under its own tower — diving it is punished,
+            # hold position on its own side — diving it is punished,
             # farming the creep line in the middle of the lane is safe
-            self._move_toward(e, 1200.0, 0.0, _HERO_SPEED * 0.5 * dt)
+            self._move_toward(me, home_x, 0.0, _HERO_SPEED * 0.5 * dt)
+
+    # ---------------------------------------------------------- creep phase
 
     def _creep_combat(self, dt: float) -> None:
-        # implicit friendly wave whittles enemy creeps; creeps poke the hero
-        h = self.hero
+        # Opposing waves chip each other down (aggregate dps — opens
+        # last-hit windows); creeps poke enemy heroes within aggro radius.
         for c in self.creeps:
             if not c.alive:
                 continue
-            c.hp -= (14.0 + 6.0 * self.rng.rand()) * dt  # friendly-wave dps
+            c.hp -= (14.0 + 6.0 * self.rng.rand()) * dt  # opposing-wave dps
             if c.hp <= 0:
-                c.alive = False  # denied by the wave — no last-hit credit
+                c.alive = False  # chipped down by the wave — no credit
                 continue
-            self._move_toward(c, -800.0, 0.0, 40.0 * dt)
-            if h.alive and self._dist(c, h) <= _CREEP_AGGRO_RADIUS:
-                h.hp -= _CREEP_DMG * dt * 0.2
-                if h.hp <= 0:
-                    h.alive = False
-                    self.stats["deaths"] += 1
-        self.creeps = [c for c in self.creeps if c.alive and c.x > -1800.0]
+            goal_x = -800.0 if c.team == TEAM_DIRE else 800.0
+            self._move_toward(c, goal_x, 0.0, 40.0 * dt)
+            for h in self.heroes.values():
+                if h.alive and h.team != c.team and self._dist(c, h) <= _CREEP_AGGRO_RADIUS:
+                    h.hp -= _CREEP_DMG * dt * 0.2
+                    if h.hp <= 0:
+                        h.alive = False
+                        self.stats_by[h.player_id]["deaths"] += 1
+        self.creeps = [c for c in self.creeps if c.alive and abs(c.x) < 1800.0]
 
     def _regen(self, dt: float) -> None:
-        for u in (self.hero, self.enemy_hero):
+        for pid, u in self.heroes.items():
             if u.alive:
                 u.hp = min(u.hp + 4.0 * dt, u.hp_max)
-        # passive xp trickle so standing safely far away is weakly positive
-        # (float-accumulated so the rate survives any dt, then credited in
-        # whole points since the proto field is integral)
-        self._xp_trickle += 2.0 * dt
-        whole = int(self._xp_trickle)
-        if whole:
-            self.stats["xp"] += whole
-            self._xp_trickle -= whole
+            # passive xp trickle so standing safely far away is weakly
+            # positive (float-accumulated so the rate survives any dt, then
+            # credited in whole points since the proto field is integral)
+            self._xp_trickle[pid] += 2.0 * dt
+            whole = int(self._xp_trickle[pid])
+            if whole:
+                self.stats_by[pid]["xp"] += whole
+                self._xp_trickle[pid] -= whole
 
     def _check_end(self) -> None:
         if not self.hero.alive:
-            self.winning_team = TEAM_DIRE
+            self.winning_team, self.ended = TEAM_DIRE, True
         elif not self.enemy_hero.alive:
-            self.winning_team = TEAM_RADIANT
+            self.winning_team, self.ended = TEAM_RADIANT, True
         elif self.dota_time >= self.max_time:
             mine = self.stats["gold"] + self.stats["xp"]
             theirs = self.enemy_stats["gold"] + self.enemy_stats["xp"]
-            self.winning_team = TEAM_RADIANT if mine >= theirs else TEAM_DIRE
+            self.ended = True
+            if mine != theirs:  # exact tie = draw (winning_team stays 0) —
+                # mirror self-play with identical play must not hand
+                # radiant a free TrueSkill win
+                self.winning_team = TEAM_RADIANT if mine > theirs else TEAM_DIRE
 
     # ------------------------------------------------------------- helpers
 
@@ -243,9 +317,9 @@ class LastHitLaneGame:
             team_id=team_id,
             winning_team=self.winning_team,
         )
-        w.player_ids.append(0 if team_id == TEAM_RADIANT else 5)
+        w.player_ids.append(RADIANT_PLAYER if team_id == TEAM_RADIANT else DIRE_PLAYER)
         for u, stats in ((self.hero, self.stats), (self.enemy_hero, self.enemy_stats)):
-            p = w.units.add(
+            w.units.add(
                 handle=u.handle,
                 unit_type=ws.Unit.HERO,
                 team_id=u.team,
@@ -269,7 +343,6 @@ class LastHitLaneGame:
                 kills=stats["kills"],
                 deaths=stats["deaths"],
             )
-            del p  # fields set via add()
         for c in self.creeps:
             w.units.add(
                 handle=c.handle,
@@ -292,8 +365,10 @@ class FakeDotaService(DotaServiceServicer):
 
     Matches the reference dotaservice loop semantics (SURVEY.md §3.1):
     `reset` starts a fresh game and returns the first observation;
-    `act` queues the hero's action; `observe` advances one observation
-    interval and returns the new worldstate (EPISODE_DONE once ended).
+    `act` queues per-player actions; `observe` returns the requesting
+    team's worldstate, advancing the world one observation interval only
+    when that team is already up to date with the current tick (so in
+    self-play, two teams observing per tick step the world exactly once).
     Trace replay (feeding recorded real-game protos) plugs in here later
     by swapping LastHitLaneGame for a trace reader.
     """
@@ -318,7 +393,7 @@ class FakeDotaService(DotaServiceServicer):
         if len(self._games) < self._MAX_SESSIONS:
             return
         for key, game in self._games.items():
-            if game.winning_team:
+            if game.ended:
                 self._games.pop(key)
                 return
         self._games.pop(next(iter(self._games)))
@@ -329,6 +404,7 @@ class FakeDotaService(DotaServiceServicer):
             self._evict_if_full()
             self._games[self._key(context)] = game
         with game.lock:
+            game.seen_tick[TEAM_RADIANT] = game.tick
             return ds.Observation(
                 status=ds.Observation.OK,
                 world_state=game.worldstate(TEAM_RADIANT),
@@ -342,8 +418,10 @@ class FakeDotaService(DotaServiceServicer):
         if game is None:
             return ds.Observation(status=ds.Observation.RESOURCE_EXHAUSTED)
         with game.lock:  # games step concurrently; only the dict is global
-            game.step()
-            status = ds.Observation.EPISODE_DONE if game.winning_team else ds.Observation.OK
+            if game.seen_tick.get(team, -1) >= game.tick and not game.ended:
+                game.step()
+            game.seen_tick[team] = game.tick
+            status = ds.Observation.EPISODE_DONE if game.ended else ds.Observation.OK
             return ds.Observation(status=status, world_state=game.worldstate(team), team_id=team)
 
     def act(self, request: ds.Actions, context=None) -> ds.Empty:
@@ -352,8 +430,8 @@ class FakeDotaService(DotaServiceServicer):
         if game is not None:
             with game.lock:
                 for a in request.actions:
-                    if a.player_id == 0:
-                        game.pending = a
+                    if a.player_id in game.heroes:
+                        game.pending[a.player_id] = a
         return ds.Empty()
 
 

@@ -75,10 +75,15 @@ class Evaluator:
     def __init__(self, cfg: ActorConfig, name: str = "agent"):
         from dotaclient_tpu.runtime.actor import Actor
 
+        if cfg.opponent not in ("scripted", "scripted_hard"):
+            raise ValueError(f"Evaluator measures vs a scripted bot, got opponent={cfg.opponent!r}")
         self.cfg = cfg
         self.name = name
+        # the anchor is whichever bot this evaluator faces — the north-star
+        # metric is measured against "scripted_hard"
+        self.opponent_name = cfg.opponent
         self.table = RatingTable()
-        self.table.add(self.SCRIPTED, Rating(), anchored=True)
+        self.table.add(self.opponent_name, Rating(), anchored=True)
         self.table.add(name)
         # One persistent loop + actor so the jit cache and the gRPC channel
         # survive across evaluate() calls (fresh loops would orphan the
@@ -104,13 +109,13 @@ class Evaluator:
                 returns.append(ret)
                 if actor.last_win > 0:
                     wins += 1
-                    self.table.record(self.name, self.SCRIPTED)
+                    self.table.record(self.name, self.opponent_name)
                 elif actor.last_win < 0:
                     losses += 1
-                    self.table.record(self.SCRIPTED, self.name)
+                    self.table.record(self.opponent_name, self.name)
                 else:  # decided draw (episode ended, no winning team)
                     draws += 1
-                    self.table.record(self.name, self.SCRIPTED, draw=True)
+                    self.table.record(self.name, self.opponent_name, draw=True)
 
         self._loop.run_until_complete(run())
         return EvalResult(

@@ -1,0 +1,131 @@
+"""League self-play: opponent pool + PFSP sampling (benchmark config 5).
+
+The reference's self-play opponent is the latest (or a lagged) copy of the
+learner's weights (SURVEY.md §2 "Eval / rating"); the benchmark ladder's
+final rung (BASELINE.json config 5) is league self-play with PFSP —
+prioritized fictitious self-play, the AlphaStar-style scheme where the
+probability of facing a past snapshot scales with how hard that snapshot
+is for the current agent.
+
+Each self-play actor keeps its own local league: snapshots are taken from
+the weight broadcasts the actor receives anyway, so the league needs no
+extra transport — the pool and its ratings live beside the actor and
+sample opponents per episode.
+
+Pure host-side python (numpy for the categorical draw); nothing here
+touches the device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dotaclient_tpu.eval.rating import RatingTable, win_probability
+
+NamedParams = List[Tuple[str, np.ndarray]]  # transport/serialize wire form
+
+AGENT = "agent"
+
+# PFSP weighting curves f(p) where p = P(agent beats snapshot):
+#   hard:    (1-p)^2  — mostly the opponents we lose to (AlphaStar main-exploiter flavour)
+#   even:    p(1-p)   — opponents near 50%, the highest-information games
+#   uniform: 1        — plain fictitious self-play
+_PFSP_CURVES = {
+    "hard": lambda p: (1.0 - p) ** 2,
+    "even": lambda p: p * (1.0 - p),
+    "uniform": lambda p: np.ones_like(p),
+}
+
+
+class Snapshot(NamedTuple):
+    name: str  # "v<version>"
+    version: int
+    named_params: NamedParams  # wire-format flat params
+
+
+class League:
+    """Bounded snapshot pool with TrueSkill bookkeeping and PFSP draws."""
+
+    def __init__(
+        self,
+        capacity: int = 8,
+        snapshot_every: int = 20,
+        mode: str = "hard",
+        seed: int = 0,
+    ):
+        if mode not in _PFSP_CURVES:
+            raise ValueError(f"unknown pfsp mode {mode!r}; want one of {sorted(_PFSP_CURVES)}")
+        self.capacity = capacity
+        self.snapshot_every = snapshot_every
+        self.mode = mode
+        self.table = RatingTable()
+        self.table.add(AGENT)
+        self._snapshots: Dict[str, Snapshot] = {}
+        self._last_snap_version: Optional[int] = None
+        self._rng = np.random.RandomState(seed)
+
+    # ------------------------------------------------------------ snapshots
+
+    def __len__(self) -> int:
+        return len(self._snapshots)
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._snapshots)
+
+    def maybe_snapshot(self, version: int, named_params: NamedParams) -> bool:
+        """Admit `named_params` as snapshot v<version> if it is
+        `snapshot_every` versions past the previous snapshot. The snapshot
+        inherits the agent's current rating (it IS the agent, frozen)."""
+        if self._last_snap_version is not None and version - self._last_snap_version < self.snapshot_every:
+            return False
+        name = f"v{version}"
+        if name in self._snapshots:
+            return False
+        # copy: the caller may mutate its arrays (unflatten targets)
+        frozen = [(k, np.array(v, copy=True)) for k, v in named_params]
+        self._snapshots[name] = Snapshot(name, version, frozen)
+        self.table.add(name, rating=self.table.get(AGENT))
+        self._last_snap_version = version
+        if len(self._snapshots) > self.capacity:
+            self._evict()
+        return True
+
+    def _evict(self) -> None:
+        """Drop the weakest snapshot, never the newest — the pool should
+        track the frontier of past strength, not a museum of early junk."""
+        newest = max(self._snapshots.values(), key=lambda s: s.version).name
+        candidates = [n for n in self._snapshots if n != newest]
+        # weakest by mu (strength estimate) — conservative would punish
+        # barely-played snapshots for their uncertainty, not their skill
+        weakest = min(candidates, key=lambda n: self.table.get(n).mu)
+        del self._snapshots[weakest]
+
+    # ------------------------------------------------------------- sampling
+
+    def sample_opponent(self) -> Optional[Snapshot]:
+        """PFSP draw from the pool; None while the pool is empty (caller
+        falls back to mirror self-play against the live weights)."""
+        if not self._snapshots:
+            return None
+        names = list(self._snapshots)
+        agent = self.table.get(AGENT)
+        p = np.asarray([win_probability(agent, self.table.get(n)) for n in names])
+        w = _PFSP_CURVES[self.mode](p) + 1e-6  # floor: nobody is ever unpickable
+        w = w / w.sum()
+        return self._snapshots[names[int(self._rng.choice(len(names), p=w))]]
+
+    # -------------------------------------------------------------- results
+
+    def record_result(self, opponent: str, win: float) -> None:
+        """win > 0: agent beat `opponent`; < 0: lost; == 0: decided draw."""
+        if opponent not in self._snapshots:
+            return  # opponent already evicted — rating signal is stale
+        if win > 0:
+            self.table.record(AGENT, opponent)
+        elif win < 0:
+            self.table.record(opponent, AGENT)
+        else:
+            self.table.record(AGENT, opponent, draw=True)

@@ -64,6 +64,36 @@ def make_actor_step(cfg: ActorConfig):
     return step
 
 
+def build_action(
+    cfg: ActorConfig,
+    action: ad.Action,
+    handles: np.ndarray,
+    hero: Optional[ws.Unit],
+    player_id: int,
+    batch_index: int = 0,
+) -> ds.Action:
+    """Map one batch row of sampled head indices to an Action proto."""
+    a = ds.Action(player_id=player_id)
+    i = batch_index
+    atype = int(action.type[i])
+    if atype == F.ACT_MOVE and hero is not None:
+        n = cfg.policy.n_move_bins
+        grid = (np.arange(n) - n // 2) / max(n // 2, 1)
+        a.type = ds.Action.MOVE
+        a.move_x = hero.x + float(grid[int(action.move_x[i])]) * cfg.policy.move_step
+        a.move_y = hero.y + float(grid[int(action.move_y[i])]) * cfg.policy.move_step
+    elif atype == F.ACT_ATTACK:
+        a.type = ds.Action.ATTACK
+        a.target_handle = int(handles[int(action.target[i])])
+    elif atype == F.ACT_CAST:
+        a.type = ds.Action.CAST
+        a.ability_slot = 0
+        a.target_handle = int(handles[int(action.target[i])])
+    else:
+        a.type = ds.Action.NOOP
+    return a
+
+
 def build_actions_proto(
     cfg: ActorConfig,
     action: ad.Action,
@@ -74,23 +104,7 @@ def build_actions_proto(
     dota_time: float,
 ) -> ds.Actions:
     """Map sampled head indices back to a concrete Actions proto."""
-    a = ds.Action(player_id=player_id)
-    atype = int(action.type[0])
-    if atype == F.ACT_MOVE and hero is not None:
-        n = cfg.policy.n_move_bins
-        grid = (np.arange(n) - n // 2) / max(n // 2, 1)
-        a.type = ds.Action.MOVE
-        a.move_x = hero.x + float(grid[int(action.move_x[0])]) * cfg.policy.move_step
-        a.move_y = hero.y + float(grid[int(action.move_y[0])]) * cfg.policy.move_step
-    elif atype == F.ACT_ATTACK:
-        a.type = ds.Action.ATTACK
-        a.target_handle = int(handles[int(action.target[0])])
-    elif atype == F.ACT_CAST:
-        a.type = ds.Action.CAST
-        a.ability_slot = 0
-        a.target_handle = int(handles[int(action.target[0])])
-    else:
-        a.type = ds.Action.NOOP
+    a = build_action(cfg, action, handles, hero, player_id)
     return ds.Actions(actions=[a], team_id=team_id, dota_time=dota_time)
 
 
@@ -218,7 +232,12 @@ class Actor:
             seed=self.np_rng.randint(1 << 30),
             hero_picks=[
                 ds.HeroPick(team_id=2, hero_name=cfg.hero, control_mode=1),
-                ds.HeroPick(team_id=3, hero_name=cfg.hero, control_mode=0 if cfg.opponent == "scripted" else 1),
+                ds.HeroPick(
+                    team_id=3,
+                    hero_name=cfg.hero,
+                    # 0 = passive scripted, 2 = hard scripted (farms/retreats)
+                    control_mode={"scripted": 0, "scripted_hard": 2}.get(cfg.opponent, 1),
+                ),
             ],
         )
         resp = await self.stub.reset(config)
@@ -315,7 +334,12 @@ def main(argv=None):
     if cfg.platform:
         jax.config.update("jax_platforms", cfg.platform)
     broker = broker_connect(cfg.broker_url)
-    actor = Actor(cfg, broker, actor_id=cfg.actor_id)
+    if cfg.opponent in ("self", "league"):
+        from dotaclient_tpu.runtime.selfplay import SelfPlayActor
+
+        actor = SelfPlayActor(cfg, broker, actor_id=cfg.actor_id)
+    else:
+        actor = Actor(cfg, broker, actor_id=cfg.actor_id)
     asyncio.run(actor.run())
 
 

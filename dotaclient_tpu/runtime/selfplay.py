@@ -1,0 +1,299 @@
+"""Self-play actor: both heroes of a 1v1 game driven by one process.
+
+The reference's self-play opponent is the latest (or lagged) copy of the
+learner's weights (SURVEY.md §2 "Eval / rating", BASELINE configs 3/5);
+here one asyncio process controls both player_ids of a single env
+session, which keeps the two sides in lockstep without any cross-process
+game synchronization:
+
+- **mirror** (`opponent="self"`): both sides play the live weights and
+  BOTH publish experience — every game yields 2× trajectories, and the
+  policy sees both the radiant and dire views of the same states (the
+  team-indicator feature differs, so one shared LSTM learns both sides —
+  exactly the "shared LSTM self-play" of BASELINE config 3).
+- **league** (`opponent="league"`): the dire side plays a frozen PFSP
+  snapshot from the local league pool (eval/league.py); only the live
+  (radiant) side publishes experience. Snapshots are taken from the
+  weight broadcasts the actor receives anyway — no extra transport.
+
+TPU-first detail: in mirror mode the two sides' observations are stacked
+into ONE batched jit call per tick (B=2) — the policy step is a single
+compiled program either way; batching players is how 5v5 scales too.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dotaclient_tpu.config import ActorConfig
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env import rewards as R
+from dotaclient_tpu.env.service import AsyncDotaServiceStub, connect_async
+from dotaclient_tpu.eval.league import League, Snapshot
+from dotaclient_tpu.models import policy as P
+from dotaclient_tpu.ops import action_dist as ad
+from dotaclient_tpu.protos import dotaservice_pb2 as ds
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+from dotaclient_tpu.runtime.actor import _Chunk, build_action, make_actor_step
+from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.serialize import (
+    deserialize_weights,
+    serialize_rollout,
+    unflatten_params,
+)
+
+_log = logging.getLogger(__name__)
+
+RADIANT_PLAYER, DIRE_PLAYER = 0, 5
+TEAM_RADIANT, TEAM_DIRE = 2, 3
+
+
+def _slice_action(action: ad.Action, i: int) -> ad.Action:
+    """Row i of a batched Action, kept as a length-1 batch (chunk format)."""
+    return ad.Action(
+        type=action.type[i : i + 1],
+        move_x=action.move_x[i : i + 1],
+        move_y=action.move_y[i : i + 1],
+        target=action.target[i : i + 1],
+    )
+
+
+class _Side:
+    """Per-player episode state (view, LSTM carry, chunk, reward memory)."""
+
+    def __init__(self, player_id: int, team_id: int, cfg: ActorConfig):
+        self.player_id = player_id
+        self.team_id = team_id
+        self.state = P.initial_state(cfg.policy, (1,))
+        self.chunk = _Chunk(self.state)
+        self.world: Optional[ws.World] = None
+        self.obs: Optional[F.Observation] = None
+        self.handles: Optional[np.ndarray] = None
+        self.last_hero: Optional[ws.Unit] = None
+        self.episode_return = 0.0
+
+
+class SelfPlayActor:
+    """Drives both sides of a self-play episode through one env session."""
+
+    def __init__(
+        self,
+        cfg: ActorConfig,
+        broker: Broker,
+        actor_id: int = 0,
+        stub: Optional[AsyncDotaServiceStub] = None,
+    ):
+        if cfg.opponent not in ("self", "league"):
+            raise ValueError(f"SelfPlayActor wants opponent 'self' or 'league', got {cfg.opponent!r}")
+        self.cfg = cfg
+        self.broker = broker
+        self.actor_id = actor_id
+        self._stub = stub
+        self.params = P.init_params(cfg.policy, jax.random.PRNGKey(cfg.seed))
+        self.version = 0
+        self.step_fn = make_actor_step(cfg)
+        self.rng = jax.random.PRNGKey(cfg.seed * 9973 + actor_id)
+        self.np_rng = np.random.RandomState(cfg.seed * 1000003 + actor_id)
+        self.steps_done = 0
+        self.episodes_done = 0
+        self.rollouts_published = 0
+        self.last_win: Optional[float] = None  # radiant (live) perspective
+        self.league: Optional[League] = None
+        if cfg.opponent == "league":
+            self.league = League(
+                capacity=cfg.league_capacity,
+                snapshot_every=cfg.league_snapshot_every,
+                mode=cfg.pfsp_mode,
+                seed=cfg.seed * 31 + actor_id,
+            )
+        # frozen opponent params for the current episode (league mode)
+        self._opp_params = None
+        self._opp_name: Optional[str] = None
+
+    # ------------------------------------------------------------- weights
+
+    def maybe_update_weights(self) -> bool:
+        frame = self.broker.poll_weights()
+        if frame is None:
+            return False
+        try:
+            named, version = deserialize_weights(frame)
+            self.params = unflatten_params(named, self.params)
+            self.version = version
+            if self.league is not None:
+                self.league.maybe_snapshot(version, named)
+            return True
+        except Exception as e:  # a bad broadcast must never kill the actor
+            _log.warning("selfplay actor %d: bad weight frame: %s", self.actor_id, e)
+            return False
+
+    # ------------------------------------------------------------- episode
+
+    @property
+    def stub(self) -> AsyncDotaServiceStub:
+        if self._stub is None:
+            self._stub = connect_async(self.cfg.env_addr)
+        return self._stub
+
+    def _pick_opponent(self) -> None:
+        """League: sample a frozen snapshot (falls back to mirror while the
+        pool is empty). Mirror: live weights both sides."""
+        self._opp_params = None
+        self._opp_name = None
+        if self.league is None:
+            return
+        snap: Optional[Snapshot] = self.league.sample_opponent()
+        if snap is not None:
+            self._opp_params = unflatten_params(snap.named_params, self.params)
+            self._opp_name = snap.name
+
+    def _publish(self, side: _Side, win: float, done: bool) -> None:
+        rollout = side.chunk.to_rollout(
+            side.obs,
+            self.version,
+            self.actor_id,
+            side.episode_return if done else 0.0,
+            win,
+            self.cfg.policy.aux_heads,
+        )
+        self.broker.publish_experience(serialize_rollout(rollout))
+        self.rollouts_published += 1
+        side.chunk = _Chunk(side.state)
+
+    async def run_episode(self) -> float:
+        cfg = self.cfg
+        self.last_win = None
+        self._pick_opponent()
+        mirror = self._opp_params is None  # also league-mode fallback
+        config = ds.GameConfig(
+            host_timescale=cfg.host_timescale,
+            ticks_per_observation=cfg.ticks_per_observation,
+            max_dota_time=cfg.max_dota_time,
+            seed=self.np_rng.randint(1 << 30),
+            hero_picks=[
+                ds.HeroPick(team_id=TEAM_RADIANT, hero_name=cfg.hero, control_mode=1),
+                ds.HeroPick(team_id=TEAM_DIRE, hero_name=cfg.hero, control_mode=1),
+            ],
+        )
+        resp = await self.stub.reset(config)
+        sides: Dict[int, _Side] = {
+            RADIANT_PLAYER: _Side(RADIANT_PLAYER, TEAM_RADIANT, cfg),
+            DIRE_PLAYER: _Side(DIRE_PLAYER, TEAM_DIRE, cfg),
+        }
+        live, opp = sides[RADIANT_PLAYER], sides[DIRE_PLAYER]
+        live.world = resp.world_state
+        opp.world = (await self.stub.observe(ds.ObserveRequest(team_id=TEAM_DIRE))).world_state
+        for s in sides.values():
+            s.obs, s.handles = F.featurize_with_handles(s.world, s.player_id)
+
+        done = False
+        while not done:
+            actions: Dict[int, ds.Action] = {}
+            if mirror:
+                # one batched policy step for both sides
+                obs_b = jax.tree.map(
+                    lambda a, b: jnp.stack([jnp.asarray(a), jnp.asarray(b)]),
+                    live.obs,
+                    opp.obs,
+                )
+                state_b = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), live.state, opp.state)
+                self.rng, key = jax.random.split(self.rng)
+                state_b, action_b, logp_b, value_b = self.step_fn(self.params, state_b, obs_b, key)
+                action_h = jax.device_get(action_b)
+                logp_h = jax.device_get(logp_b)
+                value_h = jax.device_get(value_b)
+                for i, s in enumerate((live, opp)):
+                    s.state = jax.tree.map(lambda x: x[i : i + 1], state_b)
+                    hero = F.find_hero(s.world, s.player_id)
+                    actions[s.player_id] = build_action(
+                        cfg, action_h, s.handles, hero, s.player_id, batch_index=i
+                    )
+                    s._step_record = (_slice_action(action_h, i), float(logp_h[i]), float(value_h[i]))
+            else:
+                for s, params in ((live, self.params), (opp, self._opp_params)):
+                    obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], s.obs)
+                    self.rng, key = jax.random.split(self.rng)
+                    s.state, action, logp, value = self.step_fn(params, s.state, obs_b, key)
+                    action_h = jax.device_get(action)
+                    hero = F.find_hero(s.world, s.player_id)
+                    actions[s.player_id] = build_action(cfg, action_h, s.handles, hero, s.player_id)
+                    s._step_record = (action_h, float(logp[0]), float(value[0]))
+
+            for s in sides.values():
+                hero = F.find_hero(s.world, s.player_id)
+                if hero is not None:
+                    snap = ws.Unit()
+                    snap.CopyFrom(hero)
+                    s.last_hero = snap
+
+            await self.stub.act(
+                ds.Actions(
+                    actions=[actions[RADIANT_PLAYER], actions[DIRE_PLAYER]],
+                    dota_time=live.world.dota_time,
+                )
+            )
+            r2 = await self.stub.observe(ds.ObserveRequest(team_id=TEAM_RADIANT))
+            if r2.status == ds.Observation.RESOURCE_EXHAUSTED:
+                _log.warning("selfplay actor %d: env session lost; abandoning", self.actor_id)
+                self.episodes_done += 1
+                return live.episode_return
+            r3 = await self.stub.observe(ds.ObserveRequest(team_id=TEAM_DIRE))
+            done = r2.status == ds.Observation.EPISODE_DONE
+
+            for s, resp_s in ((live, r2), (opp, r3)):
+                next_world = resp_s.world_state
+                next_obs, next_handles = F.featurize_with_handles(next_world, s.player_id)
+                rew = R.reward(s.world, next_world, s.player_id, s.last_hero)
+                s.episode_return += rew
+                action_rec, logp_rec, value_rec = s._step_record
+                hero = F.find_hero(s.world, s.player_id)
+                s.chunk.obs.append(s.obs)
+                s.chunk.actions.append(action_rec)
+                s.chunk.logp.append(logp_rec)
+                s.chunk.value.append(value_rec)
+                s.chunk.rewards.append(rew)
+                s.chunk.dones.append(1.0 if done else 0.0)
+                if cfg.policy.aux_heads:
+                    s.chunk.aux_lh.append(F.norm_last_hits(hero.last_hits) if hero else 0.0)
+                    s.chunk.aux_nw.append(F.norm_gold(hero.gold) if hero else 0.0)
+                s.world = next_world
+                s.obs, s.handles = next_obs, next_handles
+                self.steps_done += 1
+
+            if len(live.chunk) >= cfg.rollout_len or done:
+                winning = live.world.winning_team
+                for s in sides.values():
+                    win = 0.0
+                    if done and winning:
+                        win = 1.0 if winning == s.team_id else -1.0
+                    publish = s is live or mirror  # frozen opponent: no data
+                    if publish:
+                        self._publish(s, win, done)
+                    else:
+                        s.chunk = _Chunk(s.state)
+                    if s is live and done:
+                        self.last_win = win
+                self.maybe_update_weights()
+
+        if self.league is not None and self._opp_name is not None and self.last_win is not None:
+            self.league.record_result(self._opp_name, self.last_win)
+        self.episodes_done += 1
+        return live.episode_return
+
+    async def run(self, num_episodes: Optional[int] = None) -> None:
+        while num_episodes is None or self.episodes_done < num_episodes:
+            ret = await self.run_episode()
+            _log.info(
+                "selfplay actor %d: episode %d return %.2f (version %d, opp %s)",
+                self.actor_id,
+                self.episodes_done,
+                ret,
+                self.version,
+                self._opp_name or "mirror",
+            )

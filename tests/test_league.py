@@ -1,0 +1,83 @@
+"""League/PFSP opponent pool tests (BASELINE config 5; eval/league.py)."""
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.eval.league import AGENT, League
+from dotaclient_tpu.eval.rating import Rating
+
+
+def params(v):
+    # wire form: list of (name, array) pairs (transport/serialize)
+    return [("w", np.full((2, 2), float(v), np.float32))]
+
+
+def test_snapshot_cadence_and_dedup():
+    lg = League(capacity=4, snapshot_every=10)
+    assert lg.maybe_snapshot(0, params(0))
+    assert not lg.maybe_snapshot(5, params(5))  # too soon
+    assert lg.maybe_snapshot(10, params(10))
+    assert not lg.maybe_snapshot(10, params(10))  # dup version
+    assert lg.names == ["v0", "v10"]
+
+
+def test_snapshot_params_are_frozen_copies():
+    lg = League(snapshot_every=1)
+    p = params(1)
+    lg.maybe_snapshot(1, p)
+    p[0][1][:] = 999.0  # caller mutates its buffer (unflatten target reuse)
+    snap = lg.sample_opponent()
+    assert snap is not None
+    np.testing.assert_array_equal(dict(snap.named_params)["w"], np.full((2, 2), 1.0))
+
+
+def test_eviction_drops_weakest_never_newest():
+    lg = League(capacity=3, snapshot_every=1)
+    for v in range(3):
+        lg.maybe_snapshot(v, params(v))
+    # make v1 clearly the weakest, v0 strong
+    for _ in range(10):
+        lg.table.record("v0", "v1")
+    lg.maybe_snapshot(99, params(99))  # overflows capacity
+    assert "v99" in lg.names  # newest survives
+    assert "v1" not in lg.names  # weakest evicted
+    assert len(lg) == 3
+
+
+def test_empty_pool_samples_none():
+    assert League().sample_opponent() is None
+
+
+def test_pfsp_hard_prefers_hard_opponents():
+    lg = League(capacity=8, snapshot_every=1, mode="hard", seed=0)
+    lg.maybe_snapshot(1, params(1))
+    lg.maybe_snapshot(2, params(2))
+    # agent dominates v1, loses to v2 → "hard" mode should mostly pick v2
+    for _ in range(15):
+        lg.table.record(AGENT, "v1")
+        lg.table.record("v2", AGENT)
+    picks = [lg.sample_opponent().name for _ in range(300)]
+    frac_hard = picks.count("v2") / len(picks)
+    assert frac_hard > 0.9, frac_hard
+
+
+def test_record_result_updates_ratings_and_ignores_evicted():
+    lg = League(snapshot_every=1)
+    lg.maybe_snapshot(1, params(1))
+    before = lg.table.get(AGENT)
+    lg.record_result("v1", 1.0)
+    assert lg.table.get(AGENT).mu > before.mu
+    lg.record_result("v-gone", -1.0)  # evicted/unknown: no crash, no change
+    assert lg.table.get(AGENT).mu > before.mu
+
+
+def test_snapshot_inherits_agent_rating():
+    lg = League(snapshot_every=1)
+    lg.table._ratings[AGENT] = Rating(mu=30.0, sigma=2.0)
+    lg.maybe_snapshot(1, params(1))
+    assert lg.table.get("v1") == Rating(mu=30.0, sigma=2.0)
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError):
+        League(mode="bogus")

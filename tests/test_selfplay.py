@@ -1,0 +1,120 @@
+"""Self-play actor tests: mirror + league modes end-to-end against the
+fake env (SURVEY.md §2 self-play disposition; BASELINE configs 3/5)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import ActorConfig, PolicyConfig
+from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+from dotaclient_tpu.env.service import serve
+from dotaclient_tpu.runtime.selfplay import SelfPlayActor
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect as broker_connect
+from dotaclient_tpu.transport.serialize import (
+    deserialize_rollout,
+    flatten_params,
+    serialize_weights,
+)
+
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+@pytest.fixture()
+def env_addr():
+    server, port = serve(FakeDotaService(), max_workers=4)
+    yield f"127.0.0.1:{port}"
+    server.stop(0)
+
+
+def make_cfg(env_addr, opponent="self", **kw):
+    return ActorConfig(
+        env_addr=env_addr,
+        rollout_len=8,
+        max_dota_time=10.0,
+        policy=SMALL,
+        seed=4,
+        opponent=opponent,
+        **kw,
+    )
+
+
+def run_one(actor):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(actor.run_episode())
+    finally:
+        loop.close()
+
+
+def test_mirror_publishes_both_sides(env_addr):
+    mem.reset("sp1")
+    broker = broker_connect("mem://sp1")
+    actor = SelfPlayActor(make_cfg(env_addr), broker, actor_id=0)
+    run_one(actor)
+    frames = broker.consume_experience(max_items=1000, timeout=1.0)
+    assert len(frames) >= 2
+    rollouts = [deserialize_rollout(f) for f in frames]
+    # both radiant (+1 team feature) and dire (−1) views present
+    team_feats = {float(r.obs.global_feats[0, 4]) for r in rollouts}
+    assert team_feats == {1.0, -1.0}
+    # result recorded from the live (radiant) perspective
+    assert actor.last_win in (1.0, -1.0, 0.0)
+    # the two sides' final rewards carry opposite win components: the sum
+    # of terminal-step rewards should roughly cancel unless it was a draw
+    finals = [r for r in rollouts if r.length and r.dones[-1] > 0]
+    assert len(finals) == 2
+    if actor.last_win != 0.0:
+        terminal = sorted(r.rewards[-1] for r in finals)
+        assert terminal[0] < 0 < terminal[1]
+
+
+def test_mirror_rewards_are_per_side(env_addr):
+    mem.reset("sp2")
+    broker = broker_connect("mem://sp2")
+    actor = SelfPlayActor(make_cfg(env_addr), broker, actor_id=1)
+    run_one(actor)
+    frames = broker.consume_experience(max_items=1000, timeout=1.0)
+    rollouts = [deserialize_rollout(f) for f in frames]
+    assert all(np.all(np.isfinite(r.rewards)) for r in rollouts)
+
+
+def test_league_mode_falls_back_to_mirror_then_uses_snapshots(env_addr):
+    mem.reset("sp3")
+    broker = broker_connect("mem://sp3")
+    cfg = make_cfg(env_addr, opponent="league", league_snapshot_every=1)
+    actor = SelfPlayActor(cfg, broker, actor_id=2)
+
+    # one loop for the actor's whole life — the aio channel binds to it
+    loop = asyncio.new_event_loop()
+    try:
+        # no snapshots yet: mirror fallback, both sides publish
+        loop.run_until_complete(actor.run_episode())
+        assert actor._opp_name is None
+        n_mirror = len(broker.consume_experience(max_items=1000, timeout=1.0))
+        assert n_mirror >= 2
+
+        # learner publishes weights → actor snapshots them into its league
+        pub = broker_connect("mem://sp3")
+        pub.publish_weights(serialize_weights(flatten_params(actor.params), version=3))
+        actor.maybe_update_weights()
+        assert len(actor.league) == 1
+
+        # next episode: frozen opponent, only the live side publishes
+        loop.run_until_complete(actor.run_episode())
+        assert actor._opp_name == "v3"
+        frames = broker.consume_experience(max_items=1000, timeout=1.0)
+        rollouts = [deserialize_rollout(f) for f in frames]
+        team_feats = {float(r.obs.global_feats[0, 4]) for r in rollouts}
+        assert team_feats == {1.0}  # radiant only
+        # the episode result updated the league table
+        assert actor.league.table.games["v3"] >= 1 or actor.last_win is None
+    finally:
+        loop.close()
+
+
+def test_selfplay_rejects_scripted_mode(env_addr):
+    mem.reset("sp4")
+    with pytest.raises(ValueError):
+        SelfPlayActor(make_cfg(env_addr, opponent="scripted"), broker_connect("mem://sp4"))
