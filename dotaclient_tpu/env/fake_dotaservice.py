@@ -46,6 +46,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from dotaclient_tpu.env import heroes
 from dotaclient_tpu.env.service import DotaServiceServicer
 from dotaclient_tpu.protos import dotaservice_pb2 as ds
 from dotaclient_tpu.protos import worldstate_pb2 as ws
@@ -65,10 +66,7 @@ _TICKS_PER_SEC = 30.0
 
 _CREEP_HP = 550.0
 _CREEP_DMG = 21.0
-_HERO_HP = 650.0
-_HERO_DMG = 53.0
-_HERO_RANGE = 600.0
-_HERO_SPEED = 310.0
+# Hero stats live in env/heroes.py profiles (per-pick); creeps below.
 _WAVE_PERIOD = 30.0
 _CREEP_AGGRO_RADIUS = 150.0
 _ENEMY_PURSUE_RADIUS = 700.0
@@ -78,9 +76,38 @@ _GOLD_PER_CREEP = 40
 
 
 class _Unit:
-    __slots__ = ("handle", "unit_type", "team", "x", "y", "hp", "hp_max", "alive", "player_id")
+    __slots__ = (
+        "handle",
+        "unit_type",
+        "team",
+        "x",
+        "y",
+        "hp",
+        "hp_max",
+        "alive",
+        "player_id",
+        "name",
+        "damage",
+        "atk_range",
+        "move_speed",
+        "regen",
+    )
 
-    def __init__(self, handle, unit_type, team, x, y, hp, player_id=-1):
+    def __init__(
+        self,
+        handle,
+        unit_type,
+        team,
+        x,
+        y,
+        hp,
+        player_id=-1,
+        name="",
+        damage=_CREEP_DMG,
+        atk_range=120.0,
+        move_speed=325.0,
+        regen=0.0,
+    ):
         self.handle = handle
         self.unit_type = unit_type
         self.team = team
@@ -88,6 +115,11 @@ class _Unit:
         self.hp = self.hp_max = hp
         self.alive = True
         self.player_id = player_id
+        self.name = name
+        self.damage = damage
+        self.atk_range = atk_range
+        self.move_speed = move_speed
+        self.regen = regen
 
 
 class LastHitLaneGame:
@@ -103,12 +135,32 @@ class LastHitLaneGame:
         self.next_wave_time = 0.0
         self.winning_team = 0  # 0 while running, and still 0 on a draw
         self.ended = False
-        self.hero = _Unit(
-            _HERO_HANDLE, ws.Unit.HERO, TEAM_RADIANT, -1500.0, 0.0, _HERO_HP, player_id=RADIANT_PLAYER
-        )
-        self.enemy_hero = _Unit(
-            _ENEMY_HERO_HANDLE, ws.Unit.HERO, TEAM_DIRE, 1500.0, 0.0, _HERO_HP, player_id=DIRE_PLAYER
-        )
+        # hero picks: name → stat profile (env/heroes.py); missing picks
+        # fall back to the default hero
+        names = {TEAM_RADIANT: heroes.DEFAULT_HERO, TEAM_DIRE: heroes.DEFAULT_HERO}
+        for pick in config.hero_picks:
+            if pick.hero_name and pick.team_id in names:
+                names[pick.team_id] = pick.hero_name
+
+        def make_hero(handle, team, x, pid):
+            prof = heroes.profile(names[team])
+            return _Unit(
+                handle,
+                ws.Unit.HERO,
+                team,
+                x,
+                0.0,
+                prof.hp,
+                player_id=pid,
+                name=names[team],
+                damage=prof.damage,
+                atk_range=prof.attack_range,
+                move_speed=prof.speed,
+                regen=prof.regen,
+            )
+
+        self.hero = make_hero(_HERO_HANDLE, TEAM_RADIANT, -1500.0, RADIANT_PLAYER)
+        self.enemy_hero = make_hero(_ENEMY_HERO_HANDLE, TEAM_DIRE, 1500.0, DIRE_PLAYER)
         self.heroes: Dict[int, _Unit] = {RADIANT_PLAYER: self.hero, DIRE_PLAYER: self.enemy_hero}
         self.creeps: list[_Unit] = []
         self.stats = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
@@ -168,8 +220,8 @@ class LastHitLaneGame:
         """Attack-or-approach; killing blows credit `pid`'s stats."""
         h = self.heroes[pid]
         stats = self.stats_by[pid]
-        if self._dist(h, target) <= _HERO_RANGE:
-            dmg = _HERO_DMG * dt * 1.4 * (1.0 + 0.1 * self.rng.randn())
+        if self._dist(h, target) <= h.atk_range:
+            dmg = h.damage * dt * 1.4 * (1.0 + 0.1 * self.rng.randn())
             target.hp -= max(dmg, 0.0)
             if target.hp <= 0:
                 target.alive = False
@@ -184,7 +236,7 @@ class LastHitLaneGame:
                     stats["kills"] += 1
                     self.stats_by[target.player_id]["deaths"] += 1
         else:
-            self._move_toward(h, target.x, target.y, _HERO_SPEED * dt)
+            self._move_toward(h, target.x, target.y, h.move_speed * dt)
 
     def _apply_hero_action(self, pid: int, dt: float) -> None:
         act = self.pending.pop(pid, None)
@@ -192,7 +244,7 @@ class LastHitLaneGame:
         if not h.alive or act is None:
             return
         if act.type == ds.Action.MOVE:
-            self._move_toward(h, act.move_x, act.move_y, _HERO_SPEED * dt)
+            self._move_toward(h, act.move_x, act.move_y, h.move_speed * dt)
         elif act.type == ds.Action.ATTACK:
             target = self._find(act.target_handle)
             if target is not None and target.alive and target is not h:
@@ -209,7 +261,7 @@ class LastHitLaneGame:
             return
         home_x = -1200.0 if me.team == TEAM_RADIANT else 1200.0
         if hard and me.hp < 0.25 * me.hp_max:
-            self._move_toward(me, home_x * 1.3, 0.0, _HERO_SPEED * dt)
+            self._move_toward(me, home_x * 1.3, 0.0, me.move_speed * dt)
             return
         if hard:
             lastable = [
@@ -217,20 +269,20 @@ class LastHitLaneGame:
                 for c in self.creeps
                 if c.alive
                 and c.team != me.team
-                and c.hp <= 2.2 * _HERO_DMG * dt * 1.4
-                and self._dist(me, c) <= _HERO_RANGE
+                and c.hp <= 2.2 * me.damage * dt * 1.4
+                and self._dist(me, c) <= me.atk_range
             ]
             if lastable:
                 self._hero_attack(pid, min(lastable, key=lambda c: c.hp), dt)
                 return
-        if foe.alive and self._dist(me, foe) <= _HERO_RANGE:
+        if foe.alive and self._dist(me, foe) <= me.atk_range:
             self._hero_attack(pid, foe, dt)
         elif foe.alive and self._dist(me, foe) < _ENEMY_PURSUE_RADIUS:
-            self._move_toward(me, foe.x, foe.y, _HERO_SPEED * 0.8 * dt)
+            self._move_toward(me, foe.x, foe.y, me.move_speed * 0.8 * dt)
         else:
             # hold position on its own side — diving it is punished,
             # farming the creep line in the middle of the lane is safe
-            self._move_toward(me, home_x, 0.0, _HERO_SPEED * 0.5 * dt)
+            self._move_toward(me, home_x, 0.0, me.move_speed * 0.5 * dt)
 
     # ---------------------------------------------------------- creep phase
 
@@ -257,7 +309,7 @@ class LastHitLaneGame:
     def _regen(self, dt: float) -> None:
         for pid, u in self.heroes.items():
             if u.alive:
-                u.hp = min(u.hp + 4.0 * dt, u.hp_max)
+                u.hp = min(u.hp + u.regen * dt, u.hp_max)
             # passive xp trickle so standing safely far away is weakly
             # positive (float-accumulated so the rate survives any dt, then
             # credited in whole points since the proto field is integral)
@@ -324,16 +376,17 @@ class LastHitLaneGame:
                 unit_type=ws.Unit.HERO,
                 team_id=u.team,
                 player_id=u.player_id,
+                name=u.name,
                 x=u.x,
                 y=u.y,
                 health=max(u.hp, 0.0),
                 health_max=u.hp_max,
-                health_regen=2.0,
+                health_regen=u.regen,
                 mana=300.0,
                 mana_max=300.0,
-                attack_damage=_HERO_DMG,
-                attack_range=_HERO_RANGE,
-                speed=_HERO_SPEED,
+                attack_damage=u.damage,
+                attack_range=u.atk_range,
+                speed=u.move_speed,
                 is_alive=u.alive,
                 level=1 + stats["xp"] // 240,
                 gold=stats["gold"],
@@ -352,9 +405,9 @@ class LastHitLaneGame:
                 y=c.y,
                 health=max(c.hp, 0.0),
                 health_max=c.hp_max,
-                attack_damage=_CREEP_DMG,
-                attack_range=120.0,
-                speed=325.0,
+                attack_damage=c.damage,
+                attack_range=c.atk_range,
+                speed=c.move_speed,
                 is_alive=c.alive,
             )
         return w

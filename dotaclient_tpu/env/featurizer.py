@@ -23,13 +23,16 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from dotaclient_tpu.env.heroes import hero_id_features
 from dotaclient_tpu.protos import worldstate_pb2 as ws
 
 # ---------------------------------------------------------------------------
 # Schema constants (shared with the policy).
 MAX_UNITS = 16
 UNIT_FEATURES = 16
-HERO_FEATURES = 16
+# 16 stat features + an 8-dim hashed hero-identity code (env/heroes.py) so
+# one shared LSTM can condition on which hero it is playing (config 3).
+HERO_FEATURES = 24
 GLOBAL_FEATURES = 8
 
 # Action-type head ordering (reference: {noop, move, attack[, ability]}).
@@ -139,6 +142,7 @@ def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     out[13] = math.log1p(max(h.xp, 0)) / 10.0
     out[14] = norm_last_hits(h.last_hits)
     out[15] = 1.0 if h.is_alive else 0.0
+    out[16:24] = hero_id_features(h.name)
 
 
 def featurize_with_handles(world: ws.World, player_id: int):

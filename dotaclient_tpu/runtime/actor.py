@@ -32,6 +32,7 @@ import numpy as np
 
 from dotaclient_tpu.config import ActorConfig
 from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env import heroes
 from dotaclient_tpu.env import rewards as R
 from dotaclient_tpu.env.service import AsyncDotaServiceStub, connect_async
 from dotaclient_tpu.models import policy as P
@@ -225,16 +226,19 @@ class Actor:
     async def run_episode(self) -> float:
         cfg = self.cfg
         self.last_win = None
+        # cfg.hero is one name or a comma-separated pool (config 3: shared
+        # LSTM across a hero pool) — both sides draw independently
+        pool = heroes.parse_pool(cfg.hero)
         config = ds.GameConfig(
             host_timescale=cfg.host_timescale,
             ticks_per_observation=cfg.ticks_per_observation,
             max_dota_time=cfg.max_dota_time,
             seed=self.np_rng.randint(1 << 30),
             hero_picks=[
-                ds.HeroPick(team_id=2, hero_name=cfg.hero, control_mode=1),
+                ds.HeroPick(team_id=2, hero_name=pool[self.np_rng.randint(len(pool))], control_mode=1),
                 ds.HeroPick(
                     team_id=3,
-                    hero_name=cfg.hero,
+                    hero_name=pool[self.np_rng.randint(len(pool))],
                     # 0 = passive scripted, 2 = hard scripted (farms/retreats)
                     control_mode={"scripted": 0, "scripted_hard": 2}.get(cfg.opponent, 1),
                 ),

@@ -33,6 +33,7 @@ import numpy as np
 
 from dotaclient_tpu.config import ActorConfig
 from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env import heroes
 from dotaclient_tpu.env import rewards as R
 from dotaclient_tpu.env.service import AsyncDotaServiceStub, connect_async
 from dotaclient_tpu.eval.league import League, Snapshot
@@ -171,14 +172,23 @@ class SelfPlayActor:
         self.last_win = None
         self._pick_opponent()
         mirror = self._opp_params is None  # also league-mode fallback
+        pool = heroes.parse_pool(cfg.hero)
         config = ds.GameConfig(
             host_timescale=cfg.host_timescale,
             ticks_per_observation=cfg.ticks_per_observation,
             max_dota_time=cfg.max_dota_time,
             seed=self.np_rng.randint(1 << 30),
             hero_picks=[
-                ds.HeroPick(team_id=TEAM_RADIANT, hero_name=cfg.hero, control_mode=1),
-                ds.HeroPick(team_id=TEAM_DIRE, hero_name=cfg.hero, control_mode=1),
+                ds.HeroPick(
+                    team_id=TEAM_RADIANT,
+                    hero_name=pool[self.np_rng.randint(len(pool))],
+                    control_mode=1,
+                ),
+                ds.HeroPick(
+                    team_id=TEAM_DIRE,
+                    hero_name=pool[self.np_rng.randint(len(pool))],
+                    control_mode=1,
+                ),
             ],
         )
         resp = await self.stub.reset(config)

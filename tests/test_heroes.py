@@ -1,0 +1,126 @@
+"""Hero pool tests (BASELINE config 3: 1v1 hero-pool, shared LSTM)."""
+
+import numpy as np
+
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env import heroes
+from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+from dotaclient_tpu.protos import dotaservice_pb2 as ds
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+
+
+def pick_cfg(radiant, dire, seed=1):
+    return ds.GameConfig(
+        ticks_per_observation=30,
+        max_dota_time=30.0,
+        seed=seed,
+        hero_picks=[
+            ds.HeroPick(team_id=2, hero_name=radiant, control_mode=1),
+            ds.HeroPick(team_id=3, hero_name=dire, control_mode=0),
+        ],
+    )
+
+
+def test_profiles_cover_pool_and_fallback():
+    assert len(heroes.HEROES) >= 8
+    assert heroes.profile("npc_dota_hero_axe").attack_range == 150
+    assert heroes.profile("not_a_hero") == heroes.profile(heroes.DEFAULT_HERO)
+
+
+def test_parse_pool():
+    assert heroes.parse_pool("a,b, c") == ["a", "b", "c"]
+    assert heroes.parse_pool("solo") == ["solo"]
+    assert heroes.parse_pool("") == [heroes.DEFAULT_HERO]
+
+
+def test_hero_id_features_stable_and_distinct():
+    a = heroes.hero_id_features("npc_dota_hero_axe")
+    b = heroes.hero_id_features("npc_dota_hero_axe")
+    c = heroes.hero_id_features("npc_dota_hero_lina")
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert set(np.unique(a)) <= {-1.0, 1.0}
+    np.testing.assert_array_equal(heroes.hero_id_features(""), np.zeros(heroes.HERO_ID_DIM))
+
+
+def test_env_applies_hero_profiles():
+    svc = FakeDotaService()
+    obs = svc.reset(pick_cfg("npc_dota_hero_axe", "npc_dota_hero_sniper"))
+    world = obs.world_state
+    radiant = F.find_hero(world, 0)
+    dire = F.find_hero(world, 5)
+    axe, sniper = heroes.profile("npc_dota_hero_axe"), heroes.profile("npc_dota_hero_sniper")
+    assert radiant.name == "npc_dota_hero_axe"
+    assert radiant.health_max == axe.hp
+    assert radiant.attack_range == axe.attack_range
+    assert dire.name == "npc_dota_hero_sniper"
+    assert dire.attack_damage == sniper.damage
+
+
+def test_melee_hero_must_close_distance_to_attack():
+    """An axe at range 150 can't hit a creep 500 units away: the attack
+    becomes attack-move (the env walks it in), so position matters."""
+    svc = FakeDotaService()
+    world = svc.reset(pick_cfg("npc_dota_hero_axe", "npc_dota_hero_axe", seed=3)).world_state
+    creeps = [u for u in world.units if u.unit_type == ws.Unit.LANE_CREEP and u.team_id == 3]
+    target = creeps[0]
+    hero0 = F.find_hero(world, 0)
+    svc.act(ds.Actions(actions=[ds.Action(type=ds.Action.ATTACK, player_id=0, target_handle=target.handle)]))
+    world2 = svc.observe(ds.ObserveRequest(team_id=2)).world_state
+    hero1 = F.find_hero(world2, 0)
+    # walked toward the target, dealt no damage yet
+    assert abs(hero1.x - target.x) < abs(hero0.x - target.x)
+
+
+def test_featurizer_exposes_hero_identity():
+    svc = FakeDotaService()
+    w_axe = svc.reset(pick_cfg("npc_dota_hero_axe", "npc_dota_hero_axe")).world_state
+    obs_axe = F.featurize(w_axe, 0)
+    w_lina = svc.reset(pick_cfg("npc_dota_hero_lina", "npc_dota_hero_lina")).world_state
+    obs_lina = F.featurize(w_lina, 0)
+    id_axe, id_lina = obs_axe.hero_feats[16:24], obs_lina.hero_feats[16:24]
+    np.testing.assert_array_equal(id_axe, heroes.hero_id_features("npc_dota_hero_axe"))
+    assert not np.array_equal(id_axe, id_lina)
+
+
+def test_actor_samples_from_pool(monkeypatch):
+    """With a comma-separated pool the actor's GameConfig varies heroes."""
+    import asyncio
+
+    from dotaclient_tpu.config import ActorConfig, PolicyConfig
+    from dotaclient_tpu.env.service import serve
+    from dotaclient_tpu.eval.evaluator import NullBroker
+    from dotaclient_tpu.runtime.actor import Actor
+
+    server, port = serve(FakeDotaService(), max_workers=2)
+    pool = "npc_dota_hero_axe,npc_dota_hero_lina,npc_dota_hero_sniper"
+    cfg = ActorConfig(
+        env_addr=f"127.0.0.1:{port}",
+        rollout_len=4,
+        max_dota_time=2.0,
+        hero=pool,
+        policy=PolicyConfig(unit_embed_dim=8, lstm_hidden=8, mlp_hidden=8, dtype="float32"),
+        seed=5,
+    )
+    actor = Actor(cfg, NullBroker())
+    seen = set()
+    picked = []
+    orig_reset = None
+
+    async def go():
+        stub = actor.stub
+        nonlocal orig_reset
+        orig_reset = stub.reset
+
+        async def spy_reset(config):
+            picked.append(config.hero_picks[0].hero_name)
+            return await orig_reset(config)
+
+        stub.reset = spy_reset
+        for _ in range(6):
+            await actor.run_episode()
+
+    asyncio.new_event_loop().run_until_complete(go())
+    server.stop(0)
+    assert set(picked) <= set(pool.split(","))
+    assert len(set(picked)) >= 2  # sampled, not constant

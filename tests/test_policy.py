@@ -111,7 +111,8 @@ def test_param_count_golden():
     n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(P.init_params(CFG, jax.random.PRNGKey(0))))
     # Catches silent architecture drift; update intentionally when the
     # architecture changes.
-    assert n == 15711, n
+    # 15711 + 8×mlp_hidden when HERO_FEATURES grew 16→24 (hero-id code)
+    assert n == 15967, n
 
 
 def test_unroll_is_jittable_with_scan(params):
