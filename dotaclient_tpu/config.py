@@ -60,6 +60,9 @@ class LearnerConfig:
     log_dir: str = ""
     seed: int = 0
     mesh_shape: str = "dp=-1"  # e.g. "dp=4,tp=2"; -1 = all remaining devices
+    # C++ batch packer on the staging path (falls back to python when the
+    # build/load fails or DOTACLIENT_TPU_NO_NATIVE=1 is set)
+    native_packer: bool = True
 
 
 @dataclass

@@ -1,0 +1,200 @@
+"""Native (C++) host components, loaded via ctypes.
+
+The compute path is JAX/XLA; the host runtime around it is native where
+the throughput demands it. Currently: the rollout batch packer
+(packer.cc), built on demand with g++ into this directory and loaded
+with ctypes (the image has no pybind11 — the C ABI needs none).
+
+`load_packer()` returns None when native is unavailable (no compiler,
+build failure, or DOTACLIENT_TPU_NO_NATIVE=1); callers fall back to the
+pure-python path. Never raises at import time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "packer.cc")
+_LIB = os.path.join(_DIR, "_packer.so")
+
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+
+
+def _build() -> bool:
+    """(Re)build _packer.so when missing or older than the source.
+    Atomic: compile to a temp file, then os.replace — concurrent
+    processes race harmlessly."""
+    tmp = None
+    try:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return True
+        fd, tmp = tempfile.mkstemp(suffix=".so.tmp", dir=_DIR)
+        os.close(fd)
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            _log.warning("native packer build failed:\n%s", proc.stderr)
+            return False
+        os.replace(tmp, _LIB)
+        tmp = None
+        return True
+    except Exception as e:
+        _log.warning("native packer build error: %s", e)
+        return False
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_packer() -> Optional[ctypes.CDLL]:
+    """The compiled packer library, or None (python fallback)."""
+    global _cached, _load_failed
+    if _cached is not None:
+        return _cached
+    if _load_failed or os.environ.get("DOTACLIENT_TPU_NO_NATIVE", "") not in ("", "0"):
+        return None
+    with _lock:
+        if _cached is not None:
+            return _cached
+        if not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            _log.warning("native packer load failed: %s", e)
+            _load_failed = True
+            return None
+        lib.dt_pack_batch.restype = ctypes.c_int64
+        lib.dt_frame_header.restype = ctypes.c_int64
+        _cached = lib
+        return lib
+
+
+# ---------------------------------------------------------------------------
+# High-level wrappers (numpy in, numpy out).
+
+
+def _schema_dims():
+    from dotaclient_tpu.env import featurizer as F
+
+    return (F.GLOBAL_FEATURES, F.HERO_FEATURES, F.MAX_UNITS, F.UNIT_FEATURES, F.N_ACTION_TYPES)
+
+
+def frame_header(lib: ctypes.CDLL, frame: bytes) -> Optional[Tuple[int, int, int, int, int, float, float]]:
+    """(version, L, H, flags, actor_id, episode_return, last_done) or None
+    if the frame is malformed. Validates the full frame size."""
+    G, HF, U, UF, A = _schema_dims()
+    version = ctypes.c_int64()
+    L = ctypes.c_int64()
+    H = ctypes.c_int64()
+    flags = ctypes.c_int64()
+    actor_id = ctypes.c_int64()
+    ep_ret = ctypes.c_float()
+    last_done = ctypes.c_float()
+    rc = lib.dt_frame_header(
+        ctypes.cast(ctypes.c_char_p(frame), _u8p),
+        ctypes.c_int64(len(frame)),
+        *(ctypes.c_int64(d) for d in (G, HF, U, UF, A)),
+        ctypes.byref(version),
+        ctypes.byref(L),
+        ctypes.byref(H),
+        ctypes.byref(flags),
+        ctypes.byref(actor_id),
+        ctypes.byref(ep_ret),
+        ctypes.byref(last_done),
+    )
+    if rc != 0:
+        return None
+    return (
+        version.value,
+        L.value,
+        H.value,
+        flags.value,
+        actor_id.value,
+        ep_ret.value,
+        last_done.value,
+    )
+
+
+def pack_frames(lib: ctypes.CDLL, frames: List[bytes], seq_len: int, lstm_hidden: int, with_aux: bool):
+    """Pack B wire frames into one padded TrainBatch (numpy leaves).
+
+    Raises ValueError naming the offending frame index if any frame is
+    malformed — mirroring the python packer's contract.
+    """
+    from dotaclient_tpu.ops.batch import zeros_train_batch
+
+    n = len(frames)
+    batch = zeros_train_batch(n, seq_len, lstm_hidden, with_aux)
+    G, HF, U, UF, A = _schema_dims()
+
+    frame_ptrs = (ctypes.c_char_p * n)(*frames)
+    frame_lens = (ctypes.c_int64 * n)(*[len(f) for f in frames])
+    versions = np.zeros(n, np.uint32)
+    actor_ids = np.zeros(n, np.uint32)
+    ep_returns = np.zeros(n, np.float32)
+
+    def fp(a):
+        return a.ctypes.data_as(_f32p)
+
+    def u8(a):
+        return a.ctypes.data_as(_u8p)
+
+    def i32(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    obs, acts, aux = batch.obs, batch.actions, batch.aux
+    rc = lib.dt_pack_batch(
+        ctypes.cast(frame_ptrs, ctypes.POINTER(_u8p)),
+        frame_lens,
+        ctypes.c_int64(n),
+        ctypes.c_int64(seq_len),
+        ctypes.c_int64(lstm_hidden),
+        ctypes.c_int64(1 if with_aux else 0),
+        *(ctypes.c_int64(d) for d in (G, HF, U, UF, A)),
+        fp(obs.global_feats),
+        fp(obs.hero_feats),
+        fp(obs.unit_feats),
+        u8(obs.unit_mask),
+        u8(obs.target_mask),
+        u8(obs.action_mask),
+        i32(acts.type),
+        i32(acts.move_x),
+        i32(acts.move_y),
+        i32(acts.target),
+        fp(batch.behavior_logp),
+        fp(batch.behavior_value),
+        fp(batch.rewards),
+        fp(batch.dones),
+        fp(batch.mask),
+        fp(batch.initial_state[0]),
+        fp(batch.initial_state[1]),
+        fp(aux.win) if aux is not None else None,
+        fp(aux.last_hit) if aux is not None else None,
+        fp(aux.net_worth) if aux is not None else None,
+        versions.ctypes.data_as(_u32p),
+        actor_ids.ctypes.data_as(_u32p),
+        ep_returns.ctypes.data_as(_f32p),
+    )
+    if rc != 0:
+        raise ValueError(f"native packer rejected frame {-rc - 1}")
+    return batch

@@ -1,0 +1,191 @@
+// Native host packer: rollout wire frames -> padded [B, T] batch arrays.
+//
+// This is the one place the rebuild owes a native component (SURVEY.md §2,
+// §7 "Throughput of host-side packing"): the learner host must unpack and
+// pad experience frames fast enough to feed the TPU at the north-star
+// 50k env-steps/s, and the reference's pickle+python-loop equivalent is
+// the bottleneck there. The wire format (transport/serialize.py) is a
+// fixed little-endian layout designed to be read without a Python
+// runtime; here each field is a single bounds-checked memcpy straight
+// from the frame into its [b, :L] slice of the batch.
+//
+// C ABI only (loaded via ctypes — no pybind11 in the image). The caller
+// owns every buffer; outputs are the numpy arrays of a zeros_train_batch
+// (padding rows stay as Python initialized them, e.g. NOOP-legal action
+// masks). ctypes releases the GIL around the call, so batch packing
+// overlaps the device step.
+//
+// Frame layout (transport/serialize.py, little-endian):
+//   magic 'DTR1' | u32 version | u16 L | u16 H | u8 flags | u32 actor_id
+//   | f32 episode_return | arrays in fixed order (shapes derive from L/H
+//   and the schema dims passed in by the caller).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int64_t kHeaderBytes = 21;
+constexpr uint8_t kFlagAux = 1;
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok;
+
+  void copy(void* dst, int64_t n) {
+    if (!ok || p + n > end) {
+      ok = false;
+      return;
+    }
+    std::memcpy(dst, p, n);
+    p += n;
+  }
+  // Masks land in numpy bool arrays: normalize every byte to 0/1 (the
+  // python path's astype(bool) does the same; raw !=1 bytes from an
+  // untrusted peer must not create invalid bool storage).
+  void copy_bool(uint8_t* dst, int64_t n) {
+    if (!ok || p + n > end) {
+      ok = false;
+      return;
+    }
+    for (int64_t i = 0; i < n; ++i) dst[i] = p[i] ? 1 : 0;
+    p += n;
+  }
+  void skip(int64_t n) {
+    if (!ok || p + n > end) {
+      ok = false;
+      return;
+    }
+    p += n;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, -(b+1) if frame b is malformed or inconsistent
+// with (T, H, schema dims). On error the outputs may be partially
+// written; the caller discards the batch.
+int64_t dt_pack_batch(
+    const uint8_t** frames, const int64_t* frame_lens, int64_t n,
+    int64_t T, int64_t H, int64_t want_aux,
+    // schema dims: global, hero, units, unit-features, action-types
+    int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
+    // batch outputs (C-contiguous, leading dim n):
+    float* global_f,   // [n, T+1, G]
+    float* hero_f,     // [n, T+1, HF]
+    float* unit_f,     // [n, T+1, U, UF]
+    uint8_t* unit_m,   // [n, T+1, U]
+    uint8_t* target_m, // [n, T+1, U]
+    uint8_t* action_m, // [n, T+1, A]
+    int32_t* act_type, int32_t* act_mx, int32_t* act_my, int32_t* act_tg,  // [n, T]
+    float* logp, float* value, float* rewards, float* dones, float* mask,  // [n, T]
+    float* init_c, float* init_h,  // [n, H]
+    float* aux_win, float* aux_lh, float* aux_nw,  // [n, T] or nullptr
+    // per-frame metadata:
+    uint32_t* versions, uint32_t* actor_ids, float* ep_returns) {
+  const int64_t T1o = T + 1;  // output time rows per sequence
+  for (int64_t b = 0; b < n; ++b) {
+    const uint8_t* p = frames[b];
+    const int64_t len = frame_lens[b];
+    if (len < kHeaderBytes || std::memcmp(p, "DTR1", 4) != 0) return -(b + 1);
+
+    uint32_t version, actor_id;
+    uint16_t L16, H16;
+    uint8_t flags;
+    float ep_ret;
+    std::memcpy(&version, p + 4, 4);
+    std::memcpy(&L16, p + 8, 2);
+    std::memcpy(&H16, p + 10, 2);
+    flags = p[12];
+    std::memcpy(&actor_id, p + 13, 4);
+    std::memcpy(&ep_ret, p + 17, 4);
+
+    const int64_t L = L16;
+    if (L > T || L < 0 || H16 != H) return -(b + 1);
+    const bool frame_aux = (flags & kFlagAux) != 0;
+    const int64_t T1 = L + 1;
+
+    const int64_t expect = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
+                           T1 * (2 * U + A) + L * 8 * 4 + H * 2 * 4 +
+                           (frame_aux ? L * 3 * 4 : 0);
+    if (len != expect) return -(b + 1);
+
+    Reader r{p + kHeaderBytes, p + len, true};
+    r.copy(global_f + b * T1o * G, T1 * G * 4);
+    r.copy(hero_f + b * T1o * HF, T1 * HF * 4);
+    r.copy(unit_f + b * T1o * U * UF, T1 * U * UF * 4);
+    r.copy_bool(unit_m + b * T1o * U, T1 * U);
+    r.copy_bool(target_m + b * T1o * U, T1 * U);
+    r.copy_bool(action_m + b * T1o * A, T1 * A);
+    r.copy(act_type + b * T, L * 4);
+    r.copy(act_mx + b * T, L * 4);
+    r.copy(act_my + b * T, L * 4);
+    r.copy(act_tg + b * T, L * 4);
+    r.copy(logp + b * T, L * 4);
+    r.copy(value + b * T, L * 4);
+    r.copy(rewards + b * T, L * 4);
+    r.copy(dones + b * T, L * 4);
+    r.copy(init_c + b * H, H * 4);
+    r.copy(init_h + b * H, H * 4);
+    if (frame_aux) {
+      if (want_aux && aux_win != nullptr) {
+        r.copy(aux_win + b * T, L * 4);
+        r.copy(aux_lh + b * T, L * 4);
+        r.copy(aux_nw + b * T, L * 4);
+      } else {
+        r.skip(L * 3 * 4);
+      }
+    }
+    if (!r.ok) return -(b + 1);
+
+    float* m = mask + b * T;
+    for (int64_t t = 0; t < L; ++t) m[t] = 1.0f;
+    versions[b] = version;
+    actor_ids[b] = actor_id;
+    ep_returns[b] = ep_ret;
+  }
+  return 0;
+}
+
+// Header peek for the ingest filter: writes {version, L, H, flags,
+// actor_id} and returns the episode_return via *ep_ret. Returns 0 if the
+// header is well-formed and the total size matches, else -1.
+int64_t dt_frame_header(
+    const uint8_t* p, int64_t len,
+    int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
+    int64_t* version, int64_t* L_out, int64_t* H_out, int64_t* flags_out,
+    int64_t* actor_id, float* ep_ret, float* last_done) {
+  if (len < kHeaderBytes || std::memcmp(p, "DTR1", 4) != 0) return -1;
+  uint32_t v, aid;
+  uint16_t L16, H16;
+  std::memcpy(&v, p + 4, 4);
+  std::memcpy(&L16, p + 8, 2);
+  std::memcpy(&H16, p + 10, 2);
+  const uint8_t flags = p[12];
+  std::memcpy(&aid, p + 13, 4);
+  std::memcpy(ep_ret, p + 17, 4);
+  const int64_t L = L16, H = H16, T1 = L + 1;
+  const bool aux = (flags & kFlagAux) != 0;
+  const int64_t expect = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
+                         T1 * (2 * U + A) + L * 8 * 4 + H * 2 * 4 +
+                         (aux ? L * 3 * 4 : 0);
+  if (len != expect) return -1;
+  // last element of the dones array (episode-end marker for stats)
+  *last_done = 0.0f;
+  if (L > 0) {
+    const int64_t dones_off = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
+                              T1 * (2 * U + A) + L * 7 * 4;
+    std::memcpy(last_done, p + dones_off + (L - 1) * 4, 4);
+  }
+  *version = v;
+  *L_out = L;
+  *H_out = H;
+  *flags_out = flags;
+  *actor_id = aid;
+  return 0;
+}
+
+}  // extern "C"

@@ -71,7 +71,15 @@ def pack_rollouts(rollouts: List[Rollout], seq_len: int, with_aux: bool) -> Trai
 
 
 class StagingBuffer:
-    """Consume → filter → pack pipeline feeding the train loop."""
+    """Consume → filter → pack pipeline feeding the train loop.
+
+    Two packing paths, identical output:
+    - native (default): frames are header-validated in C and kept as raw
+      bytes; a whole batch packs in one C call (one memcpy per field,
+      GIL released — packing overlaps the device step);
+    - python fallback: full deserialize + per-field numpy copies
+      (DOTACLIENT_TPU_NO_NATIVE=1, no compiler, or native_packer=False).
+    """
 
     def __init__(
         self,
@@ -82,10 +90,16 @@ class StagingBuffer:
         self.cfg = cfg
         self.broker = broker
         self.version_fn = version_fn
-        self._pending: List[Rollout] = []
+        # python path: Rollout objects; native path: raw frame bytes
+        self._pending: List = []
         self._ready: "queue.Queue[TrainBatch]" = queue.Queue(maxsize=2)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._lib = None
+        if getattr(cfg, "native_packer", True):
+            from dotaclient_tpu import native
+
+            self._lib = native.load_packer()
         self._stats_lock = threading.Lock()
         self._stats = {
             "consumed": 0,
@@ -96,6 +110,10 @@ class StagingBuffer:
             "episodes": 0,
             "consumer_errors": 0,
         }
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
 
     # -- consumer thread -------------------------------------------------
 
@@ -112,8 +130,17 @@ class StagingBuffer:
                 if frames:
                     self._ingest(frames)
                 while len(self._pending) >= B:
-                    batch = pack_rollouts(self._pending[:B], self.cfg.seq_len, self.cfg.policy.aux_heads)
+                    items = self._pending[:B]
                     del self._pending[:B]
+                    try:
+                        batch = self._pack(items)
+                    except ValueError:
+                        # a frame passed ingest validation but failed the
+                        # packer — drop the batch, never livelock on it
+                        _log.exception("packer rejected a batch; dropping %d frames", len(items))
+                        with self._stats_lock:
+                            self._stats["dropped_bad"] += len(items)
+                        continue
                     with self._stats_lock:
                         self._stats["batches"] += 1
                     while not self._stop.is_set():
@@ -129,6 +156,38 @@ class StagingBuffer:
                 with self._stats_lock:
                     self._stats["consumer_errors"] += 1
 
+    def _pack(self, items: List) -> TrainBatch:
+        if self._lib is not None:
+            from dotaclient_tpu import native
+
+            return native.pack_frames(
+                self._lib,
+                items,
+                self.cfg.seq_len,
+                self.cfg.policy.lstm_hidden,
+                self.cfg.policy.aux_heads,
+            )
+        return pack_rollouts(items, self.cfg.seq_len, self.cfg.policy.aux_heads)
+
+    def _parse(self, frame: bytes):
+        """One frame → (pending_item, version, L, H, ep_return, last_done)
+        or None if malformed. Native keeps raw bytes (the C packer reads
+        them later); python keeps the deserialized Rollout."""
+        if self._lib is not None:
+            from dotaclient_tpu import native
+
+            hdr = native.frame_header(self._lib, frame)
+            if hdr is None:
+                return None
+            version, L, frame_h, _flags, _actor, ep_ret, last_done = hdr
+            return frame, version, L, frame_h, ep_ret, last_done
+        try:
+            r = deserialize_rollout(frame)
+        except (ValueError, KeyError):
+            return None
+        last_done = float(r.dones[-1]) if r.length else 0.0
+        return r, r.version, r.length, r.initial_state[0].shape[-1], r.episode_return, last_done
+
     def _ingest(self, frames: List[bytes]) -> None:
         min_version = self.version_fn() - self.cfg.ppo.max_staleness
         H = self.cfg.policy.lstm_hidden
@@ -136,23 +195,23 @@ class StagingBuffer:
         ep_ret = 0.0
         for frame in frames:
             consumed += 1
-            try:
-                r = deserialize_rollout(frame)
-            except (ValueError, KeyError):
+            parsed = self._parse(frame)
+            if parsed is None:
                 dropped_bad += 1
                 continue
+            item, version, L, frame_h, frame_ret, last_done = parsed
             # Per-frame config validation happens HERE so one misconfigured
             # actor can only ever cost its own frames, never the pack step.
-            if r.length > self.cfg.seq_len or r.initial_state[0].shape[-1] != H:
+            if L > self.cfg.seq_len or frame_h != H:
                 dropped_bad += 1
                 continue
-            if r.version < min_version:
+            if version < min_version:
                 dropped_stale += 1
                 continue
-            if r.length and r.dones[-1] > 0:
+            if L and last_done > 0:
                 episodes += 1
-                ep_ret += r.episode_return
-            self._pending.append(r)
+                ep_ret += frame_ret
+            self._pending.append(item)
         with self._stats_lock:
             self._stats["consumed"] += consumed
             self._stats["dropped_stale"] += dropped_stale

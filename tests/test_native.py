@@ -1,0 +1,138 @@
+"""Native (C++) batch packer tests: build, parity with the python
+packer, validation, and the staging-buffer native path (SURVEY.md §2
+native-component note, §7 "Throughput of host-side packing")."""
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu import native
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.runtime.staging import StagingBuffer, pack_rollouts
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect
+from dotaclient_tpu.transport.serialize import serialize_rollout
+from tests.test_transport import make_rollout
+
+lib = native.load_packer()
+pytestmark = pytest.mark.skipif(lib is None, reason="native packer unavailable")
+
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="float32")
+
+
+def leaves_equal(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("aux", [False, True])
+def test_pack_parity_with_python(aux):
+    rollouts = [make_rollout(L=L, H=8, version=i, seed=i, aux=aux) for i, L in enumerate([4, 8, 1, 8])]
+    frames = [serialize_rollout(r) for r in rollouts]
+    py = pack_rollouts(rollouts, seq_len=8, with_aux=aux)
+    nat = native.pack_frames(lib, frames, seq_len=8, lstm_hidden=8, with_aux=aux)
+    leaves_equal(py, nat)
+
+
+def test_pack_aux_frames_into_no_aux_batch():
+    """Frames carrying aux targets pack cleanly into a batch that doesn't
+    want them (the aux block is skipped, not misparsed)."""
+    rollouts = [make_rollout(L=4, H=8, seed=s, aux=True) for s in range(2)]
+    frames = [serialize_rollout(r) for r in rollouts]
+    py = pack_rollouts(rollouts, seq_len=8, with_aux=False)
+    nat = native.pack_frames(lib, frames, seq_len=8, lstm_hidden=8, with_aux=False)
+    leaves_equal(py, nat)
+
+
+def test_padding_preserved():
+    """Rows beyond L keep the zeros-batch padding (NOOP-legal masks)."""
+    r = make_rollout(L=2, H=8, seed=1)
+    nat = native.pack_frames(lib, [serialize_rollout(r)], seq_len=8, lstm_hidden=8, with_aux=False)
+    assert nat.mask[0, :2].sum() == 2.0 and nat.mask[0, 2:].sum() == 0.0
+    # padded action_mask rows stay NOOP-legal (uniform-safe log-softmax)
+    assert np.all(nat.obs.action_mask[0, 3:, 0])
+
+
+def test_malformed_frame_rejected():
+    good = serialize_rollout(make_rollout(L=4, H=8, seed=0))
+    with pytest.raises(ValueError, match="frame 1"):
+        native.pack_frames(lib, [good, good[:-5]], seq_len=8, lstm_hidden=8, with_aux=False)
+    with pytest.raises(ValueError):
+        native.pack_frames(lib, [b"DTR1" + b"\x00" * 40], seq_len=8, lstm_hidden=8, with_aux=False)
+    # L exceeding the learner seq_len is a config mismatch, not packable
+    with pytest.raises(ValueError):
+        native.pack_frames(lib, [serialize_rollout(make_rollout(L=9, H=8))], seq_len=8, lstm_hidden=8, with_aux=False)
+
+
+def test_mask_bytes_normalized_to_bool():
+    """Wire mask bytes >1 (hostile/buggy peer) must land as clean bools,
+    matching the python path's astype(bool)."""
+    r = make_rollout(L=2, H=8, seed=0)
+    frame = bytearray(serialize_rollout(r))
+    # unit_mask starts right after the three f32 obs arrays
+    import dotaclient_tpu.env.featurizer as F
+
+    T1 = 3
+    off = 21 + T1 * (F.GLOBAL_FEATURES + F.HERO_FEATURES + F.MAX_UNITS * F.UNIT_FEATURES) * 4
+    frame[off] = 255  # a "true" that isn't 1
+    nat = native.pack_frames(lib, [bytes(frame)], seq_len=8, lstm_hidden=8, with_aux=False)
+    m = np.asarray(nat.obs.unit_mask)
+    assert m.dtype == bool
+    assert m[0, 0, 0] == True  # normalized, not raw 255
+    assert set(np.unique(m.view(np.uint8))) <= {0, 1}
+
+
+def test_frame_header_fields():
+    r = make_rollout(L=5, H=8, version=7, actor_id=42, seed=3)
+    hdr = native.frame_header(lib, serialize_rollout(r))
+    version, L, H, flags, actor_id, ep_ret, last_done = hdr
+    assert (version, L, H, actor_id) == (7, 5, 8, 42)
+    assert ep_ret == pytest.approx(1.25)
+    assert last_done == 1.0  # make_rollout ends the episode
+    assert native.frame_header(lib, b"") is None
+    assert native.frame_header(lib, b"XXXX" + b"\x00" * 30) is None
+
+
+def test_staging_buffer_native_path_matches_python():
+    def run(native_packer):
+        name = f"nat{int(native_packer)}"
+        mem.reset(name)
+        broker = connect(f"mem://{name}")
+        cfg = LearnerConfig(batch_size=4, seq_len=8, policy=SMALL, native_packer=native_packer)
+        st = StagingBuffer(cfg, broker, version_fn=lambda: 100)
+        for i in range(4):
+            broker.publish_experience(serialize_rollout(make_rollout(L=4 + i, H=8, version=100, seed=i)))
+        # one corrupt + one stale frame must be dropped in both paths
+        broker.publish_experience(b"DTR1 corrupt")
+        stale = make_rollout(L=4, H=8, version=3, seed=9)  # 100-4 > 3
+        broker.publish_experience(serialize_rollout(stale))
+        st.start()
+        batch = st.get_batch(timeout=30.0)
+        # the batch can be ready before the trailing bad/stale frames are
+        # consumed — wait for all 6 frames to be accounted for
+        import time
+
+        deadline = time.time() + 10
+        while st.stats()["consumed"] < 6 and time.time() < deadline:
+            time.sleep(0.05)
+        stats = st.stats()
+        st.stop()
+        return batch, stats
+
+    nat_batch, nat_stats = run(True)
+    py_batch, py_stats = run(False)
+    assert nat_stats["dropped_bad"] == py_stats["dropped_bad"] == 1
+    assert nat_stats["dropped_stale"] == py_stats["dropped_stale"] == 1
+    assert nat_stats["episodes"] == py_stats["episodes"]
+    assert nat_stats["episode_return_sum"] == pytest.approx(py_stats["episode_return_sum"])
+    leaves_equal(nat_batch, py_batch)
+
+
+def test_staging_reports_native_flag():
+    mem.reset("natflag")
+    cfg = LearnerConfig(batch_size=4, seq_len=8, policy=SMALL)
+    st = StagingBuffer(cfg, connect("mem://natflag"))
+    assert st.native is True
