@@ -25,6 +25,9 @@ class PolicyConfig:
     # Auxiliary value heads (benchmark config 5: win-prob, last-hit, net-worth).
     aux_heads: bool = False
     dtype: str = "bfloat16"  # compute dtype on TPU; params stay f32
+    # LSTM recurrence implementation (ops/lstm.py): "auto" = fused Pallas
+    # kernel on TPU when the block fits VMEM, lax.scan elsewhere.
+    lstm_impl: str = "auto"
 
 
 @dataclass

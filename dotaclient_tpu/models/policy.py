@@ -31,6 +31,7 @@ from flax import linen as nn
 
 from dotaclient_tpu.config import PolicyConfig
 from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.ops import lstm as L
 from dotaclient_tpu.ops.action_dist import BIG_NEG, Dist, masked_log_softmax
 
 LSTMState = Tuple[jnp.ndarray, jnp.ndarray]  # (c, h), each [B, H]
@@ -56,35 +57,51 @@ def _dtype(cfg: PolicyConfig):
 
 
 class LSTMCell(nn.Module):
-    """Fused-gate LSTM cell: one [x;h] @ W matmul for all four gates.
-
-    Kept hand-rolled (rather than flax's OptimizedLSTMCell) so the gate
-    matmul + elementwise tail can be swapped for a Pallas kernel without
-    changing the parameter layout. Forget-gate bias +1.
+    """LSTM with a split gate matmul: x and h project separately so the
+    x half hoists out of the time loop entirely (ONE [B·T, in]×[in, 4H]
+    MXU matmul per unroll), and the sequential remainder — the [B, H]
+    hidden projection + gate tail — runs through ops/lstm.py, where a
+    fused Pallas kernel serves the TPU path and lax.scan everything
+    else. Forget-gate bias +1; gate math f32, matmuls in `dtype`.
     """
 
     features: int
     dtype: jnp.dtype = jnp.bfloat16
+    impl: str = "auto"  # ops/lstm.py dispatcher: auto|scan|pallas|pallas_interpret
 
     @nn.compact
-    def __call__(self, carry: LSTMState, x: jnp.ndarray) -> Tuple[LSTMState, jnp.ndarray]:
+    def __call__(
+        self, carry: LSTMState, x: jnp.ndarray, unroll: bool = False
+    ) -> Tuple[LSTMState, jnp.ndarray]:
+        H = self.features
+        dt = self.dtype
+        w_x = self.param("w_x", nn.initializers.lecun_normal(), (x.shape[-1], 4 * H))
+        w_h = self.param("w_h", nn.initializers.lecun_normal(), (H, 4 * H))
+        bias = self.param("bias", nn.initializers.zeros_init(), (4 * H,))
         c, h = carry
-        z = nn.Dense(4 * self.features, dtype=self.dtype, name="gates")(
-            jnp.concatenate([x, h.astype(self.dtype)], axis=-1)
-        )
-        i, f, g, o = jnp.split(z.astype(jnp.float32), 4, axis=-1)
-        new_c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
-        return (new_c, new_h), new_h
+        x_proj = x.astype(dt) @ w_x.astype(dt) + bias.astype(dt)
+        if not unroll:
+            z = x_proj + h.astype(dt) @ w_h.astype(dt)
+            new_c, new_h = L.gates(z, c)
+            return (new_c, new_h), new_h
+        h_seq, (c_T, h_T) = L.lstm_recurrence(x_proj, w_h.astype(dt), c, h, impl=self.impl)
+        return (c_T, h_T), h_seq
 
 
 class PolicyCore(nn.Module):
-    """One policy step: featurized obs + LSTM state → action dist + value."""
+    """The policy network: featurized obs + LSTM state → action dist +
+    value. One module, both modes — single step (obs leaves [B, ...])
+    and teacher-forced unroll (obs leaves [B, T, ...]). Every layer here
+    except the LSTM recurrence is position-independent, so in unroll mode
+    the embeddings, trunk, and heads all run as single [B·T] batched MXU
+    matmuls; only the recurrence (ops/lstm.py) walks the time axis."""
 
     cfg: PolicyConfig
 
     @nn.compact
-    def __call__(self, carry: LSTMState, obs: F.Observation) -> Tuple[LSTMState, PolicyOutput]:
+    def __call__(
+        self, carry: LSTMState, obs: F.Observation, unroll: bool = False
+    ) -> Tuple[LSTMState, PolicyOutput]:
         cfg = self.cfg
         dt = _dtype(cfg)
         D = cfg.unit_embed_dim
@@ -111,7 +128,9 @@ class PolicyCore(nn.Module):
 
         # LSTM output stays f32: every head below computes in f32, so a
         # bf16 round-trip here would be pure precision loss.
-        carry, out = LSTMCell(cfg.lstm_hidden, dtype=dt, name="lstm")(carry, trunk)
+        carry, out = LSTMCell(cfg.lstm_hidden, dtype=dt, impl=cfg.lstm_impl, name="lstm")(
+            carry, trunk, unroll=unroll
+        )
 
         # Heads — logits in f32 for stable masking/softmax.
         type_logits = nn.Dense(F.N_ACTION_TYPES, dtype=jnp.float32, name="type_head")(out)
@@ -148,7 +167,8 @@ class PolicyNet(nn.Module):
     - `apply(params, state, obs_seq, unroll=True)` — teacher-forced unroll,
       obs leaves [B, T, ...]; returns outputs with a [B, T] time axis and
       the final LSTM state.
-    Params are identical between the two modes (scan broadcasts them).
+    Params are identical between the two modes (every layer is shared;
+    the time axis only exists inside the LSTM recurrence).
     """
 
     cfg: PolicyConfig
@@ -159,16 +179,7 @@ class PolicyNet(nn.Module):
     @nn.compact
     def __call__(self, state: LSTMState, obs: F.Observation, unroll: bool = False):
         self._assert_shapes(obs)
-        if not unroll:
-            return PolicyCore(self.cfg, name="core")(state, obs)
-        scan = nn.scan(
-            PolicyCore,
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            in_axes=1,
-            out_axes=1,
-        )
-        return scan(self.cfg, name="core")(state, obs)
+        return PolicyCore(self.cfg, name="core")(state, obs, unroll)
 
 def initial_state(cfg: PolicyConfig, batch_shape) -> LSTMState:
     """LSTM zero-state without needing a module instance (host-side use)."""

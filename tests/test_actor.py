@@ -101,7 +101,9 @@ def test_actor_aux_targets(env):
     last = deserialize_rollout(frames[-1])
     assert last.aux is not None
     assert set(np.unique(last.aux.win)) <= {-1.0, 0.0, 1.0}
-    assert (last.aux.win != 0).all()  # final chunk knows the result
+    # final chunk carries the episode result (0.0 only for a decided draw)
+    assert actor.last_win is not None
+    assert (last.aux.win == actor.last_win).all()
 
 
 def test_actor_multi_episode_counts(env):

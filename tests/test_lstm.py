@@ -1,0 +1,134 @@
+"""LSTM recurrence tests: scan reference vs Pallas kernel (interpret
+mode on CPU), forward + custom-VJP backward parity, dispatcher."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.ops import lstm as L
+
+
+def make_inputs(B=4, T=6, H=8, seed=0, dtype=jnp.float32):
+    r = np.random.RandomState(seed)
+    x_proj = jnp.asarray(r.randn(B, T, 4 * H), dtype)
+    w_h = jnp.asarray(r.randn(H, 4 * H) * 0.1, dtype)
+    c0 = jnp.asarray(r.randn(B, H), jnp.float32)
+    h0 = jnp.asarray(r.randn(B, H), jnp.float32)
+    return x_proj, w_h, c0, h0
+
+
+def test_scan_shapes_and_finiteness():
+    x_proj, w_h, c0, h0 = make_inputs()
+    h_seq, (c_T, h_T) = L.lstm_scan(x_proj, w_h, c0, h0)
+    assert h_seq.shape == (4, 6, 8)
+    assert c_T.shape == h_T.shape == (4, 8)
+    assert np.all(np.isfinite(h_seq))
+    # last h in the sequence IS the final carry
+    np.testing.assert_allclose(np.asarray(h_seq[:, -1]), np.asarray(h_T), rtol=1e-6)
+
+
+def test_scan_matches_manual_single_steps():
+    x_proj, w_h, c0, h0 = make_inputs(T=3)
+    h_seq, _ = L.lstm_scan(x_proj, w_h, c0, h0)
+    c, h = c0, h0
+    for t in range(3):
+        z = x_proj[:, t] + h @ w_h
+        c, h = L.gates(z, c)
+        np.testing.assert_allclose(np.asarray(h_seq[:, t]), np.asarray(h), rtol=1e-5)
+
+
+def test_pallas_interpret_matches_scan_forward():
+    x_proj, w_h, c0, h0 = make_inputs(B=4, T=6, H=8, seed=1)
+    ref, (rc, rh) = L.lstm_scan(x_proj, w_h, c0, h0)
+    out, (oc, oh) = L.lstm_recurrence(x_proj, w_h, c0, h0, impl="pallas_interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(oc), np.asarray(rc), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(oh), np.asarray(rh), rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_backward_matches_scan_grads():
+    """The hand-written recompute VJP must agree with autodiff through
+    the scan on every input gradient."""
+    x_proj, w_h, c0, h0 = make_inputs(B=2, T=5, H=8, seed=2)
+
+    def loss(fn):
+        def go(xp, w, c, h):
+            h_seq, (c_T, h_T) = fn(xp, w, c, h)
+            # touch sequence outputs AND final carries so every grad path runs
+            return jnp.sum(h_seq**2) + jnp.sum(c_T * 0.3) + jnp.sum(h_T * 0.7)
+
+        return jax.grad(go, argnums=(0, 1, 2, 3))
+
+    ref_grads = loss(L.lstm_scan)(x_proj, w_h, c0, h0)
+    pal_grads = loss(lambda *a: L.lstm_recurrence(*a, impl="pallas_interpret"))(
+        x_proj, w_h, c0, h0
+    )
+    for name, a, b in zip(("x_proj", "w_h", "c0", "h0"), ref_grads, pal_grads):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5, err_msg=name
+        )
+
+
+def test_backward_with_carried_state_chain():
+    """Grads flow through c0/h0 when chunks chain (state handoff)."""
+    x_proj, w_h, c0, h0 = make_inputs(B=2, T=4, H=8, seed=3)
+
+    def go(c, h):
+        h_seq, (c_T, h_T) = L.lstm_recurrence(x_proj, w_h, c, h, impl="pallas_interpret")
+        return jnp.sum(h_seq)
+
+    g_c, g_h = jax.grad(go, argnums=(0, 1))(c0, h0)
+    assert np.any(np.asarray(g_c) != 0) and np.any(np.asarray(g_h) != 0)
+
+
+def test_dispatcher_auto_on_cpu_is_scan():
+    x_proj, w_h, c0, h0 = make_inputs()
+    # on the CPU test backend auto must not try to lower a TPU kernel
+    out, _ = L.lstm_recurrence(x_proj, w_h, c0, h0, impl="auto")
+    ref, _ = L.lstm_scan(x_proj, w_h, c0, h0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_dispatcher_rejects_unknown():
+    x_proj, w_h, c0, h0 = make_inputs()
+    with pytest.raises(ValueError):
+        L.lstm_recurrence(x_proj, w_h, c0, h0, impl="bogus")
+
+
+def test_vmem_guard():
+    assert L._pallas_ok(jnp.zeros((128, 16, 512)))
+    # an odd batch still fits as one (padded) slab
+    assert L._pallas_ok(jnp.zeros((130, 16, 512)))
+    # too big for VMEM at any slab size
+    assert not L._pallas_ok(jnp.zeros((1024, 2048, 4096)))
+    # slab sizing: divisor of B, multiple of 32 (or the whole batch)
+    assert L._block_b(256, 16, 256, 2) in (32, 64, 128, 256)
+
+
+def test_bf16_inputs_stay_finite():
+    x_proj, w_h, c0, h0 = make_inputs(dtype=jnp.bfloat16, seed=4)
+    h_seq, (c_T, h_T) = L.lstm_recurrence(x_proj, w_h, c0, h0, impl="pallas_interpret")
+    assert h_seq.dtype == jnp.float32  # gate math promotes
+    assert np.all(np.isfinite(np.asarray(h_seq, np.float32)))
+
+
+def test_bf16_scan_and_pallas_compute_identical_function():
+    """All impls use f32 matmul accumulation, so bf16 inputs give the
+    SAME forward outputs and closely matching grads — flipping lstm_impl
+    must not perturb actor-vs-learner logp consistency."""
+    x_proj, w_h, c0, h0 = make_inputs(B=4, T=5, H=8, seed=5, dtype=jnp.bfloat16)
+    ref, (rc, rh) = L.lstm_scan(x_proj, w_h, c0, h0)
+    out, (oc, oh) = L.lstm_recurrence(x_proj, w_h, c0, h0, impl="pallas_interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(oc), np.asarray(rc), rtol=1e-6, atol=1e-7)
+
+    def g(fn):
+        return jax.grad(
+            lambda xp, w: jnp.sum(fn(xp, w, c0, h0)[0] ** 2), argnums=(0, 1)
+        )(x_proj, w_h)
+
+    for a, b in zip(g(L.lstm_scan), g(lambda *s: L.lstm_recurrence(*s, impl="pallas_interpret"))):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=2e-2, atol=1e-3
+        )
