@@ -66,6 +66,9 @@ class LearnerConfig:
     # C++ batch packer on the staging path (falls back to python when the
     # build/load fails or DOTACLIENT_TPU_NO_NATIVE=1 is set)
     native_packer: bool = True
+    # jax.profiler server port (0 = off); connect with TensorBoard's
+    # profile plugin or jax.profiler.trace to capture device traces
+    profile_port: int = 0
 
 
 @dataclass
@@ -88,6 +91,9 @@ class ActorConfig:
     league_capacity: int = 8  # max snapshots in the local league pool
     league_snapshot_every: int = 20  # learner versions between snapshots
     pfsp_mode: str = "hard"  # "hard" | "even" | "uniform"
+    # Kill switch: exit (for supervisor restart) if no weight broadcast
+    # arrives for this many seconds. 0 disables.
+    max_weight_age_s: float = 0.0
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     seed: int = 0
     actor_id: int = 0

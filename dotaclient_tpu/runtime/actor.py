@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import List, Optional, Tuple
 
+import grpc
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,6 +51,25 @@ from dotaclient_tpu.transport.serialize import (
 )
 
 _log = logging.getLogger(__name__)
+
+
+class StaleWeightsError(RuntimeError):
+    """Raised by the actor kill switch: no weight broadcast arrived for
+    longer than `max_weight_age_s`. The actor exits non-zero so its
+    supervisor (k8s) replaces it with a fresh pod that re-subscribes —
+    on-policy data from an ancient policy is worse than none
+    (SURVEY.md §5 "stale-version kill switch")."""
+
+
+def check_weight_freshness(actor) -> None:
+    """Shared kill-switch check for Actor and SelfPlayActor (both carry
+    cfg.max_weight_age_s and last_weight_time)."""
+    age = time.monotonic() - actor.last_weight_time
+    if 0 < actor.cfg.max_weight_age_s < age:
+        raise StaleWeightsError(
+            f"actor {actor.actor_id}: no weight update for {age:.0f}s "
+            f"(limit {actor.cfg.max_weight_age_s:.0f}s) — exiting for restart"
+        )
 
 
 def make_actor_step(cfg: ActorConfig):
@@ -198,6 +219,9 @@ class Actor:
         # after an abandoned episode — read by the evaluator and the
         # self-play league.
         self.last_win: Optional[float] = None
+        # kill-switch clock: boot counts as "fresh" so a learner that is
+        # still compiling doesn't kill its actors
+        self.last_weight_time = time.monotonic()
 
     # ------------------------------------------------------------- weights
 
@@ -209,11 +233,17 @@ class Actor:
             named, version = deserialize_weights(frame)
             self.params = unflatten_params(named, self.params)
             self.version = version
+            self.last_weight_time = time.monotonic()
             return True
         except Exception as e:  # truncated frames raise struct.error etc. —
             # a bad broadcast must never kill the actor
             _log.warning("actor %d: bad weight frame: %s", self.actor_id, e)
             return False
+
+    def check_weight_freshness(self) -> None:
+        """Kill switch: raise if broadcasts stopped (cfg.max_weight_age_s
+        > 0 enables it)."""
+        check_weight_freshness(self)
 
     # ------------------------------------------------------------- episode
 
@@ -317,8 +347,27 @@ class Actor:
         return episode_return
 
     async def run(self, num_episodes: Optional[int] = None) -> None:
+        """Episode loop with env-outage resilience: a gRPC failure (env
+        server restarting, pod eviction) abandons the episode and retries
+        with capped backoff instead of killing the actor — the k8s model
+        is that actors outlive individual env instances."""
+        backoff = 1.0
         while num_episodes is None or self.episodes_done < num_episodes:
-            ret = await self.run_episode()
+            self.check_weight_freshness()
+            try:
+                ret = await self.run_episode()
+                backoff = 1.0
+            except grpc.aio.AioRpcError as e:
+                _log.warning(
+                    "actor %d: env rpc failed (%s); retrying in %.1fs",
+                    self.actor_id,
+                    e.code(),
+                    backoff,
+                )
+                self.maybe_update_weights()  # stay fresh while waiting
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2.0, 30.0)
+                continue
             _log.info(
                 "actor %d: episode %d return %.2f (version %d, %d steps)",
                 self.actor_id,

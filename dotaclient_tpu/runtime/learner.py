@@ -52,6 +52,10 @@ class Learner:
         self.state: TrainState = jax.device_put(state, self.state_shardings)
         self.staging = StagingBuffer(cfg, broker, version_fn=lambda: self.version)
         self.metrics = MetricsLogger(cfg.log_dir)
+        if cfg.profile_port:
+            # device-trace endpoint (SURVEY.md §5 tracing note): attach
+            # TensorBoard's profiler or jax.profiler.trace to this port
+            jax.profiler.start_server(cfg.profile_port)
         self.checkpointer = None
         if cfg.checkpoint_dir:
             from dotaclient_tpu.runtime.checkpoint import Checkpointer
@@ -86,13 +90,16 @@ class Learner:
         t_last = time.perf_counter()
         try:
             while num_steps is None or done_steps < num_steps:
+                t0 = time.perf_counter()
                 batch = self.staging.get_batch(timeout=batch_timeout)
                 if batch is None:
                     _log.warning("no batch within %.0fs; waiting", batch_timeout)
                     continue
                 if env_steps_per_batch is None:
                     env_steps_per_batch = float(np.sum(batch.mask))
+                t1 = time.perf_counter()
                 batch_dev = jax.device_put(batch, self.batch_sharding)
+                t2 = time.perf_counter()
                 self.state, metrics = self.train_step(self.state, batch_dev)
                 self.version += 1
                 done_steps += 1
@@ -102,10 +109,17 @@ class Learner:
                 if self.checkpointer is not None and self.version % cfg.checkpoint_every == 0:
                     self.checkpoint()
 
-                now = time.perf_counter()
+                # device_get below doubles as the per-step device sync, so
+                # the step timer includes real device time, not dispatch
                 scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                now = time.perf_counter()
                 stats = self.staging.stats()
                 scalars["env_steps_per_sec"] = float(np.sum(batch.mask)) / max(now - t_last, 1e-9)
+                # per-stage timing (SURVEY.md §5: consume / pack / put / step)
+                scalars["time_wait_batch_s"] = t1 - t0
+                scalars["time_device_put_s"] = t2 - t1
+                scalars["time_step_s"] = now - t2
+                scalars["active_actors"] = stats["active_actors"]
                 scalars["staleness_dropped"] = stats["dropped_stale"]
                 scalars["queue_ready"] = stats["ready_batches"]
                 scalars["episodes"] = stats["episodes"]

@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, Optional
 
+import grpc
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -41,7 +43,12 @@ from dotaclient_tpu.models import policy as P
 from dotaclient_tpu.ops import action_dist as ad
 from dotaclient_tpu.protos import dotaservice_pb2 as ds
 from dotaclient_tpu.protos import worldstate_pb2 as ws
-from dotaclient_tpu.runtime.actor import _Chunk, build_action, make_actor_step
+from dotaclient_tpu.runtime.actor import (
+    _Chunk,
+    build_action,
+    check_weight_freshness,
+    make_actor_step,
+)
 from dotaclient_tpu.transport.base import Broker
 from dotaclient_tpu.transport.serialize import (
     deserialize_weights,
@@ -105,6 +112,7 @@ class SelfPlayActor:
         self.episodes_done = 0
         self.rollouts_published = 0
         self.last_win: Optional[float] = None  # radiant (live) perspective
+        self.last_weight_time = time.monotonic()  # kill-switch clock
         self.league: Optional[League] = None
         if cfg.opponent == "league":
             self.league = League(
@@ -127,6 +135,7 @@ class SelfPlayActor:
             named, version = deserialize_weights(frame)
             self.params = unflatten_params(named, self.params)
             self.version = version
+            self.last_weight_time = time.monotonic()
             if self.league is not None:
                 self.league.maybe_snapshot(version, named)
             return True
@@ -297,8 +306,23 @@ class SelfPlayActor:
         return live.episode_return
 
     async def run(self, num_episodes: Optional[int] = None) -> None:
+        backoff = 1.0
         while num_episodes is None or self.episodes_done < num_episodes:
-            ret = await self.run_episode()
+            check_weight_freshness(self)  # same kill switch as Actor
+            try:
+                ret = await self.run_episode()
+                backoff = 1.0
+            except grpc.aio.AioRpcError as e:
+                _log.warning(
+                    "selfplay actor %d: env rpc failed (%s); retrying in %.1fs",
+                    self.actor_id,
+                    e.code(),
+                    backoff,
+                )
+                self.maybe_update_weights()
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2.0, 30.0)
+                continue
             _log.info(
                 "selfplay actor %d: episode %d return %.2f (version %d, opp %s)",
                 self.actor_id,

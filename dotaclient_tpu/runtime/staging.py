@@ -24,6 +24,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from dotaclient_tpu.config import LearnerConfig
@@ -100,6 +101,10 @@ class StagingBuffer:
             from dotaclient_tpu import native
 
             self._lib = native.load_packer()
+        # actor heartbeats: actor_id → last time a frame from it arrived
+        # (written only by the consumer thread; stats() reads a snapshot)
+        self._actor_seen: Dict[int, float] = {}
+        self.heartbeat_window_s = 60.0
         self._stats_lock = threading.Lock()
         self._stats = {
             "consumed": 0,
@@ -170,36 +175,46 @@ class StagingBuffer:
         return pack_rollouts(items, self.cfg.seq_len, self.cfg.policy.aux_heads)
 
     def _parse(self, frame: bytes):
-        """One frame → (pending_item, version, L, H, ep_return, last_done)
-        or None if malformed. Native keeps raw bytes (the C packer reads
-        them later); python keeps the deserialized Rollout."""
+        """One frame → (pending_item, version, L, H, actor_id, ep_return,
+        last_done) or None if malformed. Native keeps raw bytes (the C
+        packer reads them later); python keeps the deserialized Rollout."""
         if self._lib is not None:
             from dotaclient_tpu import native
 
             hdr = native.frame_header(self._lib, frame)
             if hdr is None:
                 return None
-            version, L, frame_h, _flags, _actor, ep_ret, last_done = hdr
-            return frame, version, L, frame_h, ep_ret, last_done
+            version, L, frame_h, _flags, actor_id, ep_ret, last_done = hdr
+            return frame, version, L, frame_h, actor_id, ep_ret, last_done
         try:
             r = deserialize_rollout(frame)
         except (ValueError, KeyError):
             return None
         last_done = float(r.dones[-1]) if r.length else 0.0
-        return r, r.version, r.length, r.initial_state[0].shape[-1], r.episode_return, last_done
+        return (
+            r,
+            r.version,
+            r.length,
+            r.initial_state[0].shape[-1],
+            r.actor_id,
+            r.episode_return,
+            last_done,
+        )
 
     def _ingest(self, frames: List[bytes]) -> None:
         min_version = self.version_fn() - self.cfg.ppo.max_staleness
         H = self.cfg.policy.lstm_hidden
         consumed = dropped_stale = dropped_bad = episodes = 0
         ep_ret = 0.0
+        now = time.monotonic()
         for frame in frames:
             consumed += 1
             parsed = self._parse(frame)
             if parsed is None:
                 dropped_bad += 1
                 continue
-            item, version, L, frame_h, frame_ret, last_done = parsed
+            item, version, L, frame_h, actor_id, frame_ret, last_done = parsed
+            self._actor_seen[actor_id] = now  # heartbeat (consumer thread only)
             # Per-frame config validation happens HERE so one misconfigured
             # actor can only ever cost its own frames, never the pack step.
             if L > self.cfg.seq_len or frame_h != H:
@@ -232,6 +247,13 @@ class StagingBuffer:
             out = dict(self._stats)
         out["ready_batches"] = self._ready.qsize()
         out["pending_rollouts"] = len(self._pending)
+        # heartbeat gauge: actors heard from within the window (dict reads
+        # are atomic enough; values drift by at most one frame)
+        cutoff = time.monotonic() - self.heartbeat_window_s
+        seen = dict(self._actor_seen)
+        out["active_actors"] = sum(1 for t in seen.values() if t >= cutoff)
+        if len(seen) > 4096:  # prune long-gone ids so the dict stays bounded
+            self._actor_seen = {a: t for a, t in seen.items() if t >= cutoff}
         return out
 
     def stop(self) -> None:

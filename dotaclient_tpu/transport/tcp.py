@@ -9,11 +9,14 @@ drop-oldest experience queue and a latest-wins weight fanout. The client
 learner are agnostic to which broker backs the URL.
 
 Framing: every message is  u32 payload_len | u8 type | payload.
-  0x01 PUB_EXP   payload = experience frame            (no reply)
+  0x01 PUB_EXP   payload = experience frame            → 0x81 ack
   0x02 CONSUME   payload = u16 max_items, f32 timeout  → 0x82 reply
-  0x03 PUB_W     payload = weight frame                (no reply)
+  0x03 PUB_W     payload = weight frame                → 0x81 ack
   0x04 GET_W     payload = u32 last_seen_seq           → 0x84 reply
   0x05 DEPTH     no payload                            → 0x85 reply
+  0x81 ack       empty — publishes are acknowledged so a client can
+                 DETECT a dead broker (an unacked sendall can succeed
+                 into a dead socket's buffer) and reconnect/resend
   0x82 reply     u16 count, then per frame u32 len + bytes
   0x84 reply     u32 seq (0 = nothing newer), frame bytes
   0x85 reply     u32 depth, u32 dropped
@@ -39,7 +42,7 @@ _LEN = struct.Struct("<I")
 _TYPE = struct.Struct("<B")
 
 PUB_EXP, CONSUME, PUB_W, GET_W, DEPTH = 0x01, 0x02, 0x03, 0x04, 0x05
-R_CONSUME, R_GET_W, R_DEPTH = 0x82, 0x84, 0x85
+R_ACK, R_CONSUME, R_GET_W, R_DEPTH = 0x81, 0x82, 0x84, 0x85
 
 MAX_FRAME = 256 * 1024 * 1024
 _POLL_SLICE = 30.0  # max per-request server-side wait when blocking forever
@@ -87,6 +90,7 @@ class BrokerServer:
                     self.dropped += 1
                 self.experience.append(payload)
                 self._cond.notify_all()
+            await self._reply(writer, R_ACK, b"")
         elif mtype == CONSUME:
             max_items, timeout = struct.unpack("<Hf", payload)
             async with self._cond:
@@ -108,6 +112,7 @@ class BrokerServer:
         elif mtype == PUB_W:
             self.weights_seq += 1
             self.weights = payload
+            await self._reply(writer, R_ACK, b"")
         elif mtype == GET_W:
             (seen,) = struct.unpack("<I", payload)
             if self.weights is not None and self.weights_seq > seen:
@@ -175,24 +180,60 @@ class BrokerServer:
 
 
 class _Conn:
-    """One blocking framed connection with its own lock."""
+    """One blocking framed connection with its own lock.
 
-    def __init__(self, addr, connect_timeout: float):
+    Survives broker restarts: a failed request reconnects with capped
+    exponential backoff and re-sends for up to `retry_window` seconds
+    before giving up (SURVEY.md §5 failure-detection note — "elasticity
+    via broker + restart" only works if clients outlive the broker).
+    Requests are whole-message, so a resend after a half-written request
+    at worst duplicates one experience frame — harmless to PPO.
+    """
+
+    def __init__(self, addr, connect_timeout: float, retry_window: float = 60.0):
+        self.addr = addr
+        self.connect_timeout = connect_timeout
+        self.retry_window = retry_window
         self.lock = threading.Lock()
-        self.sock = socket.create_connection(addr, timeout=connect_timeout)
+        self.sock: Optional[socket.socket] = None
+        self._connect()  # fail fast at boot — a wrong URL should not retry
+
+    def _connect(self):
+        self.sock = socket.create_connection(self.addr, timeout=self.connect_timeout)
         self.sock.settimeout(None)
+        self.generation = getattr(self, "generation", -1) + 1
 
     def request(self, mtype: int, payload: bytes, expected_reply: Optional[int]) -> Optional[bytes]:
         with self.lock:
-            self.sock.sendall(_LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload)
-            if expected_reply is None:
-                return None
-            hdr = self._recv_exact(_LEN.size + _TYPE.size)
-            (n,) = _LEN.unpack_from(hdr)
-            (rtype,) = _TYPE.unpack_from(hdr, _LEN.size)
-            if rtype != expected_reply:
-                raise ValueError(f"unexpected reply type {rtype:#x}")
-            return self._recv_exact(n) if n else b""
+            deadline = time.monotonic() + self.retry_window
+            backoff = 0.1
+            while True:
+                try:
+                    if self.sock is None:
+                        self._connect()
+                    return self._request_once(mtype, payload, expected_reply)
+                except (ConnectionError, OSError):
+                    if self.sock is not None:
+                        try:
+                            self.sock.close()
+                        except OSError:
+                            pass
+                        self.sock = None
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2.0, 2.0)
+
+    def _request_once(self, mtype: int, payload: bytes, expected_reply: Optional[int]) -> Optional[bytes]:
+        self.sock.sendall(_LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload)
+        if expected_reply is None:
+            return None
+        hdr = self._recv_exact(_LEN.size + _TYPE.size)
+        (n,) = _LEN.unpack_from(hdr)
+        (rtype,) = _TYPE.unpack_from(hdr, _LEN.size)
+        if rtype != expected_reply:
+            raise ValueError(f"unexpected reply type {rtype:#x}")
+        return self._recv_exact(n) if n else b""
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -206,7 +247,8 @@ class _Conn:
 
     def close(self):
         with self.lock:
-            self.sock.close()
+            if self.sock is not None:
+                self.sock.close()
 
 
 class TcpBroker(Broker):
@@ -216,9 +258,10 @@ class TcpBroker(Broker):
         self._exp = _Conn((host, port), connect_timeout)
         self._w = _Conn((host, port), connect_timeout)
         self._seen_weights_seq = 0
+        self._w_generation = self._w.generation
 
     def publish_experience(self, data: bytes) -> None:
-        self._exp.request(PUB_EXP, data, None)
+        self._exp.request(PUB_EXP, data, R_ACK)
 
     def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -244,9 +287,15 @@ class TcpBroker(Broker):
         return frames
 
     def publish_weights(self, data: bytes) -> None:
-        self._w.request(PUB_W, data, None)
+        self._w.request(PUB_W, data, R_ACK)
 
     def poll_weights(self) -> Optional[bytes]:
+        # a restarted broker restarts its weight sequence at 1 — after any
+        # reconnect the high-water mark must reset or every future
+        # broadcast would be silently ignored
+        if self._w.generation != self._w_generation:
+            self._w_generation = self._w.generation
+            self._seen_weights_seq = 0
         payload = self._w.request(GET_W, struct.pack("<I", self._seen_weights_seq), R_GET_W)
         assert payload is not None
         (seq,) = struct.unpack_from("<I", payload)
