@@ -72,6 +72,25 @@ def check_weight_freshness(actor) -> None:
         )
 
 
+async def reset_env_stub(actor) -> None:
+    """Tear down the env channel after an RPC failure so the next episode
+    reconnects from scratch (shared by Actor and SelfPlayActor; both keep
+    the lazily-created stub in `_stub`).
+
+    Required for convergent recovery: a kept channel reuses its dead
+    subchannel, whose internal gRPC reconnect backoff grows to ~2 min —
+    far past our own retry cadence — so a revived env server would sit
+    unused while the actor's "retries" all fail against the stale
+    subchannel."""
+    stub = actor._stub
+    actor._stub = None
+    if stub is not None:
+        try:
+            await stub.channel.close()
+        except Exception:  # a half-dead aio channel may throw on close
+            pass
+
+
 def make_actor_step(cfg: ActorConfig):
     """jit'd single-step inference: sampling stays on device."""
     net = P.PolicyNet(cfg.policy)
@@ -364,6 +383,7 @@ class Actor:
                     e.code(),
                     backoff,
                 )
+                await reset_env_stub(self)  # drop the dead subchannel
                 self.maybe_update_weights()  # stay fresh while waiting
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2.0, 30.0)

@@ -48,6 +48,7 @@ from dotaclient_tpu.runtime.actor import (
     build_action,
     check_weight_freshness,
     make_actor_step,
+    reset_env_stub,
 )
 from dotaclient_tpu.transport.base import Broker
 from dotaclient_tpu.transport.serialize import (
@@ -319,6 +320,7 @@ class SelfPlayActor:
                     e.code(),
                     backoff,
                 )
+                await reset_env_stub(self)  # drop the dead subchannel
                 self.maybe_update_weights()
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2.0, 30.0)

@@ -66,8 +66,10 @@ class BrokerServer:
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
         self._boot_error: Optional[BaseException] = None
+        self._conns: set = set()  # live connection writers, loop-thread only
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._conns.add(writer)
         try:
             while True:
                 hdr = await reader.readexactly(_LEN.size + _TYPE.size)
@@ -79,7 +81,10 @@ class BrokerServer:
                 await self._dispatch(mtype, payload, writer)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
+        except asyncio.CancelledError:
+            pass  # server shutdown aborted this connection
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     async def _dispatch(self, mtype: int, payload: bytes, writer: asyncio.StreamWriter):
@@ -135,8 +140,25 @@ class BrokerServer:
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
         self._started.set()
-        async with self._server:
-            await self._stop_ev.wait()
+        await self._stop_ev.wait()
+        # Python 3.12's Server.wait_closed() waits for every connection
+        # handler, and handlers park in readexactly() on live client
+        # sockets or in the CONSUME cond-wait — without tearing them all
+        # down first, stop() never completes and a "stopped" broker keeps
+        # ACKing from beyond the grave. Order: stop accepting, then
+        # cancel every handler task (asyncio.all_tasks also covers
+        # just-accepted handlers that haven't reached their first line),
+        # then abort transports so close is immediate, not graceful.
+        self._server.close()
+        me = asyncio.current_task()
+        handlers = [t for t in asyncio.all_tasks() if t is not me]
+        for t in handlers:
+            t.cancel()
+        for w in list(self._conns):
+            w.transport.abort()
+        if handlers:
+            await asyncio.gather(*handlers, return_exceptions=True)
+        await self._server.wait_closed()
 
     def start(self) -> "BrokerServer":
         self._thread = threading.Thread(target=self._run, daemon=True, name="broker-server")
@@ -187,7 +209,12 @@ class _Conn:
     before giving up (SURVEY.md §5 failure-detection note — "elasticity
     via broker + restart" only works if clients outlive the broker).
     Requests are whole-message, so a resend after a half-written request
-    at worst duplicates one experience frame — harmless to PPO.
+    at worst duplicates one experience frame — harmless to PPO. The one
+    lossy case: a CONSUME whose reply times out client-side may lose the
+    frames the server already popped for it. That is accepted — the
+    experience queue is drop-oldest under pressure anyway, and PPO
+    tolerates lost rollouts; the alternative (consume acks + redelivery)
+    buys nothing this system needs.
     """
 
     def __init__(self, addr, connect_timeout: float, retry_window: float = 60.0):
@@ -200,10 +227,23 @@ class _Conn:
 
     def _connect(self):
         self.sock = socket.create_connection(self.addr, timeout=self.connect_timeout)
-        self.sock.settimeout(None)
         self.generation = getattr(self, "generation", -1) + 1
 
-    def request(self, mtype: int, payload: bytes, expected_reply: Optional[int]) -> Optional[bytes]:
+    def request(
+        self,
+        mtype: int,
+        payload: bytes,
+        expected_reply: Optional[int],
+        read_timeout: float = 10.0,
+    ) -> Optional[bytes]:
+        """Send one request and read its reply, with reconnection.
+
+        `read_timeout` bounds the wait for the reply — a broker that dies
+        without RST (silent host death, network partition) must raise
+        here so the reconnect/backoff path engages instead of blocking
+        recv() forever. Callers whose requests legitimately park on the
+        server (blocking consume) pass their server-side wait + slack.
+        """
         with self.lock:
             deadline = time.monotonic() + self.retry_window
             backoff = 0.1
@@ -211,7 +251,7 @@ class _Conn:
                 try:
                     if self.sock is None:
                         self._connect()
-                    return self._request_once(mtype, payload, expected_reply)
+                    return self._request_once(mtype, payload, expected_reply, read_timeout)
                 except (ConnectionError, OSError):
                     if self.sock is not None:
                         try:
@@ -224,10 +264,17 @@ class _Conn:
                     time.sleep(backoff)
                     backoff = min(backoff * 2.0, 2.0)
 
-    def _request_once(self, mtype: int, payload: bytes, expected_reply: Optional[int]) -> Optional[bytes]:
+    def _request_once(
+        self, mtype: int, payload: bytes, expected_reply: Optional[int], read_timeout: float
+    ) -> Optional[bytes]:
+        # the send gets its own (generous) bound — a large weight frame
+        # into a backpressured-but-alive broker must not be killed by the
+        # reply deadline; a send stuck >60s means the broker is dead
+        self.sock.settimeout(max(read_timeout, 60.0))
         self.sock.sendall(_LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload)
         if expected_reply is None:
             return None
+        self.sock.settimeout(read_timeout)
         hdr = self._recv_exact(_LEN.size + _TYPE.size)
         (n,) = _LEN.unpack_from(hdr)
         (rtype,) = _TYPE.unpack_from(hdr, _LEN.size)
@@ -270,8 +317,12 @@ class TcpBroker(Broker):
                 wait = _POLL_SLICE
             else:
                 wait = max(0.0, deadline - time.monotonic())
+            slice_wait = min(wait, _POLL_SLICE)
             payload = self._exp.request(
-                CONSUME, struct.pack("<Hf", max_items, min(wait, _POLL_SLICE)), R_CONSUME
+                CONSUME,
+                struct.pack("<Hf", max_items, slice_wait),
+                R_CONSUME,
+                read_timeout=slice_wait + 10.0,
             )
             assert payload is not None
             (count,) = struct.unpack_from("<H", payload)

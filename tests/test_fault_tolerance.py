@@ -58,6 +58,34 @@ def test_tcp_broker_survives_server_restart():
         restarted.stop()
 
 
+def test_tcp_broker_stop_interrupts_parked_consume():
+    """stop() must complete promptly (and actually kill the server
+    thread) even while a client is parked in a long blocking consume —
+    handlers waiting on the experience condition are cancelled, not
+    waited out (Python 3.12 Server.wait_closed waits for handlers)."""
+    server = BrokerServer(port=0).start()
+    client = TcpBroker(port=server.port)
+    client._exp.retry_window = 1.0
+    result = {}
+
+    def consumer():
+        try:
+            result["frames"] = client.consume_experience(max_items=4, timeout=20.0)
+        except OSError as e:
+            result["err"] = type(e).__name__
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    time.sleep(0.5)  # let the consume park server-side
+    t0 = time.monotonic()
+    server.stop()
+    assert time.monotonic() - t0 < 3.0
+    assert not server._thread.is_alive()
+    t.join(timeout=10)  # client notices the death within its retry window
+    assert not t.is_alive() and "err" in result
+    client.close()
+
+
 def test_tcp_broker_gives_up_after_retry_window():
     server = BrokerServer(port=0).start()
     port = server.port
@@ -84,6 +112,9 @@ def test_actor_survives_env_outage():
         seed=6,
     )
     actor = Actor(cfg, NullBroker())
+    revived = []  # keep the revived grpc.Server referenced — a dropped
+    # reference lets GC terminate it mid-test, which would make recovery
+    # impossible for any client
 
     async def go():
         await actor.run(num_episodes=1)  # healthy episode
@@ -91,12 +122,13 @@ def test_actor_survives_env_outage():
         # restart on the same port while the actor is retrying
         def revive():
             time.sleep(1.5)
-            serve(FakeDotaService(), port=port, max_workers=2)
+            revived.append(serve(FakeDotaService(), port=port, max_workers=2))
 
         threading.Thread(target=revive, daemon=True).start()
         # a lost stub channel keeps the old (dead) subchannel; the retry
-        # path must still converge once the server is back
-        await asyncio.wait_for(actor.run(num_episodes=3), timeout=60)
+        # path must recreate the channel and converge once the server is
+        # back (runtime/actor.py reset_env_stub)
+        await asyncio.wait_for(actor.run(num_episodes=3), timeout=30)
 
     asyncio.new_event_loop().run_until_complete(go())
     assert actor.episodes_done >= 3
