@@ -30,9 +30,11 @@ from dotaclient_tpu.protos import worldstate_pb2 as ws
 # Schema constants (shared with the policy).
 MAX_UNITS = 16
 UNIT_FEATURES = 16
-# 16 stat features + an 8-dim hashed hero-identity code (env/heroes.py) so
-# one shared LSTM can condition on which hero it is playing (config 3).
-HERO_FEATURES = 24
+# 16 stat features + 4 ability features (slot-0 readiness/cooldown/cost —
+# the CAST head needs to SEE why it is masked, not just that it is) + an
+# 8-dim hashed hero-identity code (env/heroes.py) so one shared LSTM can
+# condition on which hero it is playing (config 3).
+HERO_FEATURES = 28
 GLOBAL_FEATURES = 8
 
 # Action-type head ordering (reference: {noop, move, attack[, ability]}).
@@ -123,6 +125,15 @@ def norm_last_hits(last_hits: float) -> float:
     return last_hits / 100.0
 
 
+def castable(hero: ws.Unit) -> bool:
+    """Any ability off cooldown and affordable right now — the single
+    predicate behind both the CAST action mask and the hero features."""
+    return any(
+        a.is_castable and a.cooldown_remaining <= 0.0 and a.mana_cost <= hero.mana
+        for a in hero.abilities
+    )
+
+
 def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     hp_max = max(h.health_max, 1.0)
     mana_max = max(h.mana_max, 1.0)
@@ -142,7 +153,13 @@ def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     out[13] = math.log1p(max(h.xp, 0)) / 10.0
     out[14] = norm_last_hits(h.last_hits)
     out[15] = 1.0 if h.is_alive else 0.0
-    out[16:24] = hero_id_features(h.name)
+    if h.abilities:  # slot-0 ability readiness (zeros = no abilities known)
+        a = min(h.abilities, key=lambda a: a.slot)
+        out[16] = 1.0 if a.level > 0 and a.is_castable else 0.0
+        out[17] = min(a.cooldown_remaining / 10.0, 1.0)
+        out[18] = a.mana_cost / max(h.mana_max, 1.0)
+        out[19] = 1.0 if castable(h) else 0.0
+    out[20:28] = hero_id_features(h.name)
 
 
 def featurize_with_handles(world: ws.World, player_id: int):
@@ -186,11 +203,12 @@ def featurize_with_handles(world: ws.World, player_id: int):
     np.clip(obs.hero_feats, -_CLAMP, _CLAMP, out=obs.hero_feats)
     np.clip(obs.unit_feats, -_CLAMP, _CLAMP, out=obs.unit_feats)
 
-    castable = any(a.is_castable and a.cooldown_remaining <= 0.0 and a.mana_cost <= hero.mana for a in hero.abilities)
     obs.action_mask[ACT_NOOP] = True
     obs.action_mask[ACT_MOVE] = True
     obs.action_mask[ACT_ATTACK] = bool(obs.target_mask.any())
-    obs.action_mask[ACT_CAST] = castable
+    # CAST is unit-targeted (shares the target head) — it needs a ready
+    # ability AND a legal target, or sampling could pick an empty slot.
+    obs.action_mask[ACT_CAST] = castable(hero) and bool(obs.target_mask.any())
     return obs, handles
 
 

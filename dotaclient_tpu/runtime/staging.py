@@ -215,6 +215,13 @@ class StagingBuffer:
                 continue
             item, version, L, frame_h, actor_id, frame_ret, last_done = parsed
             self._actor_seen[actor_id] = now  # heartbeat (consumer thread only)
+            # Prune long-gone ids here, on the sole writer thread, so the
+            # dict stays bounded without stats() ever mutating shared state.
+            if len(self._actor_seen) > 4096:
+                cutoff = now - self.heartbeat_window_s
+                self._actor_seen = {
+                    a: t for a, t in self._actor_seen.items() if t >= cutoff
+                }
             # Per-frame config validation happens HERE so one misconfigured
             # actor can only ever cost its own frames, never the pack step.
             if L > self.cfg.seq_len or frame_h != H:
@@ -250,10 +257,8 @@ class StagingBuffer:
         # heartbeat gauge: actors heard from within the window (dict reads
         # are atomic enough; values drift by at most one frame)
         cutoff = time.monotonic() - self.heartbeat_window_s
-        seen = dict(self._actor_seen)
+        seen = dict(self._actor_seen)  # snapshot; pruning lives in _ingest
         out["active_actors"] = sum(1 for t in seen.values() if t >= cutoff)
-        if len(seen) > 4096:  # prune long-gone ids so the dict stays bounded
-            self._actor_seen = {a: t for a, t in seen.items() if t >= cutoff}
         return out
 
     def stop(self) -> None:

@@ -230,3 +230,56 @@ def test_two_clients_do_not_share_a_game():
     # b's clock advanced exactly one interval despite a's stepping
     assert abs(ob.world_state.dota_time - (wb.dota_time + 1.0)) < 1e-5
     server.stop(0)
+
+
+def test_cast_burst_mana_and_cooldown():
+    """The slot-0 nuke is live: burst damage, mana drain, cooldown gate
+    (VERDICT r1 item 8 — the CAST path must execute, not just mask)."""
+    from dotaclient_tpu.env.fake_dotaservice import (
+        _ABILITY_COOLDOWN,
+        _ABILITY_DAMAGE,
+        _ABILITY_MANA_COST,
+        LastHitLaneGame,
+    )
+
+    game = LastHitLaneGame(selfplay_cfg(seed=7))
+    creep = next(c for c in game.creeps if c.team == 3)
+    game.hero.x, game.hero.y = creep.x - 300.0, creep.y  # within cast range
+    hp0, mana0 = creep.hp, game.hero.mana
+    game.pending[0] = ds.Action(type=ds.Action.CAST, player_id=0, target_handle=creep.handle, ability_slot=0)
+    game.step()
+    # burst landed (wave chip adds a little on top) and resources moved
+    assert hp0 - creep.hp >= _ABILITY_DAMAGE
+    assert game.hero.mana <= mana0 - _ABILITY_MANA_COST + 2.0  # + regen slack
+    assert game.hero.next_cast_time > game.dota_time
+    cd_remaining = game.hero.next_cast_time - game.dota_time
+    assert cd_remaining <= _ABILITY_COOLDOWN
+    # immediate second cast is refused by the cooldown: no damage, no mana
+    hp1, mana1 = creep.hp, game.hero.mana
+    game.pending[0] = ds.Action(type=ds.Action.CAST, player_id=0, target_handle=creep.handle, ability_slot=0)
+    game.step()
+    chip = hp1 - creep.hp  # wave dps only
+    assert chip < _ABILITY_DAMAGE / 2
+    assert game.hero.mana >= mana1  # regen only, no cost paid
+
+
+def test_cast_out_of_range_approaches():
+    from dotaclient_tpu.env.fake_dotaservice import LastHitLaneGame
+
+    game = LastHitLaneGame(selfplay_cfg(seed=8))
+    x0 = game.hero.x  # -1500, far from everything
+    game.pending[0] = ds.Action(
+        type=ds.Action.CAST, player_id=0, target_handle=game.enemy_hero.handle, ability_slot=0
+    )
+    game.step()
+    assert game.hero.x > x0  # walked toward the target instead of no-op
+    assert game.hero.mana == game.hero.mana_max  # nothing was spent
+
+
+def test_worldstate_reports_abilities(stub):
+    obs = stub.reset(cfg(seed=12))
+    hero = F.find_hero(obs.world_state, 0)
+    assert len(hero.abilities) == 1
+    a = hero.abilities[0]
+    assert a.slot == 0 and a.is_castable and a.cooldown_remaining == 0.0
+    assert 0 < a.mana_cost <= hero.mana_max

@@ -108,3 +108,49 @@ def test_parse_config_does_not_mutate_base():
     out = parse_config(base, ["--ppo.gamma", "0.5"])
     assert out.ppo.gamma == 0.5
     assert base.ppo.gamma != 0.5
+
+
+def _add_ability(hero, cooldown_remaining=0.0, mana_cost=90.0, is_castable=True, level=1):
+    hero.abilities.add(
+        ability_id=5059, slot=0, level=level,
+        cooldown_remaining=cooldown_remaining, mana_cost=mana_cost,
+        is_castable=is_castable,
+    )
+
+
+def test_castable_mask_tracks_cooldown_and_mana():
+    # ready ability + legal targets → CAST legal
+    w = make_world()
+    _add_ability(F.find_hero(w, 0))
+    obs = F.featurize(w, player_id=0)
+    assert obs.action_mask[F.ACT_CAST]
+    # on cooldown → masked
+    w = make_world()
+    _add_ability(F.find_hero(w, 0), cooldown_remaining=3.0)
+    assert not F.featurize(w, 0).action_mask[F.ACT_CAST]
+    # unaffordable → masked (hero has mana=200)
+    w = make_world()
+    _add_ability(F.find_hero(w, 0), mana_cost=250.0)
+    assert not F.featurize(w, 0).action_mask[F.ACT_CAST]
+
+
+def test_cast_needs_a_target():
+    # CAST shares the unit-target head: ready ability but zero legal
+    # targets must stay masked or sampling could pick an empty slot
+    w = make_world(n_creeps=0, with_enemy_hero=False)
+    _add_ability(F.find_hero(w, 0))
+    obs = F.featurize(w, 0)
+    assert not obs.action_mask[F.ACT_CAST]
+
+
+def test_hero_ability_features():
+    w = make_world()
+    _add_ability(F.find_hero(w, 0), cooldown_remaining=5.0, mana_cost=90.0)
+    hf = F.featurize(w, 0).hero_feats
+    assert hf[16] == 1.0  # ability known
+    assert abs(hf[17] - 0.5) < 1e-6  # cooldown 5s / 10
+    assert abs(hf[18] - 0.3) < 1e-6  # cost 90 / mana_max 300
+    assert hf[19] == 0.0  # not castable right now (cooldown)
+    # no abilities → all four stay zero
+    hf0 = F.featurize(make_world(), 0).hero_feats
+    assert np.all(hf0[16:20] == 0.0)

@@ -1,0 +1,147 @@
+"""Deployment-shell validation (SURVEY.md §1 L6, §3.5) without a cluster.
+
+kubectl isn't in the image, so this is the CI-style stand-in for
+`kubectl apply --dry-run=client -f k8s/`: parse every manifest, check the
+schema shape k8s would reject, and cross-check the wiring that a dry-run
+can't see — that every container command is a real module in this repo,
+that every --flag it passes is a real config field, and that broker URLs
+point at a Service that exists.
+"""
+
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+K8S = pathlib.Path(__file__).resolve().parent.parent / "k8s"
+
+MANIFESTS = sorted(K8S.glob("*.yaml"))
+
+
+def _docs():
+    out = []
+    for path in MANIFESTS:
+        for doc in yaml.safe_load_all(path.read_text()):
+            if doc is not None:
+                out.append((path.name, doc))
+    return out
+
+
+DOCS = _docs()
+
+
+def test_manifests_exist():
+    names = {p.name for p in MANIFESTS}
+    assert {"broker.yaml", "learner.yaml", "actors.yaml", "evaluator.yaml", "rabbitmq.yaml"} <= names
+    assert (K8S / "Dockerfile").exists()
+
+
+@pytest.mark.parametrize("fname,doc", DOCS, ids=lambda v: v if isinstance(v, str) else "")
+def test_doc_schema_shape(fname, doc):
+    assert doc.get("apiVersion"), f"{fname}: missing apiVersion"
+    kind = doc.get("kind")
+    assert kind in ("Deployment", "StatefulSet", "Service"), f"{fname}: kind {kind}"
+    assert doc["metadata"].get("name"), f"{fname}: missing metadata.name"
+    spec = doc.get("spec")
+    assert spec, f"{fname}: missing spec"
+    if kind in ("Deployment", "StatefulSet"):
+        sel = spec["selector"]["matchLabels"]
+        labels = spec["template"]["metadata"]["labels"]
+        assert sel.items() <= labels.items(), f"{fname}: selector doesn't match pod labels"
+        containers = spec["template"]["spec"]["containers"]
+        assert containers, f"{fname}: no containers"
+        for c in containers:
+            assert c.get("image"), f"{fname}: container {c.get('name')} has no image"
+            assert c.get("resources", {}).get("requests"), (
+                f"{fname}: container {c.get('name')} has no resource requests"
+            )
+
+
+def _our_containers():
+    """(fname, container) for every container running this package's image."""
+    for fname, doc in DOCS:
+        if doc["kind"] == "Service":
+            continue
+        for c in doc["spec"]["template"]["spec"]["containers"]:
+            if c["image"].startswith("dotaclient-tpu"):
+                yield fname, c
+
+
+def test_commands_are_real_modules():
+    for fname, c in _our_containers():
+        cmd = c.get("command")
+        if cmd is None:  # Dockerfile default CMD
+            continue
+        assert cmd[0] == "python" and cmd[1] == "-m", f"{fname}: {cmd}"
+        module = cmd[2]
+        proc = subprocess.run(
+            [sys.executable, "-c", f"import importlib.util as u; exit(0 if u.find_spec({module!r}) else 1)"],
+            cwd=K8S.parent,
+        )
+        assert proc.returncode == 0, f"{fname}: module {module} not importable"
+
+
+def test_flags_are_real_config_fields():
+    from dotaclient_tpu.config import ActorConfig, EvalConfig, LearnerConfig, add_flags
+    import argparse
+
+    known = {
+        "dotaclient_tpu.runtime.learner": LearnerConfig(),
+        "dotaclient_tpu.runtime.actor": ActorConfig(),
+        "dotaclient_tpu.eval.evaluator": EvalConfig(),
+    }
+    for fname, c in _our_containers():
+        cmd = c.get("command")
+        if cmd is None or cmd[2] not in known:
+            continue
+        parser = argparse.ArgumentParser()
+        add_flags(parser, known[cmd[2]])
+        # parse_args would sys.exit on an unknown flag; that's the assert
+        parser.parse_args(c.get("args", []))
+
+
+def test_broker_urls_resolve_to_a_service():
+    services = {doc["metadata"]["name"] for _, doc in DOCS if doc["kind"] == "Service"}
+    url_re = re.compile(r"^(tcp|amqp)://(?:[^@/]+@)?([^:/]+)")
+    found = 0
+    for fname, c in _our_containers():
+        args = c.get("args", [])
+        for flag, val in zip(args, args[1:]):
+            if flag.endswith("broker_url"):
+                host = url_re.match(val).group(2)
+                assert host in services, f"{fname}: broker host {host!r} has no Service"
+                found += 1
+    assert found >= 3  # learner + actor + evaluator all wired
+
+
+def test_learner_requests_tpu():
+    (fname, doc), = [(f, d) for f, d in DOCS if d["metadata"]["name"] == "learner" and d["kind"] != "Service"]
+    c = doc["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["requests"].get("google.com/tpu"), "learner must request TPU chips"
+    sel = doc["spec"]["template"]["spec"].get("nodeSelector", {})
+    assert any("tpu" in k for k in sel), "learner must pin to the TPU node pool"
+
+
+def test_actor_fleet_scale_and_kill_switch():
+    (_, doc), = [(f, d) for f, d in DOCS if d["metadata"]["name"] == "actors"]
+    assert doc["spec"]["replicas"] >= 2
+    actor = [c for c in doc["spec"]["template"]["spec"]["containers"] if c["name"] == "actor"][0]
+    args = actor["args"]
+    assert "--max_weight_age_s" in args, "actors must carry the stale-weights kill switch"
+
+
+def test_kubectl_dry_run_if_available():
+    import shutil
+
+    if shutil.which("kubectl") is None:
+        pytest.skip("kubectl not in image; structural checks above stand in")
+    for path in MANIFESTS:
+        proc = subprocess.run(
+            ["kubectl", "apply", "--dry-run=client", "-f", str(path)],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, f"{path.name}: {proc.stderr}"

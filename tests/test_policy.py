@@ -111,8 +111,9 @@ def test_param_count_golden():
     n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(P.init_params(CFG, jax.random.PRNGKey(0))))
     # Catches silent architecture drift; update intentionally when the
     # architecture changes.
-    # 15711 + 8×mlp_hidden when HERO_FEATURES grew 16→24 (hero-id code)
-    assert n == 15967, n
+    # grew 15711→15967 when HERO_FEATURES went 16→24 (hero-id code) and
+    # →16095 when it went 24→28 (slot-0 ability readiness features)
+    assert n == 16095, n
 
 
 def test_unroll_is_jittable_with_scan(params):
