@@ -76,6 +76,10 @@ class ActorConfig:
     """Actor binary (reference: agent.py CLI)."""
 
     env_addr: str = "localhost:13337"
+    # "internal": this framework's env protos (fake env, tests);
+    # "valve": a real dotaservice speaking CMsgBotWorldState — adapted at
+    # the stub boundary (env/valve_adapter.py), actor loop unchanged.
+    env_dialect: str = "internal"
     broker_url: str = "mem://"
     rollout_len: int = 16  # steps per published experience chunk
     host_timescale: float = 10.0

@@ -1,0 +1,479 @@
+"""Adapters between Valve's `CMsgBotWorldState` dialect (what a real
+dotaservice speaks — SURVEY.md §1 L1, §2 "Env protos") and this
+framework's internal worldstate schema.
+
+The internal protos carry exactly the fields the featurize/reward path
+reads, in flat form; the Valve schema nests locations, splits gold into
+reliable/unreliable, keeps kills/deaths on Player messages, and omits a
+few derived quantities (hero xp, winning team). This module is the single
+place that knowledge lives:
+
+- `world_from_valve`  : CMsgBotWorldState → internal `ws.World`
+- `actions_to_valve`  : internal `ds.Actions` → dotaservice `Actions`
+  (MOVE → DOTA_UNIT_ORDER_MOVE_DIRECTLY, ATTACK → ATTACK_TARGET,
+   CAST → CAST_TARGET — the same order types the reference emits)
+- `game_config_to_valve` : internal `ds.GameConfig` → dotaservice config
+- `ValveDotaServiceStub` : a drop-in for `env.service.DotaServiceStub`
+  that speaks the `/dotaservice.DotaService/...` wire dialect and does
+  all conversion, so `runtime.actor.Actor` runs against a REAL
+  dotaservice unmodified (pass `stub=connect_valve_async(addr)`).
+
+Provenance caveat (same as the .proto transcriptions): field numbering of
+the vendored Valve protos is [MED] confidence; everything here is
+schema-level and survives renumbering.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dotaclient_tpu.protos import dotaservice_pb2 as ds
+from dotaclient_tpu.protos import valve_dotaservice_pb2 as vds
+from dotaclient_tpu.protos import valve_worldstate_pb2 as vw
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+
+VAction = vw.CMsgBotWorldState.Action
+
+TEAM_RADIANT, TEAM_DIRE = 2, 3
+_TICKS_PER_SEC = 30.0
+
+# Cumulative xp required to REACH each level (index = level, [1]=0).
+# 2018-era curve, close enough for features/reward shaping — the xp
+# REWARD uses deltas of this reconstruction, so only monotonicity and
+# rough scale matter (the real worldstate does not carry total xp).
+_XP_TO_REACH = [0, 0]
+for _need in (230, 370, 480, 580, 600, 720, 750, 890, 930, 970, 1010, 1050,
+              1090, 1130, 1170, 1210, 1250, 1290, 1330, 1870, 2120, 2370, 2620, 2870):
+    _XP_TO_REACH.append(_XP_TO_REACH[-1] + _need)
+
+
+def _xp_from_level(level: int, xp_needed_to_level: int) -> int:
+    """Reconstruct total xp from (level, xp still needed to level up)."""
+    level = max(1, min(level, len(_XP_TO_REACH) - 2))
+    next_total = _XP_TO_REACH[level + 1]
+    need = max(0, min(xp_needed_to_level, next_total - _XP_TO_REACH[level]))
+    return next_total - need
+
+
+def _xp_needed_for(level: int, xp: int) -> int:
+    """Inverse of _xp_from_level (used by world_to_valve): remainder to
+    the next Valve level, clamped into the level's bracket."""
+    level = max(1, min(level, len(_XP_TO_REACH) - 2))
+    next_total = _XP_TO_REACH[level + 1]
+    bracket = next_total - _XP_TO_REACH[level]
+    return max(0, min(next_total - xp, bracket))
+
+
+_UNIT_TYPE = {
+    vw.CMsgBotWorldState.INVALID: ws.Unit.INVALID,
+    vw.CMsgBotWorldState.HERO: ws.Unit.HERO,
+    vw.CMsgBotWorldState.CREEP_HERO: ws.Unit.CREEP_HERO,
+    vw.CMsgBotWorldState.LANE_CREEP: ws.Unit.LANE_CREEP,
+    vw.CMsgBotWorldState.JUNGLE_CREEP: ws.Unit.JUNGLE_CREEP,
+    vw.CMsgBotWorldState.ROSHAN: ws.Unit.ROSHAN,
+    vw.CMsgBotWorldState.TOWER: ws.Unit.TOWER,
+    vw.CMsgBotWorldState.BARRACKS: ws.Unit.BARRACKS,
+    vw.CMsgBotWorldState.SHRINE: ws.Unit.SHRINE,
+    vw.CMsgBotWorldState.FORT: ws.Unit.FORT,
+    vw.CMsgBotWorldState.BUILDING: ws.Unit.BUILDING,
+    vw.CMsgBotWorldState.COURIER: ws.Unit.COURIER,
+    vw.CMsgBotWorldState.WARD: ws.Unit.WARD,
+}
+
+
+def _winning_team(v: vw.CMsgBotWorldState) -> int:
+    """The Valve worldstate has no winner field; a dead ancient (FORT) is
+    the ground truth the reference derives the win from."""
+    for u in v.units:
+        if u.unit_type == vw.CMsgBotWorldState.FORT and (not u.is_alive or u.health <= 0):
+            return TEAM_DIRE if u.team_id == TEAM_RADIANT else TEAM_RADIANT
+    return 0
+
+
+def world_from_valve(v: vw.CMsgBotWorldState, team_id: Optional[int] = None) -> ws.World:
+    """Flatten one CMsgBotWorldState into the internal World schema."""
+    team = team_id if team_id is not None else v.team_id
+    out = ws.World(
+        dota_time=v.dota_time,
+        game_state=v.game_state,
+        tick=max(int(v.game_time * _TICKS_PER_SEC), 0),
+        team_id=team,
+        winning_team=_winning_team(v),
+    )
+    kd = {p.player_id: (p.kills, p.deaths) for p in v.players}
+    for p in v.players:
+        if p.team_id == team:
+            out.player_ids.append(p.player_id)
+    for u in v.units:
+        kills, deaths = kd.get(u.player_id, (0, 0)) if u.unit_type == vw.CMsgBotWorldState.HERO else (0, 0)
+        o = out.units.add(
+            handle=u.handle,
+            unit_type=_UNIT_TYPE.get(u.unit_type, ws.Unit.INVALID),
+            team_id=u.team_id,
+            name=u.name,
+            player_id=u.player_id if u.HasField("player_id") else -1,
+            x=u.location.x,
+            y=u.location.y,
+            z=u.location.z,
+            facing=u.facing,
+            speed=float(u.current_movement_speed or u.base_movement_speed),
+            level=u.level,
+            health=float(u.health),
+            health_max=float(u.health_max),
+            health_regen=u.health_regen,
+            mana=u.mana,
+            mana_max=u.mana_max,
+            attack_damage=float(u.attack_damage or u.base_damage),
+            attack_range=u.attack_range,
+            attack_speed=u.attack_speed,
+            armor=u.armor,
+            is_alive=u.is_alive,
+            is_attacking=u.attack_target_handle != 0,
+            attack_target_handle=u.attack_target_handle,
+            gold=u.reliable_gold + u.unreliable_gold,
+            xp=_xp_from_level(u.level, u.xp_needed_to_level),
+            xp_needed_to_level=u.xp_needed_to_level,
+            last_hits=u.last_hits,
+            denies=u.denies,
+            kills=kills,
+            deaths=deaths,
+        )
+        for a in u.abilities:
+            o.abilities.add(
+                ability_id=a.ability_id,
+                slot=a.slot,
+                level=a.level,
+                cooldown_remaining=a.cooldown_remaining,
+                # the real worldstate carries no mana costs;
+                # is_fully_castable already folds mana in, so a ready
+                # ability adapts to (castable, cost 0)
+                mana_cost=0.0,
+                is_castable=a.is_fully_castable,
+            )
+    return out
+
+
+def action_to_valve(a: ds.Action) -> VAction:
+    """One internal action → one Valve bot order (the reference's mapping:
+    grid-move via MOVE_DIRECTLY, attack via ATTACK_TARGET, cast via
+    CAST_TARGET)."""
+    v = VAction(player=a.player_id)
+    if a.type == ds.Action.MOVE:
+        v.actionType = VAction.DOTA_UNIT_ORDER_MOVE_DIRECTLY
+        v.moveDirectly.location.x = a.move_x
+        v.moveDirectly.location.y = a.move_y
+        v.moveDirectly.location.z = 0.0
+    elif a.type == ds.Action.ATTACK:
+        v.actionType = VAction.DOTA_UNIT_ORDER_ATTACK_TARGET
+        v.attackTarget.target = a.target_handle
+        v.attackTarget.once = False
+    elif a.type == ds.Action.CAST:
+        v.actionType = VAction.DOTA_UNIT_ORDER_CAST_TARGET
+        v.castTarget.abilitySlot = a.ability_slot
+        v.castTarget.target = a.target_handle
+    else:
+        v.actionType = VAction.DOTA_UNIT_ORDER_NONE
+    return v
+
+
+def actions_to_valve(acts: ds.Actions) -> vds.Actions:
+    return vds.Actions(
+        dota_time=acts.dota_time,
+        team_id=acts.team_id,
+        actions=[action_to_valve(a) for a in acts.actions],
+    )
+
+
+_CONTROL_MODE = {
+    # internal: 0 scripted, 1 policy, 2 scripted-hard. dotaservice: the
+    # built-in bot plays DEFAULT heroes; CONTROLLED heroes take our orders.
+    0: vds.HERO_CONTROL_MODE_DEFAULT,
+    1: vds.HERO_CONTROL_MODE_CONTROLLED,
+    2: vds.HERO_CONTROL_MODE_DEFAULT,
+}
+
+
+def game_config_to_valve(cfg: ds.GameConfig) -> vds.GameConfig:
+    out = vds.GameConfig(
+        host_timescale=cfg.host_timescale,
+        ticks_per_observation=cfg.ticks_per_observation,
+        host_mode=vds.HOST_MODE_DEDICATED,
+        game_mode=cfg.game_mode,
+    )
+    for p in cfg.hero_picks:
+        try:
+            hero = vds.Hero.Value(p.hero_name.upper()) if p.hero_name else vds.NPC_DOTA_HERO_NEVERMORE
+        except ValueError:  # hero not in the vendored enum subset
+            hero = vds.NPC_DOTA_HERO_NEVERMORE
+        out.hero_picks.add(
+            team_id=p.team_id,
+            hero_id=hero,
+            control_mode=_CONTROL_MODE.get(p.control_mode, vds.HERO_CONTROL_MODE_CONTROLLED),
+        )
+    return out
+
+
+_STATUS = {
+    vds.OK: ds.Observation.OK,
+    vds.RESOURCE_EXHAUSTED: ds.Observation.RESOURCE_EXHAUSTED,
+    vds.FAILED_PRECONDITION: ds.Observation.RESOURCE_EXHAUSTED,
+}
+
+
+def observation_from_valve(o: vds.Observation) -> ds.Observation:
+    out = ds.Observation(status=_STATUS.get(o.status, ds.Observation.OK), team_id=o.team_id)
+    if o.HasField("world_state"):
+        out.world_state.CopyFrom(world_from_valve(o.world_state, o.team_id or None))
+        # a finished game surfaces as EPISODE_DONE in the internal dialect
+        if out.world_state.winning_team:
+            out.status = ds.Observation.EPISODE_DONE
+    return out
+
+
+VALVE_SERVICE = "dotaservice.DotaService"
+
+
+class ValveDotaServiceStub:
+    """Drop-in for env.service's stub, speaking the real dotaservice wire
+    dialect. Converts internal↔Valve protos at the boundary, so the actor
+    loop (runtime/actor.py) needs zero changes to lane against a real
+    Dota 2 dedicated server. Works over sync and aio channels (awaitable
+    passthrough — same duck-typing as DotaServiceStub)."""
+
+    def __init__(self, channel):
+        self.channel = channel
+        self._reset = channel.unary_unary(
+            f"/{VALVE_SERVICE}/reset",
+            request_serializer=vds.GameConfig.SerializeToString,
+            response_deserializer=vds.InitialObservation.FromString,
+        )
+        self._observe = channel.unary_unary(
+            f"/{VALVE_SERVICE}/observe",
+            request_serializer=vds.ObserveConfig.SerializeToString,
+            response_deserializer=vds.Observation.FromString,
+        )
+        self._act = channel.unary_unary(
+            f"/{VALVE_SERVICE}/act",
+            request_serializer=vds.Actions.SerializeToString,
+            response_deserializer=vds.Empty.FromString,
+        )
+
+    async def reset(self, config: ds.GameConfig) -> ds.Observation:
+        init = await self._reset(game_config_to_valve(config))
+        out = ds.Observation(status=ds.Observation.OK, team_id=TEAM_RADIANT)
+        if init.HasField("world_state"):
+            out.world_state.CopyFrom(world_from_valve(init.world_state, TEAM_RADIANT))
+            del out.world_state.player_ids[:]
+            out.world_state.player_ids.extend(init.player_ids)
+        return out
+
+    async def observe(self, req: ds.ObserveRequest) -> ds.Observation:
+        return observation_from_valve(await self._observe(vds.ObserveConfig(team_id=req.team_id)))
+
+    async def act(self, acts: ds.Actions) -> ds.Empty:
+        await self._act(actions_to_valve(acts))
+        return ds.Empty()
+
+
+def connect_valve_async(addr: str) -> ValveDotaServiceStub:
+    """Connect the actor loop to a REAL dotaservice at `addr`."""
+    import grpc
+
+    from dotaclient_tpu.env.service import _unique_options
+
+    return ValveDotaServiceStub(grpc.aio.insecure_channel(addr, options=_unique_options()))
+
+
+# ---------------------------------------------------------------------------
+# Inverse direction: internal → Valve. Lets the fake dotaservice present
+# the REAL wire dialect (ValveFrontend below), so actors running
+# --env_dialect valve exercise the exact adapter path they would use
+# against a stock dotaservice — in CI, with no Dota install.
+
+_UNIT_TYPE_INV = {v: k for k, v in _UNIT_TYPE.items()}
+
+
+def world_to_valve(w: ws.World) -> vw.CMsgBotWorldState:
+    out = vw.CMsgBotWorldState(
+        team_id=w.team_id,
+        game_time=w.tick / _TICKS_PER_SEC,
+        dota_time=w.dota_time,
+        game_state=w.game_state,
+    )
+    for u in w.units:
+        if u.unit_type == ws.Unit.HERO:
+            out.players.add(
+                player_id=u.player_id,
+                is_alive=u.is_alive,
+                kills=u.kills,
+                deaths=u.deaths,
+                team_id=u.team_id,
+            )
+        v = out.units.add(
+            handle=u.handle,
+            unit_type=_UNIT_TYPE_INV.get(u.unit_type, vw.CMsgBotWorldState.INVALID),
+            name=u.name,
+            team_id=u.team_id,
+            level=u.level,
+            is_alive=u.is_alive,
+            facing=u.facing,
+            current_movement_speed=int(u.speed),
+            health=int(u.health),
+            health_max=int(u.health_max),
+            health_regen=u.health_regen,
+            mana=u.mana,
+            mana_max=u.mana_max,
+            attack_damage=int(u.attack_damage),
+            attack_range=u.attack_range,
+            attack_speed=u.attack_speed,
+            armor=u.armor,
+            attack_target_handle=u.attack_target_handle,
+            unreliable_gold=u.gold,
+            last_hits=u.last_hits,
+            denies=u.denies,
+            # encode total xp the only way the Valve schema can carry it:
+            # as the remainder to the next level on the Valve curve, so
+            # world_from_valve's reconstruction is exact whenever xp falls
+            # inside its level's bracket (clamped otherwise)
+            xp_needed_to_level=_xp_needed_for(u.level, u.xp),
+        )
+        if u.player_id >= 0:
+            v.player_id = u.player_id
+        v.location.x, v.location.y, v.location.z = u.x, u.y, u.z
+        for a in u.abilities:
+            v.abilities.add(
+                ability_id=a.ability_id,
+                slot=a.slot,
+                level=a.level,
+                cooldown_remaining=a.cooldown_remaining,
+                # fold the internal mana-cost gate into Valve's ready-now bit
+                is_fully_castable=bool(
+                    a.is_castable and a.cooldown_remaining <= 0.0 and a.mana_cost <= u.mana
+                ),
+            )
+    # a decided internal game must translate to the signal the forward
+    # adapter derives the win from: a dead ancient
+    if w.winning_team:
+        loser = TEAM_DIRE if w.winning_team == TEAM_RADIANT else TEAM_RADIANT
+        fort = out.units.add(
+            handle=0xF0F0,
+            unit_type=vw.CMsgBotWorldState.FORT,
+            team_id=loser,
+            is_alive=False,
+            health=0,
+            health_max=4500,
+        )
+        fort.location.x = -7200.0 if loser == TEAM_RADIANT else 7200.0
+    return out
+
+
+def action_from_valve(v: VAction) -> ds.Action:
+    a = ds.Action(player_id=v.player)
+    if v.actionType in (VAction.DOTA_UNIT_ORDER_MOVE_DIRECTLY, VAction.DOTA_UNIT_ORDER_MOVE_TO_POSITION):
+        loc = v.moveDirectly.location if v.HasField("moveDirectly") else v.moveToLocation.location
+        a.type = ds.Action.MOVE
+        a.move_x, a.move_y = loc.x, loc.y
+    elif v.actionType == VAction.DOTA_UNIT_ORDER_ATTACK_TARGET:
+        a.type = ds.Action.ATTACK
+        a.target_handle = v.attackTarget.target
+    elif v.actionType == VAction.DOTA_UNIT_ORDER_CAST_TARGET:
+        a.type = ds.Action.CAST
+        a.ability_slot = v.castTarget.abilitySlot
+        a.target_handle = v.castTarget.target
+    else:
+        a.type = ds.Action.NOOP
+    return a
+
+
+def game_config_from_valve(cfg: vds.GameConfig) -> ds.GameConfig:
+    out = ds.GameConfig(
+        host_timescale=cfg.host_timescale,
+        ticks_per_observation=cfg.ticks_per_observation,
+        game_mode=cfg.game_mode,
+    )
+    inv_mode = {
+        vds.HERO_CONTROL_MODE_CONTROLLED: 1,
+        vds.HERO_CONTROL_MODE_DEFAULT: 0,
+        vds.HERO_CONTROL_MODE_IDLE: 0,
+    }
+    for p in cfg.hero_picks:
+        out.hero_picks.add(
+            team_id=p.team_id,
+            hero_name=vds.Hero.Name(p.hero_id).lower(),
+            control_mode=inv_mode.get(p.control_mode, 1),
+        )
+    return out
+
+
+class ValveFrontend:
+    """Serves the real `/dotaservice.DotaService/...` dialect in front of
+    any internal DotaServiceServicer (e.g. the fake env). The mirror image
+    of ValveDotaServiceStub; together they round-trip every proto."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def reset(self, request: vds.GameConfig, context=None) -> vds.InitialObservation:
+        obs = self.inner.reset(game_config_from_valve(request), context)
+        out = vds.InitialObservation(player_ids=obs.world_state.player_ids)
+        out.world_state.CopyFrom(world_to_valve(obs.world_state))
+        return out
+
+    def observe(self, request: vds.ObserveConfig, context=None) -> vds.Observation:
+        obs = self.inner.observe(ds.ObserveRequest(team_id=request.team_id), context)
+        status = {
+            ds.Observation.OK: vds.OK,
+            ds.Observation.EPISODE_DONE: vds.OK,  # valve signals the end via the worldstate
+            ds.Observation.RESOURCE_EXHAUSTED: vds.RESOURCE_EXHAUSTED,
+        }[obs.status]
+        out = vds.Observation(status=status, team_id=obs.team_id)
+        if obs.HasField("world_state"):
+            w = world_to_valve(obs.world_state)
+            if obs.status == ds.Observation.EPISODE_DONE and not obs.world_state.winning_team:
+                # internal draw: mark post-game so the adapted status still
+                # terminates the episode (both ancients stand)
+                w.game_state = 6
+            out.world_state.CopyFrom(w)
+        return out
+
+    def act(self, request: vds.Actions, context=None) -> vds.Empty:
+        internal = ds.Actions(
+            dota_time=request.dota_time,
+            team_id=request.team_id,
+            actions=[action_from_valve(a) for a in request.actions],
+        )
+        self.inner.act(internal, context)
+        return vds.Empty()
+
+
+def add_valve_frontend_to_server(frontend: ValveFrontend, server) -> None:
+    import grpc
+
+    methods = {
+        "reset": (vds.GameConfig, vds.InitialObservation),
+        "observe": (vds.ObserveConfig, vds.Observation),
+        "act": (vds.Actions, vds.Empty),
+    }
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(frontend, name),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
+        for name, (req, resp) in methods.items()
+    }
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(VALVE_SERVICE, handlers),))
+
+
+def serve_valve(inner, port: int = 0, max_workers: int = 4):
+    """Start a valve-dialect server in front of an internal servicer;
+    returns (server, bound_port)."""
+    from concurrent import futures
+
+    import grpc
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_valve_frontend_to_server(ValveFrontend(inner), server)
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound

@@ -5,5 +5,7 @@
 # dotaclient_tpu/env/service.py using grpc's generic handler API.
 set -e
 protoc --python_out=. -I. worldstate.proto dotaservice.proto
+protoc --python_out=. -I. valve_worldstate.proto valve_dotaservice.proto
 # protoc emits absolute sibling imports; make them package-relative.
 sed -i 's/^import worldstate_pb2 as/from . import worldstate_pb2 as/' dotaservice_pb2.py
+sed -i 's/^import valve_worldstate_pb2 as/from . import valve_worldstate_pb2 as/' valve_dotaservice_pb2.py

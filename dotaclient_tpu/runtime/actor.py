@@ -269,7 +269,12 @@ class Actor:
     @property
     def stub(self) -> AsyncDotaServiceStub:
         if self._stub is None:
-            self._stub = connect_async(self.cfg.env_addr)
+            if getattr(self.cfg, "env_dialect", "internal") == "valve":
+                from dotaclient_tpu.env.valve_adapter import connect_valve_async
+
+                self._stub = connect_valve_async(self.cfg.env_addr)
+            else:
+                self._stub = connect_async(self.cfg.env_addr)
         return self._stub
 
     async def run_episode(self) -> float:

@@ -149,7 +149,12 @@ class SelfPlayActor:
     @property
     def stub(self) -> AsyncDotaServiceStub:
         if self._stub is None:
-            self._stub = connect_async(self.cfg.env_addr)
+            if getattr(self.cfg, "env_dialect", "internal") == "valve":
+                from dotaclient_tpu.env.valve_adapter import connect_valve_async
+
+                self._stub = connect_valve_async(self.cfg.env_addr)
+            else:
+                self._stub = connect_async(self.cfg.env_addr)
         return self._stub
 
     def _pick_opponent(self) -> None:

@@ -1,0 +1,223 @@
+"""Golden tests: featurize/reward over hand-built REAL-schema worldstates
+(VERDICT r1 item 5 — the framework must attach to Valve's
+`CMsgBotWorldState`, not only its internal invention), plus the
+valve-dialect end-to-end loop: Actor(--env_dialect valve) → ValveFrontend
+→ fake env, exercising the exact stub path a stock dotaservice would see.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env import rewards as R
+from dotaclient_tpu.env import valve_adapter as VA
+from dotaclient_tpu.protos import dotaservice_pb2 as ds
+from dotaclient_tpu.protos import valve_dotaservice_pb2 as vds
+from dotaclient_tpu.protos import valve_worldstate_pb2 as vw
+
+
+def valve_world(hero_health=450, enemy=True, creeps=2, fort_dead=False, cooldown=0.0):
+    """Hand-built CMsgBotWorldState, fed through wire bytes like a real
+    dotaservice response."""
+    w = vw.CMsgBotWorldState(team_id=2, dota_time=120.0, game_time=135.0, game_state=5)
+    w.players.add(player_id=0, team_id=2, is_alive=True, kills=3, deaths=1)
+    w.players.add(player_id=5, team_id=3, is_alive=True, kills=1, deaths=3)
+    h = w.units.add(
+        handle=101, unit_type=vw.CMsgBotWorldState.HERO, name="npc_dota_hero_nevermore",
+        team_id=2, player_id=0, level=6, is_alive=True, facing=0.5,
+        health=hero_health, health_max=900, health_regen=2.5,
+        mana=273.0, mana_max=435.0, current_movement_speed=315,
+        attack_damage=61, attack_range=500.0, armor=3.2,
+        reliable_gold=220, unreliable_gold=410, last_hits=28, denies=4,
+        xp_needed_to_level=100,
+    )
+    h.location.x, h.location.y, h.location.z = -900.0, -820.0, 256.0
+    h.abilities.add(ability_id=5059, slot=0, level=3, cooldown_remaining=cooldown,
+                    is_fully_castable=cooldown <= 0.0)
+    if enemy:
+        e = w.units.add(
+            handle=102, unit_type=vw.CMsgBotWorldState.HERO, name="npc_dota_hero_sniper",
+            team_id=3, player_id=5, level=5, is_alive=True,
+            health=700, health_max=760, mana=300, mana_max=350,
+            current_movement_speed=290, attack_damage=50, attack_range=550.0,
+        )
+        e.location.x, e.location.y = -400.0, -700.0
+    for i in range(creeps):
+        c = w.units.add(
+            handle=200 + i, unit_type=vw.CMsgBotWorldState.LANE_CREEP, team_id=3,
+            is_alive=True, health=300, health_max=550, attack_damage=21,
+            current_movement_speed=325,
+        )
+        c.location.x, c.location.y = -700.0 + 60 * i, -800.0
+    if fort_dead:
+        f = w.units.add(handle=400, unit_type=vw.CMsgBotWorldState.FORT, team_id=3,
+                        is_alive=False, health=0, health_max=4500)
+        f.location.x = 7200.0
+    return vw.CMsgBotWorldState.FromString(w.SerializeToString())
+
+
+def test_world_from_valve_field_mapping():
+    w = VA.world_from_valve(valve_world())
+    hero = F.find_hero(w, 0)
+    assert hero is not None and hero.name == "npc_dota_hero_nevermore"
+    assert hero.x == -900.0 and hero.health == 450.0 and hero.health_max == 900.0
+    assert hero.gold == 630  # reliable 220 + unreliable 410
+    assert hero.kills == 3 and hero.deaths == 1  # joined from Player messages
+    assert hero.speed == 315.0
+    assert hero.level == 6
+    # xp reconstruction: monotone in level, reduced by xp_needed_to_level
+    assert hero.xp == VA._XP_TO_REACH[7] - 100
+    assert w.tick == int(135.0 * 30)
+    assert list(w.player_ids) == [0]
+    assert w.winning_team == 0
+
+
+def test_golden_featurization_of_real_schema():
+    """The featurizer's numbers over an adapted real-schema worldstate —
+    pinned values so adapter OR featurizer drift breaks loudly."""
+    obs = F.featurize(VA.world_from_valve(valve_world()), player_id=0)
+    hf = obs.hero_feats
+    assert abs(hf[0] - 6 / 25.0) < 1e-6  # level
+    assert abs(hf[1] - 0.5) < 1e-6  # hp fraction 450/900
+    assert abs(hf[4] - 273.0 / 435.0) < 1e-6  # mana fraction
+    assert abs(hf[9] - 61.0 / 200.0) < 1e-6  # attack damage
+    assert abs(hf[10] - 0.5) < 1e-6  # attack range 500/1000
+    assert abs(hf[12] - np.log1p(630) / 10.0) < 1e-5  # gold (reliable+unreliable)
+    assert abs(hf[14] - 0.28) < 1e-6  # last hits 28/100
+    assert hf[19] == 1.0  # ability ready (is_fully_castable)
+    # 3 enemies (sniper + 2 creeps) → all legal targets, CAST legal
+    assert obs.unit_mask.sum() == 3 and obs.target_mask.sum() == 3
+    assert obs.action_mask.tolist() == [True, True, True, True]
+    # nearest-first ordering: creeps (~216, ~265) before sniper (~515)
+    d = obs.unit_feats[:3, 10] * 3000.0
+    assert d[0] < d[1] < d[2] < 600
+
+
+def test_cooldown_masks_cast_through_adapter():
+    obs = F.featurize(VA.world_from_valve(valve_world(cooldown=4.0)), player_id=0)
+    assert not obs.action_mask[F.ACT_CAST]
+    assert obs.hero_feats[19] == 0.0
+    assert abs(obs.hero_feats[17] - 0.4) < 1e-6  # cooldown 4s/10
+
+
+def test_rewards_run_on_adapted_worlds():
+    prev = VA.world_from_valve(valve_world(hero_health=500))
+    nxt_raw = valve_world(hero_health=400, fort_dead=True)
+    nxt = VA.world_from_valve(nxt_raw)
+    assert nxt.winning_team == 2  # dire ancient down → radiant won
+    comps = R.component_rewards(prev, nxt, player_id=0)
+    assert comps["win"] == 1.0
+    assert abs(comps["hp"] - (400 - 500) / 900.0) < 1e-6
+    assert np.isfinite(R.total_reward(comps))
+
+
+def test_action_adapters_round_trip():
+    internal = ds.Actions(
+        dota_time=12.5,
+        team_id=2,
+        actions=[
+            ds.Action(type=ds.Action.MOVE, player_id=0, move_x=100.0, move_y=-50.0),
+            ds.Action(type=ds.Action.ATTACK, player_id=0, target_handle=200),
+            ds.Action(type=ds.Action.CAST, player_id=0, target_handle=102, ability_slot=0),
+            ds.Action(type=ds.Action.NOOP, player_id=0),
+        ],
+    )
+    v = vds.Actions.FromString(VA.actions_to_valve(internal).SerializeToString())
+    VA_ = vw.CMsgBotWorldState.Action
+    assert v.actions[0].actionType == VA_.DOTA_UNIT_ORDER_MOVE_DIRECTLY
+    assert v.actions[0].moveDirectly.location.x == 100.0
+    assert v.actions[1].actionType == VA_.DOTA_UNIT_ORDER_ATTACK_TARGET
+    assert v.actions[1].attackTarget.target == 200
+    assert v.actions[2].actionType == VA_.DOTA_UNIT_ORDER_CAST_TARGET
+    assert v.actions[2].castTarget.target == 102
+    back = [VA.action_from_valve(a) for a in v.actions]
+    for orig, rt in zip(internal.actions, back):
+        assert rt.type == orig.type and rt.target_handle == orig.target_handle
+    assert abs(back[0].move_x - 100.0) < 1e-6
+
+
+def test_game_config_round_trip():
+    cfg = ds.GameConfig(
+        host_timescale=10.0,
+        ticks_per_observation=30,
+        hero_picks=[
+            ds.HeroPick(team_id=2, hero_name="npc_dota_hero_nevermore", control_mode=1),
+            ds.HeroPick(team_id=3, hero_name="npc_dota_hero_sniper", control_mode=0),
+        ],
+    )
+    v = VA.game_config_to_valve(cfg)
+    assert v.hero_picks[0].hero_id == vds.NPC_DOTA_HERO_NEVERMORE
+    assert v.hero_picks[0].control_mode == vds.HERO_CONTROL_MODE_CONTROLLED
+    assert v.hero_picks[1].control_mode == vds.HERO_CONTROL_MODE_DEFAULT
+    back = VA.game_config_from_valve(v)
+    assert back.hero_picks[0].hero_name == "npc_dota_hero_nevermore"
+    assert back.hero_picks[0].control_mode == 1
+    assert back.ticks_per_observation == 30
+
+
+def test_world_round_trip_preserves_featurization():
+    """internal → valve → internal must featurize identically (the fake
+    env behind a ValveFrontend must look the same to the policy)."""
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+
+    svc = FakeDotaService()
+    obs = svc.reset(ds.GameConfig(ticks_per_observation=30, seed=3, max_dota_time=60.0))
+    w0 = obs.world_state
+    w1 = VA.world_from_valve(
+        vw.CMsgBotWorldState.FromString(VA.world_to_valve(w0).SerializeToString()),
+        w0.team_id,
+    )
+    a, _ = F.featurize_with_handles(w0, 0)
+    b, _ = F.featurize_with_handles(w1, 0)
+    for x, y, name in zip(a, b, a._fields):
+        if name == "hero_feats":
+            # hero_feats[18] (ability mana cost) is the one knowingly lossy
+            # field: Valve's worldstate carries no mana costs — the cost
+            # gate arrives folded into is_fully_castable instead
+            np.testing.assert_allclose(x[:18], y[:18], atol=1e-5, err_msg=name)
+            np.testing.assert_allclose(x[19:], y[19:], atol=1e-5, err_msg=name)
+            assert y[18] == 0.0
+        else:
+            np.testing.assert_allclose(x, y, atol=1e-5, err_msg=name)
+
+
+def test_actor_runs_full_episode_over_valve_dialect():
+    """The headline: the UNMODIFIED actor loop laning over the real wire
+    dialect — Actor(--env_dialect valve) → ValveFrontend → fake env."""
+    from dotaclient_tpu.config import ActorConfig, PolicyConfig
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.runtime.actor import Actor
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect as broker_connect
+    from dotaclient_tpu.transport.serialize import deserialize_rollout
+
+    server, port = VA.serve_valve(FakeDotaService())
+    try:
+        mem.reset("valve_e2e")
+        cfg = ActorConfig(
+            env_addr=f"127.0.0.1:{port}",
+            env_dialect="valve",
+            rollout_len=8,
+            max_dota_time=30.0,
+            policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"),
+            seed=4,
+        )
+        broker = broker_connect("mem://valve_e2e")
+        actor = Actor(cfg, broker_connect("mem://valve_e2e"), actor_id=7)
+        asyncio.new_event_loop().run_until_complete(actor.run_episode())
+        frames = broker.consume_experience(1000, timeout=0.2)
+        assert frames, "no rollouts published over the valve dialect"
+        total = casts = 0
+        for f in frames:
+            r = deserialize_rollout(f)
+            assert np.isfinite(r.behavior_logp).all()
+            assert np.isfinite(r.rewards).all()
+            total += r.length
+            casts += int((r.actions.type == F.ACT_CAST).sum())
+        assert total > 5
+        assert casts > 0  # CAST orders flowed through CAST_TARGET and back
+        assert deserialize_rollout(frames[-1]).dones[-1] == 1.0  # episode terminated
+    finally:
+        server.stop(0)
