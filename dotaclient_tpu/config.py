@@ -92,6 +92,11 @@ class ActorConfig:
     # "self":          mirror self-play, both sides live weights (runtime/selfplay.py)
     # "league":        PFSP league self-play vs frozen snapshots (eval/league.py)
     opponent: str = "scripted"
+    # Heroes per team (1 = the 1v1 ladder rungs; 5 = BASELINE configs 4-5
+    # team play). Self-play batches ALL controlled heroes into one jit
+    # call per tick (B = 2*team_size mirror, B = team_size per side in
+    # league mode) and publishes per-hero trajectories.
+    team_size: int = 1
     league_capacity: int = 8  # max snapshots in the local league pool
     league_snapshot_every: int = 20  # learner versions between snapshots
     pfsp_mode: str = "hard"  # "hard" | "even" | "uniform"

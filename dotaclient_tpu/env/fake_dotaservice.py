@@ -60,8 +60,12 @@ CONTROL_SCRIPTED_HARD = 2
 
 RADIANT_PLAYER, DIRE_PLAYER = 0, 5
 
-_HERO_HANDLE = 1
-_ENEMY_HERO_HANDLE = 2
+# Dota player-slot convention: radiant 0-4, dire 5-9. Hero handles are
+# 1+player_id (creep handles start at 100, far above).
+_TEAM_BASE = {TEAM_RADIANT: RADIANT_PLAYER, TEAM_DIRE: DIRE_PLAYER}
+_MAX_TEAM_SIZE = 5
+# lane y-offsets fanning a team's heroes out around the mid lane
+_SPAWN_SPREAD = (0.0, -140.0, 140.0, -280.0, 280.0)
 _TICKS_PER_SEC = 30.0
 
 _CREEP_HP = 550.0
@@ -156,44 +160,52 @@ class LastHitLaneGame:
         self.next_wave_time = 0.0
         self.winning_team = 0  # 0 while running, and still 0 on a draw
         self.ended = False
-        # hero picks: name → stat profile (env/heroes.py); missing picks
-        # fall back to the default hero
-        names = {TEAM_RADIANT: heroes.DEFAULT_HERO, TEAM_DIRE: heroes.DEFAULT_HERO}
+        # Hero picks: one pick = one hero; N picks per team = NvN (5v5 is
+        # BASELINE configs 4-5). Player ids assign per Dota convention —
+        # radiant 0..4, dire 5..9, in pick order. Teams with no picks get
+        # the legacy 1v1 default (radiant policy vs dire scripted).
+        picks_by_team = {TEAM_RADIANT: [], TEAM_DIRE: []}
         for pick in config.hero_picks:
-            if pick.hero_name and pick.team_id in names:
-                names[pick.team_id] = pick.hero_name
+            if pick.team_id in picks_by_team and len(picks_by_team[pick.team_id]) < _MAX_TEAM_SIZE:
+                picks_by_team[pick.team_id].append(pick)
 
-        def make_hero(handle, team, x, pid):
-            prof = heroes.profile(names[team])
-            return _Unit(
-                handle,
-                ws.Unit.HERO,
-                team,
-                x,
-                0.0,
-                prof.hp,
-                player_id=pid,
-                name=names[team],
-                damage=prof.damage,
-                atk_range=prof.attack_range,
-                move_speed=prof.speed,
-                regen=prof.regen,
-            )
-
-        self.hero = make_hero(_HERO_HANDLE, TEAM_RADIANT, -1500.0, RADIANT_PLAYER)
-        self.enemy_hero = make_hero(_ENEMY_HERO_HANDLE, TEAM_DIRE, 1500.0, DIRE_PLAYER)
-        self.heroes: Dict[int, _Unit] = {RADIANT_PLAYER: self.hero, DIRE_PLAYER: self.enemy_hero}
+        self.heroes: Dict[int, _Unit] = {}
+        self.stats_by: Dict[int, dict] = {}
+        self.control: Dict[int, int] = {}
+        self._xp_trickle: Dict[int, float] = {}
+        for team, picks in picks_by_team.items():
+            sign = -1.0 if team == TEAM_RADIANT else 1.0
+            default_control = CONTROL_POLICY if team == TEAM_RADIANT else CONTROL_SCRIPTED
+            if not picks:
+                picks = [None]
+            for i, pick in enumerate(picks):
+                pid = _TEAM_BASE[team] + i
+                name = pick.hero_name if pick is not None and pick.hero_name else heroes.DEFAULT_HERO
+                prof = heroes.profile(name)
+                self.heroes[pid] = _Unit(
+                    1 + pid,
+                    ws.Unit.HERO,
+                    team,
+                    sign * 1500.0,
+                    _SPAWN_SPREAD[i],
+                    prof.hp,
+                    player_id=pid,
+                    name=name,
+                    damage=prof.damage,
+                    atk_range=prof.attack_range,
+                    move_speed=prof.speed,
+                    regen=prof.regen,
+                )
+                self.stats_by[pid] = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
+                self.control[pid] = pick.control_mode if pick is not None else default_control
+                self._xp_trickle[pid] = 0.0
+        # 1v1 aliases (first hero of each side) — the scripted retreat
+        # logic, worldstate stats and several tests address them directly
+        self.hero = self.heroes[RADIANT_PLAYER]
+        self.enemy_hero = self.heroes[DIRE_PLAYER]
+        self.stats = self.stats_by[RADIANT_PLAYER]
+        self.enemy_stats = self.stats_by[DIRE_PLAYER]
         self.creeps: list[_Unit] = []
-        self.stats = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
-        self.enemy_stats = {"xp": 0, "gold": 600, "last_hits": 0, "denies": 0, "kills": 0, "deaths": 0}
-        self.stats_by: Dict[int, dict] = {RADIANT_PLAYER: self.stats, DIRE_PLAYER: self.enemy_stats}
-        # control mode per player: radiant defaults to policy, dire to
-        # scripted (back-compat with 1v1-vs-bot configs without picks).
-        self.control: Dict[int, int] = {RADIANT_PLAYER: CONTROL_POLICY, DIRE_PLAYER: CONTROL_SCRIPTED}
-        for pick in config.hero_picks:
-            pid = RADIANT_PLAYER if pick.team_id == TEAM_RADIANT else DIRE_PLAYER
-            self.control[pid] = pick.control_mode
-        self._xp_trickle: Dict[int, float] = {RADIANT_PLAYER: 0.0, DIRE_PLAYER: 0.0}
         # pending action per player, applied on next step
         self.pending: Dict[int, ds.Action] = {}
         # highest tick each team has been served (observe steps the world
@@ -213,7 +225,7 @@ class LastHitLaneGame:
         self.dota_time += dt
         self.tick += int(dt * _TICKS_PER_SEC)
         self._maybe_spawn_wave()
-        for pid in (RADIANT_PLAYER, DIRE_PLAYER):
+        for pid in self.heroes:
             if self.control[pid] == CONTROL_POLICY:
                 self._apply_hero_action(pid, dt)
             else:
@@ -300,9 +312,11 @@ class LastHitLaneGame:
         last-hits low-hp enemy creeps in range (it farms, so beating it
         on net worth requires genuinely better laning)."""
         me = self.heroes[pid]
-        foe = self.heroes[DIRE_PLAYER if pid == RADIANT_PLAYER else RADIANT_PLAYER]
         if not me.alive:
             return
+        # nearest living enemy hero (NvN-aware; None once they're all down)
+        foes = [h for h in self.heroes.values() if h.team != me.team and h.alive]
+        foe = min(foes, key=lambda f: self._dist(me, f)) if foes else None
         home_x = -1200.0 if me.team == TEAM_RADIANT else 1200.0
         if hard and me.hp < 0.25 * me.hp_max:
             self._move_toward(me, home_x * 1.3, 0.0, me.move_speed * dt)
@@ -319,9 +333,9 @@ class LastHitLaneGame:
             if lastable:
                 self._hero_attack(pid, min(lastable, key=lambda c: c.hp), dt)
                 return
-        if foe.alive and self._dist(me, foe) <= me.atk_range:
+        if foe is not None and self._dist(me, foe) <= me.atk_range:
             self._hero_attack(pid, foe, dt)
-        elif foe.alive and self._dist(me, foe) < _ENEMY_PURSUE_RADIUS:
+        elif foe is not None and self._dist(me, foe) < _ENEMY_PURSUE_RADIUS:
             self._move_toward(me, foe.x, foe.y, me.move_speed * 0.8 * dt)
         else:
             # hold position on its own side — diving it is punished,
@@ -364,14 +378,23 @@ class LastHitLaneGame:
                 self.stats_by[pid]["xp"] += whole
                 self._xp_trickle[pid] -= whole
 
+    def _team_net_worth(self, team: int) -> int:
+        return sum(
+            self.stats_by[pid]["gold"] + self.stats_by[pid]["xp"]
+            for pid, h in self.heroes.items()
+            if h.team == team
+        )
+
     def _check_end(self) -> None:
-        if not self.hero.alive:
+        rad_alive = any(h.alive for h in self.heroes.values() if h.team == TEAM_RADIANT)
+        dire_alive = any(h.alive for h in self.heroes.values() if h.team == TEAM_DIRE)
+        if not rad_alive:
             self.winning_team, self.ended = TEAM_DIRE, True
-        elif not self.enemy_hero.alive:
+        elif not dire_alive:
             self.winning_team, self.ended = TEAM_RADIANT, True
         elif self.dota_time >= self.max_time:
-            mine = self.stats["gold"] + self.stats["xp"]
-            theirs = self.enemy_stats["gold"] + self.enemy_stats["xp"]
+            mine = self._team_net_worth(TEAM_RADIANT)
+            theirs = self._team_net_worth(TEAM_DIRE)
             self.ended = True
             if mine != theirs:  # exact tie = draw (winning_team stays 0) —
                 # mirror self-play with identical play must not hand
@@ -381,10 +404,9 @@ class LastHitLaneGame:
     # ------------------------------------------------------------- helpers
 
     def _find(self, handle: int) -> Optional[_Unit]:
-        if handle == _HERO_HANDLE:
-            return self.hero
-        if handle == _ENEMY_HERO_HANDLE:
-            return self.enemy_hero
+        for h in self.heroes.values():
+            if h.handle == handle:
+                return h
         for c in self.creeps:
             if c.handle == handle:
                 return c
@@ -414,8 +436,9 @@ class LastHitLaneGame:
             team_id=team_id,
             winning_team=self.winning_team,
         )
-        w.player_ids.append(RADIANT_PLAYER if team_id == TEAM_RADIANT else DIRE_PLAYER)
-        for u, stats in ((self.hero, self.stats), (self.enemy_hero, self.enemy_stats)):
+        w.player_ids.extend(pid for pid, h in self.heroes.items() if h.team == team_id)
+        for pid, u in self.heroes.items():
+            stats = self.stats_by[pid]
             w.units.add(
                 handle=u.handle,
                 unit_type=ws.Unit.HERO,

@@ -199,6 +199,9 @@ def game_config_to_valve(cfg: ds.GameConfig) -> vds.GameConfig:
         ticks_per_observation=cfg.ticks_per_observation,
         host_mode=vds.HOST_MODE_DEDICATED,
         game_mode=cfg.game_mode,
+        # extension fields; a stock dotaservice skips them (see .proto)
+        max_dota_time=cfg.max_dota_time,
+        seed=cfg.seed,
     )
     for p in cfg.hero_picks:
         try:
@@ -209,6 +212,10 @@ def game_config_to_valve(cfg: ds.GameConfig) -> vds.GameConfig:
             team_id=p.team_id,
             hero_id=hero,
             control_mode=_CONTROL_MODE.get(p.control_mode, vds.HERO_CONTROL_MODE_CONTROLLED),
+            # preserves hard-bot (mode 2) across the dialect boundary —
+            # stock semantics only know DEFAULT, which would silently
+            # downgrade the TrueSkill yardstick to the passive bot
+            bot_difficulty=p.control_mode if p.control_mode != 1 else 0,
         )
     return out
 
@@ -224,8 +231,12 @@ def observation_from_valve(o: vds.Observation) -> ds.Observation:
     out = ds.Observation(status=_STATUS.get(o.status, ds.Observation.OK), team_id=o.team_id)
     if o.HasField("world_state"):
         out.world_state.CopyFrom(world_from_valve(o.world_state, o.team_id or None))
-        # a finished game surfaces as EPISODE_DONE in the internal dialect
-        if out.world_state.winning_team:
+        # A finished game surfaces as EPISODE_DONE in the internal dialect.
+        # Two signals, both needed: a dead ancient (decided game) OR
+        # post-game state (>= 6) — a DRAW ends with both ancients standing,
+        # and without the game_state check the actor loop would spin on the
+        # final observation forever.
+        if out.world_state.winning_team or o.world_state.game_state >= 6:
             out.status = ds.Observation.EPISODE_DONE
     return out
 
@@ -390,17 +401,18 @@ def game_config_from_valve(cfg: vds.GameConfig) -> ds.GameConfig:
         host_timescale=cfg.host_timescale,
         ticks_per_observation=cfg.ticks_per_observation,
         game_mode=cfg.game_mode,
+        max_dota_time=cfg.max_dota_time,
+        seed=cfg.seed,
     )
-    inv_mode = {
-        vds.HERO_CONTROL_MODE_CONTROLLED: 1,
-        vds.HERO_CONTROL_MODE_DEFAULT: 0,
-        vds.HERO_CONTROL_MODE_IDLE: 0,
-    }
     for p in cfg.hero_picks:
+        if p.control_mode == vds.HERO_CONTROL_MODE_CONTROLLED:
+            mode = 1
+        else:  # DEFAULT/IDLE: bot_difficulty restores hard-bot (2)
+            mode = p.bot_difficulty if p.bot_difficulty in (0, 2) else 0
         out.hero_picks.add(
             team_id=p.team_id,
             hero_name=vds.Hero.Name(p.hero_id).lower(),
-            control_mode=inv_mode.get(p.control_mode, 1),
+            control_mode=mode,
         )
     return out
 
@@ -429,9 +441,9 @@ class ValveFrontend:
         out = vds.Observation(status=status, team_id=obs.team_id)
         if obs.HasField("world_state"):
             w = world_to_valve(obs.world_state)
-            if obs.status == ds.Observation.EPISODE_DONE and not obs.world_state.winning_team:
-                # internal draw: mark post-game so the adapted status still
-                # terminates the episode (both ancients stand)
+            if obs.status == ds.Observation.EPISODE_DONE:
+                # post-game state — for a DRAW this is the ONLY end signal
+                # (both ancients stand; winning_team stays 0)
                 w.game_state = 6
             out.world_state.CopyFrom(w)
         return out

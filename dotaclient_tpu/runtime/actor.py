@@ -72,6 +72,17 @@ def check_weight_freshness(actor) -> None:
         )
 
 
+def connect_env_async(cfg: ActorConfig) -> AsyncDotaServiceStub:
+    """Dialect-aware env stub factory shared by Actor and SelfPlayActor:
+    'valve' speaks a real dotaservice's wire schema through the adapter,
+    anything else the internal protos."""
+    if getattr(cfg, "env_dialect", "internal") == "valve":
+        from dotaclient_tpu.env.valve_adapter import connect_valve_async
+
+        return connect_valve_async(cfg.env_addr)
+    return connect_async(cfg.env_addr)
+
+
 async def reset_env_stub(actor) -> None:
     """Tear down the env channel after an RPC failure so the next episode
     reconnects from scratch (shared by Actor and SelfPlayActor; both keep
@@ -269,12 +280,7 @@ class Actor:
     @property
     def stub(self) -> AsyncDotaServiceStub:
         if self._stub is None:
-            if getattr(self.cfg, "env_dialect", "internal") == "valve":
-                from dotaclient_tpu.env.valve_adapter import connect_valve_async
-
-                self._stub = connect_valve_async(self.cfg.env_addr)
-            else:
-                self._stub = connect_async(self.cfg.env_addr)
+            self._stub = connect_env_async(self.cfg)
         return self._stub
 
     async def run_episode(self) -> float:

@@ -1,4 +1,5 @@
-"""Self-play actor: both heroes of a 1v1 game driven by one process.
+"""Self-play actor: all controlled heroes of a game driven by one process
+(1v1 mirror/league up to full 5v5 team play, cfg.team_size).
 
 The reference's self-play opponent is the latest (or lagged) copy of the
 learner's weights (SURVEY.md §2 "Eval / rating", BASELINE configs 3/5);
@@ -16,9 +17,11 @@ game synchronization:
   (radiant) side publishes experience. Snapshots are taken from the
   weight broadcasts the actor receives anyway — no extra transport.
 
-TPU-first detail: in mirror mode the two sides' observations are stacked
-into ONE batched jit call per tick (B=2) — the policy step is a single
-compiled program either way; batching players is how 5v5 scales too.
+TPU-first detail: ALL controlled heroes' observations are stacked into
+batched jit calls per tick — 5v5 mirror is one B=10 policy step, league
+mode one B=5 step per team's params. The policy step is a single
+compiled program at every team size; per-hero trajectories publish
+independently (team play = BASELINE configs 4-5).
 """
 
 from __future__ import annotations
@@ -37,7 +40,7 @@ from dotaclient_tpu.config import ActorConfig
 from dotaclient_tpu.env import featurizer as F
 from dotaclient_tpu.env import heroes
 from dotaclient_tpu.env import rewards as R
-from dotaclient_tpu.env.service import AsyncDotaServiceStub, connect_async
+from dotaclient_tpu.env.service import AsyncDotaServiceStub
 from dotaclient_tpu.eval.league import League, Snapshot
 from dotaclient_tpu.models import policy as P
 from dotaclient_tpu.ops import action_dist as ad
@@ -47,6 +50,7 @@ from dotaclient_tpu.runtime.actor import (
     _Chunk,
     build_action,
     check_weight_freshness,
+    connect_env_async,
     make_actor_step,
     reset_env_stub,
 )
@@ -149,12 +153,7 @@ class SelfPlayActor:
     @property
     def stub(self) -> AsyncDotaServiceStub:
         if self._stub is None:
-            if getattr(self.cfg, "env_dialect", "internal") == "valve":
-                from dotaclient_tpu.env.valve_adapter import connect_valve_async
-
-                self._stub = connect_valve_async(self.cfg.env_addr)
-            else:
-                self._stub = connect_async(self.cfg.env_addr)
+            self._stub = connect_env_async(self.cfg)
         return self._stub
 
     def _pick_opponent(self) -> None:
@@ -182,12 +181,30 @@ class SelfPlayActor:
         self.rollouts_published += 1
         side.chunk = _Chunk(side.state)
 
+    def _batched_step(self, params, group: list, key) -> None:
+        """ONE jit call for a group of sides (B = len(group)) — this is
+        the TPU-first scaling story for team play: 5v5 mirror is a single
+        B=10 policy step per tick, not ten B=1 steps."""
+        obs_b = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *[s.obs for s in group])
+        state_b = jax.tree.map(lambda *xs: jnp.concatenate(xs), *[s.state for s in group])
+        state_b, action_b, logp_b, value_b = self.step_fn(params, state_b, obs_b, key)
+        action_h = jax.device_get(action_b)
+        logp_h = jax.device_get(logp_b)
+        value_h = jax.device_get(value_b)
+        for i, s in enumerate(group):
+            s.state = jax.tree.map(lambda x: x[i : i + 1], state_b)
+            s._step_record = (_slice_action(action_h, i), float(logp_h[i]), float(value_h[i]))
+            s._action_h, s._batch_index = action_h, i
+
     async def run_episode(self) -> float:
         cfg = self.cfg
         self.last_win = None
         self._pick_opponent()
         mirror = self._opp_params is None  # also league-mode fallback
         pool = heroes.parse_pool(cfg.hero)
+        n = max(1, min(int(getattr(cfg, "team_size", 1)), 5))
+        rad_pids = [RADIANT_PLAYER + i for i in range(n)]
+        dire_pids = [DIRE_PLAYER + i for i in range(n)]
         config = ds.GameConfig(
             host_timescale=cfg.host_timescale,
             ticks_per_observation=cfg.ticks_per_observation,
@@ -195,72 +212,66 @@ class SelfPlayActor:
             seed=self.np_rng.randint(1 << 30),
             hero_picks=[
                 ds.HeroPick(
-                    team_id=TEAM_RADIANT,
+                    team_id=team,
                     hero_name=pool[self.np_rng.randint(len(pool))],
                     control_mode=1,
-                ),
-                ds.HeroPick(
-                    team_id=TEAM_DIRE,
-                    hero_name=pool[self.np_rng.randint(len(pool))],
-                    control_mode=1,
-                ),
+                )
+                for team in (TEAM_RADIANT, TEAM_DIRE)
+                for _ in range(n)
             ],
         )
         resp = await self.stub.reset(config)
-        sides: Dict[int, _Side] = {
-            RADIANT_PLAYER: _Side(RADIANT_PLAYER, TEAM_RADIANT, cfg),
-            DIRE_PLAYER: _Side(DIRE_PLAYER, TEAM_DIRE, cfg),
-        }
-        live, opp = sides[RADIANT_PLAYER], sides[DIRE_PLAYER]
-        live.world = resp.world_state
-        opp.world = (await self.stub.observe(ds.ObserveRequest(team_id=TEAM_DIRE))).world_state
+        sides: Dict[int, _Side] = {}
+        for pid in rad_pids:
+            sides[pid] = _Side(pid, TEAM_RADIANT, cfg)
+        for pid in dire_pids:
+            sides[pid] = _Side(pid, TEAM_DIRE, cfg)
+        live_team = [sides[p] for p in rad_pids]
+        opp_team = [sides[p] for p in dire_pids]
+        live = live_team[0]  # reporting anchor (return/win bookkeeping)
+        rad_world = resp.world_state
+        dire_world = (await self.stub.observe(ds.ObserveRequest(team_id=TEAM_DIRE))).world_state
         for s in sides.values():
+            s.world = rad_world if s.team_id == TEAM_RADIANT else dire_world
             s.obs, s.handles = F.featurize_with_handles(s.world, s.player_id)
 
         done = False
         while not done:
-            actions: Dict[int, ds.Action] = {}
+            self.rng, key = jax.random.split(self.rng)
             if mirror:
-                # one batched policy step for both sides
-                obs_b = jax.tree.map(
-                    lambda a, b: jnp.stack([jnp.asarray(a), jnp.asarray(b)]),
-                    live.obs,
-                    opp.obs,
-                )
-                state_b = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), live.state, opp.state)
-                self.rng, key = jax.random.split(self.rng)
-                state_b, action_b, logp_b, value_b = self.step_fn(self.params, state_b, obs_b, key)
-                action_h = jax.device_get(action_b)
-                logp_h = jax.device_get(logp_b)
-                value_h = jax.device_get(value_b)
-                for i, s in enumerate((live, opp)):
-                    s.state = jax.tree.map(lambda x: x[i : i + 1], state_b)
-                    hero = F.find_hero(s.world, s.player_id)
-                    actions[s.player_id] = build_action(
-                        cfg, action_h, s.handles, hero, s.player_id, batch_index=i
-                    )
-                    s._step_record = (_slice_action(action_h, i), float(logp_h[i]), float(value_h[i]))
+                # every controlled hero, both teams, one compiled call
+                self._batched_step(self.params, live_team + opp_team, key)
             else:
-                for s, params in ((live, self.params), (opp, self._opp_params)):
-                    obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], s.obs)
-                    self.rng, key = jax.random.split(self.rng)
-                    s.state, action, logp, value = self.step_fn(params, s.state, obs_b, key)
-                    action_h = jax.device_get(action)
-                    hero = F.find_hero(s.world, s.player_id)
-                    actions[s.player_id] = build_action(cfg, action_h, s.handles, hero, s.player_id)
-                    s._step_record = (action_h, float(logp[0]), float(value[0]))
+                key_live, key_opp = jax.random.split(key)
+                self._batched_step(self.params, live_team, key_live)
+                self._batched_step(self._opp_params, opp_team, key_opp)
 
+            actions: Dict[int, ds.Action] = {}
             for s in sides.values():
                 hero = F.find_hero(s.world, s.player_id)
                 if hero is not None:
                     snap = ws.Unit()
                     snap.CopyFrom(hero)
                     s.last_hero = snap
+                actions[s.player_id] = build_action(
+                    cfg, s._action_h, s.handles, hero, s.player_id, batch_index=s._batch_index
+                )
 
+            # one act() per team, team_id set: a real dotaservice routes
+            # orders per team — mixing both teams in one call only happens
+            # to work against the fake env (which keys on player_id)
             await self.stub.act(
                 ds.Actions(
-                    actions=[actions[RADIANT_PLAYER], actions[DIRE_PLAYER]],
+                    actions=[actions[p] for p in rad_pids],
                     dota_time=live.world.dota_time,
+                    team_id=TEAM_RADIANT,
+                )
+            )
+            await self.stub.act(
+                ds.Actions(
+                    actions=[actions[p] for p in dire_pids],
+                    dota_time=live.world.dota_time,
+                    team_id=TEAM_DIRE,
                 )
             )
             r2 = await self.stub.observe(ds.ObserveRequest(team_id=TEAM_RADIANT))
@@ -271,8 +282,8 @@ class SelfPlayActor:
             r3 = await self.stub.observe(ds.ObserveRequest(team_id=TEAM_DIRE))
             done = r2.status == ds.Observation.EPISODE_DONE
 
-            for s, resp_s in ((live, r2), (opp, r3)):
-                next_world = resp_s.world_state
+            for s in sides.values():
+                next_world = (r2 if s.team_id == TEAM_RADIANT else r3).world_state
                 next_obs, next_handles = F.featurize_with_handles(next_world, s.player_id)
                 rew = R.reward(s.world, next_world, s.player_id, s.last_hero)
                 s.episode_return += rew
@@ -297,7 +308,10 @@ class SelfPlayActor:
                     win = 0.0
                     if done and winning:
                         win = 1.0 if winning == s.team_id else -1.0
-                    publish = s is live or mirror  # frozen opponent: no data
+                    # mirror publishes every hero (2n trajectories/chunk
+                    # window); league publishes only the live team's n —
+                    # the frozen opponent yields no data
+                    publish = s.team_id == TEAM_RADIANT or mirror
                     if publish:
                         self._publish(s, win, done)
                     else:

@@ -29,11 +29,11 @@ def env_addr():
 
 
 def make_cfg(env_addr, opponent="self", **kw):
+    kw.setdefault("policy", SMALL)
     return ActorConfig(
         env_addr=env_addr,
         rollout_len=8,
         max_dota_time=10.0,
-        policy=SMALL,
         seed=4,
         opponent=opponent,
         **kw,
@@ -118,3 +118,68 @@ def test_selfplay_rejects_scripted_mode(env_addr):
     mem.reset("sp4")
     with pytest.raises(ValueError):
         SelfPlayActor(make_cfg(env_addr, opponent="scripted"), broker_connect("mem://sp4"))
+
+
+def test_5v5_fake_env_scripted_runs():
+    """10-hero games: spawn, per-team player_ids, scripted play, and the
+    team-wipe end rule (VERDICT r1 item 7 — BASELINE configs 4-5 had no
+    path to run)."""
+    from dotaclient_tpu.protos import dotaservice_pb2 as ds
+    from dotaclient_tpu.protos import worldstate_pb2 as ws
+    from dotaclient_tpu.env.fake_dotaservice import LastHitLaneGame, TEAM_DIRE, TEAM_RADIANT
+
+    picks = [
+        ds.HeroPick(team_id=t, hero_name="", control_mode=0)
+        for t in (TEAM_RADIANT,) * 5 + (TEAM_DIRE,) * 5
+    ]
+    game = LastHitLaneGame(ds.GameConfig(ticks_per_observation=30, seed=21, max_dota_time=30.0, hero_picks=picks))
+    assert len(game.heroes) == 10
+    assert sorted(game.heroes) == [0, 1, 2, 3, 4, 5, 6, 7, 8, 9]
+    w_rad = game.worldstate(TEAM_RADIANT)
+    assert list(w_rad.player_ids) == [0, 1, 2, 3, 4]
+    assert list(game.worldstate(TEAM_DIRE).player_ids) == [5, 6, 7, 8, 9]
+    assert sum(1 for u in w_rad.units if u.unit_type == ws.Unit.HERO) == 10
+    for _ in range(40):
+        game.step()
+        if game.ended:
+            break
+    assert game.ended
+    # team-wipe rule: killing ONE dire hero must not end a 5v5 game
+    game2 = LastHitLaneGame(ds.GameConfig(ticks_per_observation=30, seed=22, max_dota_time=300.0, hero_picks=picks))
+    game2.heroes[5].hp = -1.0
+    game2.heroes[5].alive = False
+    game2._check_end()
+    assert not game2.ended
+    for pid in (6, 7, 8, 9):
+        game2.heroes[pid].alive = False
+    game2._check_end()
+    assert game2.ended and game2.winning_team == TEAM_RADIANT
+
+
+def test_5v5_mirror_publishes_per_hero_trajectories(env_addr):
+    """The VERDICT item-7 'done' bar: an e2e 5v5 episode with aux heads
+    on, every controlled hero batched into one jit call, per-hero
+    trajectories published for BOTH teams."""
+    mem.reset("sp5v5")
+    broker = broker_connect("mem://sp5v5")
+    cfg = make_cfg(env_addr, team_size=5, policy=PolicyConfig(
+        unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32", aux_heads=True,
+    ))
+    actor = SelfPlayActor(cfg, broker, actor_id=0)
+    run_one(actor)
+    frames = broker.consume_experience(max_items=1000, timeout=1.0)
+    rollouts = [deserialize_rollout(f) for f in frames]
+    # 10 heroes publish in lockstep: every chunk window yields 10 frames
+    assert len(rollouts) >= 10 and len(rollouts) % 10 == 0
+    team_feats = [float(r.obs.global_feats[0, 4]) for r in rollouts]
+    assert team_feats.count(1.0) == len(rollouts) // 2   # radiant halves
+    assert team_feats.count(-1.0) == len(rollouts) // 2  # dire halves
+    for r in rollouts:
+        assert np.isfinite(r.behavior_logp).all()
+        assert np.isfinite(r.rewards).all()
+        assert r.aux is not None  # aux heads targets rode along
+        assert np.isfinite(r.aux.net_worth).all()
+    # the 10 perspectives genuinely differ (different heroes, same world)
+    first_window = rollouts[:10]
+    hero_rows = {r.obs.hero_feats[: r.length].tobytes() for r in first_window}
+    assert len(hero_rows) == 10

@@ -221,3 +221,82 @@ def test_actor_runs_full_episode_over_valve_dialect():
         assert deserialize_rollout(frames[-1]).dones[-1] == 1.0  # episode terminated
     finally:
         server.stop(0)
+
+
+def test_draw_terminates_over_valve_dialect():
+    """Review regression: a drawn game (both ancients standing,
+    winning_team 0) must still adapt to EPISODE_DONE — the draw's only
+    wire signal is post-game state."""
+    internal = ds.Observation(status=ds.Observation.EPISODE_DONE, team_id=2)
+    internal.world_state.dota_time = 10.0
+    internal.world_state.game_state = 5
+    internal.world_state.team_id = 2  # no winning_team: a draw
+
+    class _Inner:
+        def observe(self, request, context=None):
+            return internal
+
+    front = VA.ValveFrontend(_Inner())
+    wire = front.observe(vds.ObserveConfig(team_id=2))
+    wire = vds.Observation.FromString(wire.SerializeToString())
+    back = VA.observation_from_valve(wire)
+    assert back.status == ds.Observation.EPISODE_DONE
+    assert back.world_state.winning_team == 0
+
+
+def test_config_round_trip_preserves_horizon_seed_and_hard_bot():
+    """Review regression: max_dota_time/seed/hard-bot must survive the
+    dialect (they were silently dropped, collapsing episode diversity and
+    downgrading the TrueSkill yardstick to the passive bot)."""
+    cfg = ds.GameConfig(
+        host_timescale=10.0,
+        ticks_per_observation=30,
+        max_dota_time=45.0,
+        seed=12345,
+        hero_picks=[
+            ds.HeroPick(team_id=2, hero_name="npc_dota_hero_nevermore", control_mode=1),
+            ds.HeroPick(team_id=3, hero_name="npc_dota_hero_sniper", control_mode=2),
+        ],
+    )
+    v = vds.GameConfig.FromString(VA.game_config_to_valve(cfg).SerializeToString())
+    back = VA.game_config_from_valve(v)
+    assert back.max_dota_time == 45.0
+    assert back.seed == 12345
+    assert back.hero_picks[1].control_mode == 2  # hard bot survives
+
+
+def test_5v5_selfplay_over_valve_dialect():
+    """5v5 mirror self-play across the real wire dialect: per-team act()
+    routing, 10 hero trajectories, bounded episodes via the horizon
+    extension field."""
+    from dotaclient_tpu.config import ActorConfig, PolicyConfig
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.runtime.selfplay import SelfPlayActor
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect as broker_connect
+    from dotaclient_tpu.transport.serialize import deserialize_rollout
+
+    server, port = VA.serve_valve(FakeDotaService(), max_workers=4)
+    try:
+        mem.reset("valve5v5")
+        cfg = ActorConfig(
+            env_addr=f"127.0.0.1:{port}",
+            env_dialect="valve",
+            opponent="self",
+            team_size=5,
+            rollout_len=8,
+            max_dota_time=10.0,
+            policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"),
+            seed=13,
+        )
+        broker = broker_connect("mem://valve5v5")
+        actor = SelfPlayActor(cfg, broker_connect("mem://valve5v5"), actor_id=2)
+        asyncio.new_event_loop().run_until_complete(actor.run_episode())
+        frames = broker.consume_experience(1000, timeout=0.5)
+        rollouts = [deserialize_rollout(f) for f in frames]
+        assert len(rollouts) >= 10 and len(rollouts) % 10 == 0
+        teams = [float(r.obs.global_feats[0, 4]) for r in rollouts]
+        assert teams.count(1.0) == teams.count(-1.0) == len(rollouts) // 2
+        assert rollouts[-1].dones[-1] == 1.0  # horizon honored → terminated
+    finally:
+        server.stop(0)
