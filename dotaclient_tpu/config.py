@@ -69,6 +69,10 @@ class LearnerConfig:
     # jax.profiler server port (0 = off); connect with TensorBoard's
     # profile plugin or jax.profiler.trace to capture device traces
     profile_port: int = 0
+    # "" = default backend (TPU in production). "cpu" pins the learner to
+    # host devices — CPU smoke deployments, and hosts whose TPU plugin
+    # would hang backend init.
+    platform: str = ""
 
 
 @dataclass

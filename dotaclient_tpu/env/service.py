@@ -84,6 +84,49 @@ class DotaServiceStub:
 AsyncDotaServiceStub = DotaServiceStub
 
 
+class _LocalContext:
+    """Stands in for grpc's ServicerContext on the in-process path; the
+    fake env keys sessions by peer()."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def peer(self) -> str:
+        return self._name
+
+
+class _ClosableNone:
+    async def close(self) -> None:  # duck-types grpc.aio channel teardown
+        pass
+
+
+class LocalDotaServiceStub:
+    """In-process stub: same async surface as DotaServiceStub, zero gRPC.
+
+    For many-actor single-process runs (learning smokes, benchmarks) the
+    gRPC loopback hop is pure overhead — and grpc.aio pollers across many
+    threads on a small host actively thrash. Each stub gets its own peer
+    name so the fake env gives it a private session, exactly like a
+    distinct network client."""
+
+    _n = 0
+
+    def __init__(self, servicer: DotaServiceServicer, name: Optional[str] = None):
+        LocalDotaServiceStub._n += 1
+        self._servicer = servicer
+        self._ctx = _LocalContext(name or f"local-{LocalDotaServiceStub._n}")
+        self.channel = _ClosableNone()  # reset_env_stub closes channels
+
+    async def reset(self, request):
+        return self._servicer.reset(request, self._ctx)
+
+    async def observe(self, request):
+        return self._servicer.observe(request, self._ctx)
+
+    async def act(self, request):
+        return self._servicer.act(request, self._ctx)
+
+
 _uid = 0
 
 

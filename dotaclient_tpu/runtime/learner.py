@@ -139,6 +139,8 @@ def main(argv=None):
 
     logging.basicConfig(level=logging.INFO)
     cfg = parse_config(LearnerConfig(), argv)
+    if cfg.platform:
+        jax.config.update("jax_platforms", cfg.platform)
     broker = broker_connect(cfg.broker_url)
     learner = Learner(cfg, broker)
     _log.info(

@@ -9,7 +9,9 @@ clear message instead of at module import time.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+import time
+from collections import deque
+from typing import Deque, List, Optional
 
 from dotaclient_tpu.transport.base import Broker
 
@@ -40,6 +42,18 @@ class RmqBroker(Broker):
         res = self._ch.queue_declare(queue="", exclusive=True)
         self._model_queue = res.method.queue
         self._ch.queue_bind(exchange=MODEL_EXCHANGE, queue=self._model_queue)
+        # Long-lived experience consumer, registered lazily on the FIRST
+        # consume_experience call: only the learner consumes, so actor-side
+        # brokers never register one (a registered consumer would steal
+        # frames). Messages land in _exp_buf from process_data_events.
+        # This replaces the old per-call consume()/cancel() churn — a
+        # consumer (de)registration round-trip per batch is the classic
+        # slow way to drain AMQP.
+        self._exp_buf: Deque[bytes] = deque()
+        self._consuming = False
+
+    def _on_experience(self, _ch, _method, _props, body) -> None:
+        self._exp_buf.append(body)
 
     def publish_experience(self, data: bytes) -> None:
         with self._lock:
@@ -53,20 +67,27 @@ class RmqBroker(Broker):
     def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
         # Contract (transport.base): block up to `timeout` (None = forever)
         # for the FIRST frame only, then drain without waiting.
-        out: List[bytes] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
-            for _method, _props, body in self._ch.consume(
-                EXPERIENCE_QUEUE, inactivity_timeout=timeout, auto_ack=True
-            ):
-                if body is not None:
-                    out.append(body)
-                break  # first frame (or first-wait timeout) only
-            self._ch.cancel()
-            while len(out) < max_items:
-                _method, _props, body = self._ch.basic_get(EXPERIENCE_QUEUE, auto_ack=True)
-                if body is None:
-                    break
-                out.append(body)
+            if not self._consuming:
+                self._ch.basic_consume(
+                    EXPERIENCE_QUEUE, on_message_callback=self._on_experience, auto_ack=True
+                )
+                self._consuming = True
+            while not self._exp_buf:
+                if deadline is None:
+                    slice_s = 0.2
+                else:
+                    slice_s = deadline - time.monotonic()
+                    if slice_s <= 0:
+                        break
+                # pump I/O: deliveries invoke _on_experience
+                self._conn.process_data_events(time_limit=min(slice_s, 0.2))
+            out: List[bytes] = []
+            # drain whatever has been prefetched, no further waiting
+            self._conn.process_data_events(time_limit=0)
+            while self._exp_buf and len(out) < max_items:
+                out.append(self._exp_buf.popleft())
         return out
 
     def publish_weights(self, data: bytes) -> None:

@@ -122,3 +122,72 @@ def test_misconfigured_actor_frames_dropped_not_fatal():
         assert buf.stats()["consumer_errors"] == 0
     finally:
         buf.stop()
+
+
+def test_staging_stress_many_producers_with_stats_reader():
+    """Race-surface stress (SURVEY.md §5): N producer threads hammer the
+    broker while the consumer thread ingests/packs and a separate thread
+    polls stats() the whole time (the learner's metrics path). Checks
+    conservation — every frame is consumed exactly once, every batch well
+    formed — and that stats() never throws or corrupts the heartbeat map."""
+    import threading
+
+    mem.reset("stress")
+    broker = connect("mem://stress")
+    n_producers, frames_each = 8, 60
+    cfg = LearnerConfig(
+        batch_size=4,
+        seq_len=8,
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16),
+        native_packer=False,  # python path: exercises the pure-python ingest
+    )
+    staging = StagingBuffer(cfg, broker, version_fn=lambda: 0)
+    staging.start()
+
+    def produce(k):
+        conn = connect("mem://stress")
+        for i in range(frames_each):
+            conn.publish_experience(
+                serialize_rollout(make_rollout(L=8, H=8, version=0, seed=k * 1000 + i, actor_id=k))
+            )
+
+    stop_stats = threading.Event()
+    stats_errors = []
+
+    def stats_reader():
+        while not stop_stats.is_set():
+            try:
+                s = staging.stats()
+                assert 0 <= s["active_actors"] <= n_producers
+            except Exception as e:  # pragma: no cover - the assertion IS the test
+                stats_errors.append(e)
+                return
+
+    threads = [threading.Thread(target=produce, args=(k,)) for k in range(n_producers)]
+    reader = threading.Thread(target=stats_reader, daemon=True)
+    reader.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+
+    total = n_producers * frames_each
+    batches, seen_steps = 0, 0
+    deadline = time.monotonic() + 60
+    while seen_steps < (total // cfg.batch_size) * cfg.batch_size * 8 and time.monotonic() < deadline:
+        b = staging.get_batch(timeout=5.0)
+        if b is None:
+            break
+        batches += 1
+        assert b.mask.shape == (cfg.batch_size, cfg.seq_len)
+        seen_steps += int(b.mask.sum())
+    stop_stats.set()
+    reader.join(timeout=10)
+    staging.stop()
+
+    assert not stats_errors, stats_errors
+    stats = staging.stats()
+    assert stats["consumed"] == total
+    assert stats["dropped_bad"] == 0 and stats["dropped_stale"] == 0
+    assert batches == total // cfg.batch_size
+    assert stats["active_actors"] == n_producers  # every producer heartbeated
