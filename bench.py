@@ -81,47 +81,126 @@ def _make_frames(cfg: LearnerConfig, n_frames: int):
     return frames
 
 
-def _probe_tpu(timeout_s: float = 90.0) -> bool:
+def _probe_tpu():
     """Check TPU backend health in a subprocess with a hard timeout.
 
     The image's axon TPU plugin has two failure modes: a fast RuntimeError
-    and an indefinite hang inside jax.devices() (observed rounds 1-2). A
+    and an indefinite hang inside jax.devices() (observed rounds 1-3). A
     hang in-process would poison jax's init lock, so probe out-of-process;
     only if the probe succeeds do we let the parent init the TPU backend.
+
+    Two hard-won details (round 3):
+    - stdout/stderr go to temp FILES, not pipes, and the probe runs in its
+      own session killed as a GROUP on timeout. The plugin forks helper
+      processes; with pipes, subprocess.run's post-kill reap blocks forever
+      on the fds those orphans inherit (observed: single-threaded select
+      hang in _communicate).
+    - JAX_PLATFORMS=cpu is NOT a way to dodge the plugin: sitecustomize
+      sets jax_platforms="axon,cpu" programmatically, overriding the env
+      var. Only an in-process jax.config.update after import wins.
+
+    Returns (ok, reason): on failure `reason` carries the probe's actual
+    rc/stderr tail so a CPU-fallback bench JSON documents the infra fault
+    instead of hiding it (round-2 verdict item 1b).
     """
+    import os
+    import signal
     import subprocess
     import sys
+    import tempfile
 
-    for attempt in range(2):
-        try:
-            out = subprocess.run(
+    reasons = []
+    for timeout_s in (90.0, 300.0):
+        with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
+            proc = subprocess.Popen(
                 [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-                capture_output=True,
-                timeout=timeout_s,
+                stdout=out_f,
+                stderr=err_f,
+                start_new_session=True,
             )
-            if out.returncode == 0 and out.stdout.strip().isdigit():
-                return True
-        except subprocess.TimeoutExpired:
-            pass
-        if attempt == 0:
-            time.sleep(15)
-    return False
+            timed_out = False
+            try:
+                rc = proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                rc = None
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait()
+            out_f.seek(0)
+            err_f.seek(0)
+            out = out_f.read().decode(errors="replace").strip()
+            err_lines = err_f.read().decode(errors="replace").strip().splitlines()
+            if not timed_out and rc == 0 and out.isdigit():
+                return True, ""
+            tail = " | ".join(err_lines[-3:]) if err_lines else "<empty>"
+            reasons.append(
+                f"probe({timeout_s:.0f}s): "
+                f"{'TIMEOUT inside jax.devices()' if timed_out else f'rc={rc}'} "
+                f"stderr_tail={tail}"
+            )
+        if timeout_s != 300.0:  # no sleep after the final attempt
+            time.sleep(10)
+    return False, "; ".join(reasons)
 
 
 def _init_devices():
     """Initialize JAX devices: real TPU if reachable, else host CPU.
 
     Either way the bench produces its one JSON line; a CPU fallback is
-    flagged in the unit string and vs_baseline stays honest.
+    flagged in the unit string + fallback_reason, and vs_baseline stays
+    honest (scaled to the per-chip share).
+
+    DOTACLIENT_TPU_BENCH_PLATFORM=cpu skips the ~7-minute probe schedule
+    and pins the host backend — for iterating on the bench itself on
+    machines where the TPU plugin is known-hung.
     """
-    if _probe_tpu():
-        return jax.devices()
+    import os
+
+    if os.environ.get("DOTACLIENT_TPU_BENCH_PLATFORM") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices("cpu"), "forced by DOTACLIENT_TPU_BENCH_PLATFORM=cpu"
+    ok, reason = _probe_tpu()
+    if ok:
+        return jax.devices(), ""
     jax.config.update("jax_platforms", "cpu")
-    return jax.devices("cpu")
+    return jax.devices("cpu"), reason
+
+
+def _start_producers(cfg, broker_name: str, n_threads: int = 2):
+    """Producer threads republishing pre-serialized frames.
+
+    Depth-throttled: keep the queue comfortably full (≥2 batches ready),
+    then yield. Unthrottled spin-publishing into a bounded drop-oldest
+    queue models nothing real — actors never outrun the learner by 100×
+    — and on a CPU-fallback host it burns the very cores XLA computes
+    on, polluting the e2e number with fake contention.
+    """
+    mem.reset(broker_name)
+    producer_conn = connect(f"mem://{broker_name}", maxlen=cfg.batch_size * 4)
+    frames = _make_frames(cfg, 512)
+    stop = threading.Event()
+    high_water = cfg.batch_size * 3
+
+    def producer():
+        i = 0
+        while not stop.is_set():
+            if producer_conn.experience_depth() >= high_water:
+                time.sleep(0.001)
+                continue
+            producer_conn.publish_experience(frames[i % len(frames)])
+            i += 1
+
+    threads = [threading.Thread(target=producer, daemon=True) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    return stop
 
 
 def main() -> None:
-    devices = _init_devices()
+    devices, fallback_reason = _init_devices()
     n_dev = len(devices)
     on_cpu_fallback = devices[0].platform == "cpu"
     cfg = LearnerConfig(batch_size=256, seq_len=16, mesh_shape="dp=-1")
@@ -139,33 +218,48 @@ def main() -> None:
     jax.block_until_ready(metrics["loss"])
     device_rate = cfg.batch_size * cfg.seq_len * 20 / (time.perf_counter() - t0)
 
-    # ---- end-to-end rate: producer thread → broker → staging → device
-    mem.reset("bench")
-    producer_conn = connect("mem://bench", maxlen=cfg.batch_size * 4)
-    frames = _make_frames(cfg, 512)
-    stop = threading.Event()
+    # ---- host-pipeline-only rate: broker → staging → packed batches,
+    # no device work (VERDICT r2 item 5: prove host packing headroom)
+    stop = _start_producers(cfg, "bench_pack")
+    staging = StagingBuffer(cfg, connect("mem://bench_pack"), version_fn=lambda: 0).start()
+    staging.get_batch(timeout=120.0)  # pipe warm
+    pack_steps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 3.0:
+        b = staging.get_batch(timeout=120.0)
+        pack_steps += int(np.sum(b.mask))
+    packer_rate = pack_steps / (time.perf_counter() - t0)
+    stop.set()
+    staging.stop()
 
-    def producer():
-        i = 0
-        while not stop.is_set():
-            producer_conn.publish_experience(frames[i % len(frames)])
-            i += 1
-
+    # ---- end-to-end rate: producers → broker → staging → device, with
+    # the learner's round-3 overlap (prefetch + device_put of batch N+1
+    # while step N runs; no per-iteration device sync)
+    stop = _start_producers(cfg, "bench")
     staging = StagingBuffer(cfg, connect("mem://bench"), version_fn=lambda: 0).start()
-    threads = [threading.Thread(target=producer, daemon=True) for _ in range(2)]
-    for t in threads:
-        t.start()
 
-    n_iters = 12
-    warm = staging.get_batch(timeout=120.0)  # first batch out of the pipe
-    state, metrics = train_step(state, jax.device_put(warm, batch_sh))
+    def fetch():
+        t0 = time.perf_counter()
+        b = staging.get_batch(timeout=120.0)
+        t1 = time.perf_counter()
+        dev = jax.device_put(b, batch_sh)
+        return dev, int(np.sum(b.mask)), t1 - t0, time.perf_counter() - t1
+
+    warm, _, _, _ = fetch()
+    state, metrics = train_step(state, warm)
     jax.block_until_ready(metrics["loss"])
+    n_iters = 12
     env_steps = 0
+    t_wait = t_put = 0.0
+    nxt, nxt_steps, w, p = fetch()
     t0 = time.perf_counter()
     for _ in range(n_iters):
-        b = staging.get_batch(timeout=120.0)
-        env_steps += int(np.sum(b.mask))
-        state, metrics = train_step(state, jax.device_put(b, batch_sh))
+        dev, env_n = nxt, nxt_steps
+        state, metrics = train_step(state, dev)  # async dispatch
+        env_steps += env_n
+        nxt, nxt_steps, w, p = fetch()  # overlaps the in-flight step
+        t_wait += w
+        t_put += p
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
     stop.set()
@@ -173,21 +267,30 @@ def main() -> None:
 
     e2e_rate = env_steps / dt
     baseline = BASELINE_PER_CHIP * n_dev
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_learner_env_steps_per_sec",
-                "value": round(e2e_rate, 1),
-                "unit": (
-                    f"env-steps/sec end-to-end ({n_dev} "
-                    f"{'CPU-FALLBACK device(s)' if on_cpu_fallback else 'chip(s)'}, "
-                    f"batch {cfg.batch_size}x{cfg.seq_len}; device-step-only rate "
-                    f"{round(device_rate, 1)})"
-                ),
-                "vs_baseline": round(e2e_rate / baseline, 3),
-            }
-        )
-    )
+    out = {
+        "metric": "ppo_learner_env_steps_per_sec",
+        "value": round(e2e_rate, 1),
+        "unit": (
+            f"env-steps/sec end-to-end ({n_dev} "
+            f"{'CPU-FALLBACK device(s)' if on_cpu_fallback else 'chip(s)'}, "
+            f"batch {cfg.batch_size}x{cfg.seq_len}; device-step-only rate "
+            f"{round(device_rate, 1)}; host-packer-only rate {round(packer_rate, 1)})"
+        ),
+        "vs_baseline": round(e2e_rate / baseline, 3),
+        # per-stage split, seconds per iteration averaged over the run
+        # (residual = device step + dispatch; the loop never syncs per-step)
+        "split": {
+            "wait_batch_s": round(t_wait / n_iters, 5),
+            "device_put_s": round(t_put / n_iters, 5),
+            "residual_step_s": round(max(dt - t_wait - t_put, 0.0) / n_iters, 5),
+        },
+        "device_only_steps_per_sec": round(device_rate, 1),
+        "packer_only_steps_per_sec": round(packer_rate, 1),
+        "e2e_over_device_only": round(e2e_rate / device_rate, 3),
+    }
+    if on_cpu_fallback and fallback_reason:
+        out["fallback_reason"] = fallback_reason
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

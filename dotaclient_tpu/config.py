@@ -60,6 +60,11 @@ class LearnerConfig:
     checkpoint_dir: str = ""
     checkpoint_every: int = 100  # steps between durable checkpoints
     publish_every: int = 1  # steps between weight fanout publishes
+    # Steps between host↔device metric syncs. Fetching the metrics dict
+    # forces a device sync; doing it every step serializes the host onto
+    # the step's critical path (the round-2 e2e-vs-device gap). Scalars
+    # are logged once per window with window-averaged timings.
+    metrics_every: int = 10
     log_dir: str = ""
     seed: int = 0
     mesh_shape: str = "dp=-1"  # e.g. "dp=4,tp=2"; -1 = all remaining devices

@@ -28,6 +28,12 @@ from dotaclient_tpu.protos import worldstate_pb2 as ws
 
 # ---------------------------------------------------------------------------
 # Schema constants (shared with the policy).
+#
+# FEATURE_SCHEMA_VERSION stamps checkpoints (runtime/checkpoint.py) so a
+# restore across an incompatible feature layout fails with a
+# self-explanatory message instead of a bare shape mismatch.
+# History: v1 = 24-dim HERO_FEATURES; v2 = 28 (ability features added).
+FEATURE_SCHEMA_VERSION = 2
 MAX_UNITS = 16
 UNIT_FEATURES = 16
 # 16 stat features + 4 ability features (slot-0 readiness/cooldown/cost —

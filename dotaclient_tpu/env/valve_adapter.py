@@ -131,7 +131,18 @@ def world_from_valve(v: vw.CMsgBotWorldState, team_id: Optional[int] = None) -> 
             is_attacking=u.attack_target_handle != 0,
             attack_target_handle=u.attack_target_handle,
             gold=u.reliable_gold + u.unreliable_gold,
-            xp=_xp_from_level(u.level, u.xp_needed_to_level),
+            # xp is reconstructed only for heroes: creeps/buildings carry
+            # level 0 and would be credited phantom xp, and a hero whose
+            # optional xp_needed_to_level is absent gets the BOTTOM of its
+            # level bracket, not a spurious full-next-level total
+            # (ADVICE r2). Only hero rows feed xp rewards/features.
+            xp=(
+                _xp_from_level(u.level, u.xp_needed_to_level)
+                if u.unit_type == vw.CMsgBotWorldState.HERO and u.HasField("xp_needed_to_level")
+                else _XP_TO_REACH[max(1, min(u.level, len(_XP_TO_REACH) - 1))]
+                if u.unit_type == vw.CMsgBotWorldState.HERO
+                else 0
+            ),
             xp_needed_to_level=u.xp_needed_to_level,
             last_hits=u.last_hits,
             denies=u.denies,
@@ -248,8 +259,10 @@ class ValveDotaServiceStub:
     """Drop-in for env.service's stub, speaking the real dotaservice wire
     dialect. Converts internal↔Valve protos at the boundary, so the actor
     loop (runtime/actor.py) needs zero changes to lane against a real
-    Dota 2 dedicated server. Works over sync and aio channels (awaitable
-    passthrough — same duck-typing as DotaServiceStub)."""
+    Dota 2 dedicated server. Works over sync and aio channels: a sync
+    channel's multicallable returns the message directly, an aio one
+    returns an awaitable — `_call` awaits only the latter (same
+    duck-typing as DotaServiceStub)."""
 
     def __init__(self, channel):
         self.channel = channel
@@ -269,8 +282,15 @@ class ValveDotaServiceStub:
             response_deserializer=vds.Empty.FromString,
         )
 
+    @staticmethod
+    async def _call(result):
+        """Await aio-channel results, pass sync-channel messages through."""
+        import inspect
+
+        return await result if inspect.isawaitable(result) else result
+
     async def reset(self, config: ds.GameConfig) -> ds.Observation:
-        init = await self._reset(game_config_to_valve(config))
+        init = await self._call(self._reset(game_config_to_valve(config)))
         out = ds.Observation(status=ds.Observation.OK, team_id=TEAM_RADIANT)
         if init.HasField("world_state"):
             out.world_state.CopyFrom(world_from_valve(init.world_state, TEAM_RADIANT))
@@ -279,10 +299,12 @@ class ValveDotaServiceStub:
         return out
 
     async def observe(self, req: ds.ObserveRequest) -> ds.Observation:
-        return observation_from_valve(await self._observe(vds.ObserveConfig(team_id=req.team_id)))
+        return observation_from_valve(
+            await self._call(self._observe(vds.ObserveConfig(team_id=req.team_id)))
+        )
 
     async def act(self, acts: ds.Actions) -> ds.Empty:
-        await self._act(actions_to_valve(acts))
+        await self._call(self._act(actions_to_valve(acts)))
         return ds.Empty()
 
 

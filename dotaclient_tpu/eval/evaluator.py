@@ -72,7 +72,7 @@ class Evaluator:
 
     SCRIPTED = "scripted"
 
-    def __init__(self, cfg: ActorConfig, name: str = "agent"):
+    def __init__(self, cfg: ActorConfig, name: str = "agent", stub=None):
         from dotaclient_tpu.runtime.actor import Actor
 
         if cfg.opponent not in ("scripted", "scripted_hard"):
@@ -88,8 +88,10 @@ class Evaluator:
         # One persistent loop + actor so the jit cache and the gRPC channel
         # survive across evaluate() calls (fresh loops would orphan the
         # aio channel; fresh actors would recompile the step fn).
+        # `stub` (e.g. LocalDotaServiceStub) bypasses gRPC for in-process
+        # drivers like scripts/train_north_star.py.
         self._loop = asyncio.new_event_loop()
-        self._actor = Actor(cfg, NullBroker(), actor_id=10_000 + cfg.actor_id)
+        self._actor = Actor(cfg, NullBroker(), actor_id=10_000 + cfg.actor_id, stub=stub)
 
     def evaluate(self, params, n_episodes: int = 10, version: int = 0) -> EvalResult:
         actor = self._actor
@@ -130,9 +132,10 @@ class Evaluator:
         )
 
     def close(self) -> None:
-        if self._actor._stub is not None:
+        if self._actor._stub is not None and hasattr(self._actor._stub, "channel"):
             # the aio channel's tasks are bound to our private loop — close
-            # it there, before the loop itself goes away
+            # it there, before the loop itself goes away (in-process stubs
+            # have no channel)
             self._loop.run_until_complete(self._actor._stub.channel.close())
         self._loop.close()
 

@@ -254,9 +254,15 @@ def lstm_recurrence(x_proj, w_h, c0, h0, impl: str = "auto"):
     VMEM, else lax.scan. "pallas_interpret" runs the kernel in interpret
     mode (CPU tests)."""
     if impl == "auto":
-        # Measured on v5e (B=256, T=16, bf16): the kernel ties XLA's scan
-        # at H=128 (16µs) and wins from H=256 up (27µs vs 61µs at H=256,
-        # 32µs vs 40µs at H=512) — below that, let XLA fuse.
+        # Threshold provenance: in-session r1 measurements on v5e (B=256,
+        # T=16, bf16) had the kernel tying XLA's scan at H=128 (16µs) and
+        # winning from H=256 up (27µs vs 61µs at H=256, 32µs vs 40µs at
+        # H=512). The reproducible artifact is scripts/bench_lstm.py →
+        # LSTM_BENCH.json; it could not be re-run on silicon in r2-r3
+        # (chip unreachable all round — TPU_PROBE_LOG.md), so until a
+        # TPU-backed LSTM_BENCH.json lands, treat the kernel as a SCALE
+        # RESERVE: auto only engages it at H≥256, off the H=128 flagship
+        # hot path either way.
         on_tpu = jax.default_backend() == "tpu"
         big = x_proj.shape[-1] // 4 >= 256
         impl = "pallas" if on_tpu and big and _pallas_ok(x_proj) else "scan"

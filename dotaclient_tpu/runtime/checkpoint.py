@@ -11,24 +11,42 @@ never read checkpoints, they get weights over the broker fanout.
 
 from __future__ import annotations
 
+import json
 import logging
 from typing import Optional
 
 from etils import epath
 import orbax.checkpoint as ocp
 
+from dotaclient_tpu.env.featurizer import FEATURE_SCHEMA_VERSION
+
 _log = logging.getLogger(__name__)
+
+
+class SchemaMismatchError(RuntimeError):
+    """Checkpoint was written under a different feature schema."""
 
 
 class Checkpointer:
     def __init__(self, directory: str, max_to_keep: int = 5):
+        self._dir = epath.Path(directory)
         self._mngr = ocp.CheckpointManager(
-            epath.Path(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
 
+    def _schema_path(self) -> epath.Path:
+        return self._dir / "feature_schema.json"
+
     def save(self, state, step: int, wait: bool = False) -> None:
         self._mngr.save(step, args=ocp.args.StandardSave(state))
+        # stamp the CURRENT build's schema unconditionally: the newest
+        # checkpoints are always this version, and a stale stamp left in a
+        # reused directory would false-positive the restore guard after
+        # max_to_keep GC removes the old-era checkpoints
+        self._schema_path().write_text(
+            json.dumps({"feature_schema_version": FEATURE_SCHEMA_VERSION})
+        )
         if wait:
             self._mngr.wait_until_finished()
 
@@ -36,6 +54,16 @@ class Checkpointer:
         step = self._mngr.latest_step()
         if step is None:
             return None
+        p = self._schema_path()
+        if p.exists():
+            saved = json.loads(p.read_text()).get("feature_schema_version")
+            if saved != FEATURE_SCHEMA_VERSION:
+                raise SchemaMismatchError(
+                    f"checkpoint at {self._dir} was written with feature "
+                    f"schema v{saved}, this build uses v{FEATURE_SCHEMA_VERSION} "
+                    f"(env/featurizer.py history) — param shapes will not "
+                    f"restore; retrain or convert the checkpoint"
+                )
         return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
 
     def latest_step(self) -> Optional[int]:

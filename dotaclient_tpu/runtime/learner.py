@@ -15,11 +15,23 @@ is the staging buffer (runtime/staging.py); this module owns the loop:
 The python-side `version` counter mirrors state.step without forcing a
 device sync every iteration; it is the version actors stamp on their
 rollouts and the learner's staleness filter reads.
+
+Pipelining (round-3): the loop never blocks on the device except where
+semantics require it —
+- the NEXT batch is fetched from staging and device_put while the
+  current step runs (double buffering; jax async dispatch);
+- metrics are device_get only every `metrics_every` steps (each fetch is
+  a full device sync);
+- weight publishes fetch params on the loop thread (required: the jit
+  step donates the state, so params must be read before the next
+  dispatch invalidates them) but serialize+broker-publish runs on a
+  dedicated publisher thread with latest-wins coalescing.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Optional
 
@@ -41,6 +53,67 @@ from dotaclient_tpu.transport.serialize import flatten_params, serialize_weights
 _log = logging.getLogger(__name__)
 
 
+class WeightPublisher:
+    """Serialize + fanout weights off the train-loop thread.
+
+    Latest-wins single slot: if the loop submits version v+1 while v is
+    still serializing, v is superseded — actors only ever want the
+    newest weights (transport/base.py fanout semantics), so coalescing
+    is correct, not lossy. The expensive work (flatten + wire framing +
+    broker I/O) happens here; the loop thread only pays the device_get.
+    """
+
+    def __init__(self, broker: Broker):
+        self._broker = broker
+        self._cond = threading.Condition()
+        self._slot = None  # (np_params, version) — latest pending
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.published = 0  # versions actually sent (telemetry/tests)
+        self.coalesced = 0  # versions superseded before sending
+
+    def start(self) -> "WeightPublisher":
+        # restartable after stop(), same contract as StagingBuffer.start
+        with self._cond:
+            self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True, name="weight-publisher")
+        self._thread.start()
+        return self
+
+    def submit(self, np_params, version: int) -> None:
+        with self._cond:
+            if self._slot is not None:
+                self.coalesced += 1
+            self._slot = (np_params, version)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._slot is None and not self._stop:
+                    self._cond.wait()
+                if self._stop and self._slot is None:
+                    return
+                np_params, version = self._slot
+                self._slot = None
+            try:
+                frame = serialize_weights(flatten_params(np_params), version=version)
+                self._broker.publish_weights(frame)
+                self.published += 1
+            except Exception:
+                _log.exception("weight publish failed (version %d); continuing", version)
+
+    def stop(self, flush: bool = True) -> None:
+        """Stop the thread; by default drains a pending slot first."""
+        with self._cond:
+            if not flush:
+                self._slot = None
+            self._stop = True
+            self._cond.notify()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
 class Learner:
     def __init__(self, cfg: LearnerConfig, broker: Broker, mesh=None):
         self.cfg = cfg
@@ -51,7 +124,9 @@ class Learner:
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         self.state: TrainState = jax.device_put(state, self.state_shardings)
         self.staging = StagingBuffer(cfg, broker, version_fn=lambda: self.version)
+        self.publisher = WeightPublisher(broker)
         self.metrics = MetricsLogger(cfg.log_dir)
+        self.env_steps_done = 0  # total real (unmasked) env steps trained on
         if cfg.profile_port:
             # device-trace endpoint (SURVEY.md §5 tracing note): attach
             # TensorBoard's profiler or jax.profiler.trace to this port
@@ -80,57 +155,137 @@ class Learner:
 
     # --------------------------------------------------------------- loop
 
-    def run(self, num_steps: Optional[int] = None, batch_timeout: float = 60.0) -> int:
-        """Train until num_steps (None = forever); returns steps done."""
+    def _fetch_next(self, batch_timeout: float):
+        """Pull one batch off staging and device_put it (dp-sharded).
+
+        Called AFTER the current step has been dispatched, so both the
+        host wait and the transfer overlap the running device step.
+        Returns (batch_dev, env_steps, wait_s, put_s) or (None, 0, w, 0).
+        """
+        t0 = time.perf_counter()
+        batch = self.staging.get_batch(timeout=batch_timeout)
+        t1 = time.perf_counter()
+        if batch is None:
+            return None, 0, t1 - t0, 0.0
+        env_steps = int(np.sum(batch.mask))
+        batch_dev = jax.device_put(batch, self.batch_sharding)
+        return batch_dev, env_steps, t1 - t0, time.perf_counter() - t1
+
+    def run(
+        self,
+        num_steps: Optional[int] = None,
+        batch_timeout: float = 60.0,
+        max_idle: Optional[int] = None,
+    ) -> int:
+        """Train until num_steps (None = forever); returns steps done.
+
+        `max_idle`: raise TimeoutError after this many CONSECUTIVE empty
+        batch waits (None = retry forever, the service default). Drivers
+        with a finite budget set it so dead producers surface as an error
+        instead of an infinite 'no batch; waiting' loop.
+        """
         cfg = self.cfg
         self.staging.start()
-        self.publish_weights()  # version 0 so actors align immediately
-        env_steps_per_batch = None
+        self.publisher.start()
         done_steps = 0
-        t_last = time.perf_counter()
+        # per-window accumulators, reset at every metrics log
+        win_wait = win_put = 0.0
+        win_env_steps = 0
+        win_steps = 0
+        t_win = time.perf_counter()
+        metrics = None
+        idle = 0
         try:
+            # Inside the try so a failed publish or first fetch still
+            # stops the staging/publisher threads (a leaked consumer
+            # would silently eat broker frames for the process lifetime).
+            self.publish_weights()  # version 0, synchronous, so actors align immediately
+            next_batch, next_env_steps, w, p = self._fetch_next(batch_timeout)
+            win_wait += w
+            win_put += p
             while num_steps is None or done_steps < num_steps:
-                t0 = time.perf_counter()
-                batch = self.staging.get_batch(timeout=batch_timeout)
-                if batch is None:
+                if next_batch is None:
+                    idle += 1
+                    if max_idle is not None and idle >= max_idle:
+                        raise TimeoutError(
+                            f"no batch for {idle} consecutive {batch_timeout:.0f}s waits "
+                            f"— producers dead or stalled"
+                        )
                     _log.warning("no batch within %.0fs; waiting", batch_timeout)
+                    next_batch, next_env_steps, w, p = self._fetch_next(batch_timeout)
+                    win_wait += w
+                    win_put += p
                     continue
-                if env_steps_per_batch is None:
-                    env_steps_per_batch = float(np.sum(batch.mask))
-                t1 = time.perf_counter()
-                batch_dev = jax.device_put(batch, self.batch_sharding)
-                t2 = time.perf_counter()
+                idle = 0
+                batch_dev, env_steps = next_batch, next_env_steps
+                # Async dispatch: returns immediately, device runs the step.
                 self.state, metrics = self.train_step(self.state, batch_dev)
                 self.version += 1
                 done_steps += 1
+                self.env_steps_done += env_steps
+                win_env_steps += env_steps
+                win_steps += 1
+
+                last = num_steps is not None and done_steps >= num_steps
+                if not last:
+                    # Host work below overlaps the in-flight device step.
+                    # Skipped on the final step: a trailing prefetch would
+                    # eat (and discard) one packed batch per phased-run
+                    # call and could stall up to batch_timeout.
+                    next_batch, next_env_steps, w, p = self._fetch_next(batch_timeout)
+                    win_wait += w
+                    win_put += p
+                else:
+                    next_batch, next_env_steps = None, 0
 
                 if self.version % cfg.publish_every == 0:
-                    self.publish_weights()
+                    # device_get must precede the next dispatch: the jit
+                    # step donates the state, so these params die the
+                    # moment step v+1 is enqueued. The get blocks only
+                    # until step v completes; serialize+publish happens
+                    # on the publisher thread.
+                    self.publisher.submit(jax.device_get(self.state.params), self.version)
                 if self.checkpointer is not None and self.version % cfg.checkpoint_every == 0:
                     self.checkpoint()
 
-                # device_get below doubles as the per-step device sync, so
-                # the step timer includes real device time, not dispatch
-                scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
-                now = time.perf_counter()
-                stats = self.staging.stats()
-                scalars["env_steps_per_sec"] = float(np.sum(batch.mask)) / max(now - t_last, 1e-9)
-                # per-stage timing (SURVEY.md §5: consume / pack / put / step)
-                scalars["time_wait_batch_s"] = t1 - t0
-                scalars["time_device_put_s"] = t2 - t1
-                scalars["time_step_s"] = now - t2
-                scalars["active_actors"] = stats["active_actors"]
-                scalars["staleness_dropped"] = stats["dropped_stale"]
-                scalars["queue_ready"] = stats["ready_batches"]
-                scalars["episodes"] = stats["episodes"]
-                if stats["episodes"] > 0:
-                    scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
-                self.metrics.log(self.version, scalars)
-                t_last = now
+                if self.version % cfg.metrics_every == 0 or last:
+                    # The ONLY routine device sync in the loop.
+                    scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    now = time.perf_counter()
+                    stats = self.staging.stats()
+                    dt = max(now - t_win, 1e-9)
+                    n = max(win_steps, 1)
+                    scalars["env_steps_per_sec"] = win_env_steps / dt
+                    # per-stage split (SURVEY.md §5): window averages.
+                    # time_step_s is the residual — device step + dispatch
+                    # + publish-get — since the loop never syncs per step.
+                    scalars["time_wait_batch_s"] = win_wait / n
+                    scalars["time_device_put_s"] = win_put / n
+                    scalars["time_step_s"] = max(dt - win_wait - win_put, 0.0) / n
+                    scalars["active_actors"] = stats["active_actors"]
+                    scalars["staleness_dropped"] = stats["dropped_stale"]
+                    scalars["queue_ready"] = stats["ready_batches"]
+                    scalars["episodes"] = stats["episodes"]
+                    scalars["weights_published"] = self.publisher.published
+                    scalars["weights_coalesced"] = self.publisher.coalesced
+                    if stats["episodes"] > 0:
+                        scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
+                    self.metrics.log(self.version, scalars)
+                    win_wait = win_put = 0.0
+                    win_env_steps = win_steps = 0
+                    t_win = now
         finally:
+            if metrics is not None:
+                jax.block_until_ready(metrics)
             self.staging.stop()
-            self.metrics.close()
+            self.publisher.stop()
+            # flush, don't close: run() is re-entrant (phased drivers call
+            # it repeatedly); close() below releases the logger for good
+            self.metrics.flush()
         return done_steps
+
+    def close(self) -> None:
+        self.metrics.close()
 
 
 def main(argv=None):
@@ -150,7 +305,10 @@ def main(argv=None):
         cfg.seq_len,
         len(jax.devices()),
     )
-    learner.run()
+    try:
+        learner.run()
+    finally:
+        learner.close()
 
 
 if __name__ == "__main__":

@@ -44,6 +44,12 @@ class MetricsLogger:
             if self._writes % self._flush_every == 0:
                 self._tb.flush()
 
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()
+
     def close(self) -> None:
         if self._tb is not None:
             self._tb.flush()

@@ -123,6 +123,10 @@ class StagingBuffer:
     # -- consumer thread -------------------------------------------------
 
     def start(self) -> "StagingBuffer":
+        # restartable: a prior stop() leaves _stop set — clear it so
+        # phased drivers (train N steps → eval → train again, e.g.
+        # scripts/train_north_star.py) can reuse one buffer
+        self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True, name="staging-consumer")
         self._thread.start()
         return self

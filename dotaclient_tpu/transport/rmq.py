@@ -49,11 +49,19 @@ class RmqBroker(Broker):
         # This replaces the old per-call consume()/cancel() churn — a
         # consumer (de)registration round-trip per batch is the classic
         # slow way to drain AMQP.
-        self._exp_buf: Deque[bytes] = deque()
+        #
+        # Acking is explicit (auto_ack=False): a delivery is acked only
+        # when consume_experience hands it to the caller. That makes
+        # basic_qos(prefetch) actually bind client-side buffering —
+        # at most `prefetch` frames sit unacked in _exp_buf, the rest of
+        # a backlog stays on the broker (visible in experience_depth,
+        # redelivered if this process dies). auto_ack would pull the
+        # whole backlog into process memory and lose it on crash.
+        self._exp_buf: Deque[tuple] = deque()  # (delivery_tag, body)
         self._consuming = False
 
-    def _on_experience(self, _ch, _method, _props, body) -> None:
-        self._exp_buf.append(body)
+    def _on_experience(self, _ch, method, _props, body) -> None:
+        self._exp_buf.append((method.delivery_tag, body))
 
     def publish_experience(self, data: bytes) -> None:
         with self._lock:
@@ -71,7 +79,7 @@ class RmqBroker(Broker):
         with self._lock:
             if not self._consuming:
                 self._ch.basic_consume(
-                    EXPERIENCE_QUEUE, on_message_callback=self._on_experience, auto_ack=True
+                    EXPERIENCE_QUEUE, on_message_callback=self._on_experience, auto_ack=False
                 )
                 self._consuming = True
             while not self._exp_buf:
@@ -86,8 +94,14 @@ class RmqBroker(Broker):
             out: List[bytes] = []
             # drain whatever has been prefetched, no further waiting
             self._conn.process_data_events(time_limit=0)
+            last_tag = None
             while self._exp_buf and len(out) < max_items:
-                out.append(self._exp_buf.popleft())
+                last_tag, body = self._exp_buf.popleft()
+                out.append(body)
+            if last_tag is not None:
+                # tags are per-channel monotonic and we pop in order, so
+                # one cumulative ack covers everything handed out
+                self._ch.basic_ack(delivery_tag=last_tag, multiple=True)
         return out
 
     def publish_weights(self, data: bytes) -> None:
@@ -105,9 +119,12 @@ class RmqBroker(Broker):
         return latest
 
     def experience_depth(self) -> int:
+        # passive declare's message_count is READY messages only (excludes
+        # unacked deliveries); add what sits unacked in our buffer so the
+        # gauge reports the true backlog.
         with self._lock:
             res = self._ch.queue_declare(queue=EXPERIENCE_QUEUE, durable=True, passive=True)
-        return res.method.message_count
+            return res.method.message_count + len(self._exp_buf)
 
     def close(self) -> None:
         with self._lock:

@@ -59,9 +59,10 @@ class BasicProperties:
 
 
 class _Method:
-    def __init__(self, queue: str = "", message_count: int = 0):
+    def __init__(self, queue: str = "", message_count: int = 0, delivery_tag: int = 0):
         self.queue = queue
         self.message_count = message_count
+        self.delivery_tag = delivery_tag
 
 
 class _Result:
@@ -72,9 +73,17 @@ class _Result:
 class _Channel:
     def __init__(self, host: _VHost):
         self._host = host
-        # (queue, callback) long-lived consumers fed by process_data_events
-        self._consumers: List[Tuple[str, Callable]] = []
+        # (queue, callback, auto_ack) long-lived consumers fed by
+        # process_data_events
+        self._consumers: List[Tuple[str, Callable, bool]] = []
         self.closed = False
+        self.prefetch_count = 0  # 0 = unlimited, per AMQP basic.qos
+        self._next_tag = 0
+        # delivery_tag -> (queue, body): delivered but not yet acked.
+        # Real RabbitMQ redelivers these if the channel dies, and
+        # basic.qos bounds their count — both modeled here so the broker
+        # code can't validate a wrong ack assumption against this fake.
+        self._unacked: Dict[int, Tuple[str, bytes]] = {}
 
     def queue_declare(self, queue: str = "", durable: bool = False, exclusive: bool = False, passive: bool = False):
         if passive:
@@ -102,17 +111,40 @@ class _Channel:
         return _Method(queue), BasicProperties(), q.popleft()
 
     def basic_consume(self, queue: str, on_message_callback: Callable, auto_ack: bool = False) -> str:
-        self._consumers.append((queue, on_message_callback))
+        self._consumers.append((queue, on_message_callback, auto_ack))
         return f"ctag-{len(self._consumers)}"
+
+    def basic_ack(self, delivery_tag: int = 0, multiple: bool = False) -> None:
+        if multiple:
+            for tag in [t for t in self._unacked if t <= delivery_tag]:
+                del self._unacked[tag]
+        else:
+            self._unacked.pop(delivery_tag, None)
 
     def _pump(self) -> int:
         delivered = 0
-        for queue, cb in self._consumers:
+        for queue, cb, auto_ack in self._consumers:
             q = self._host.queues.get(queue)
             while q:
-                cb(self, _Method(queue), BasicProperties(), q.popleft())
+                # basic.qos: stop delivering once prefetch_count messages
+                # are outstanding unacked (auto_ack deliveries never count)
+                if not auto_ack and self.prefetch_count and len(self._unacked) >= self.prefetch_count:
+                    break
+                body = q.popleft()
+                self._next_tag += 1
+                if not auto_ack:
+                    self._unacked[self._next_tag] = (queue, body)
+                cb(self, _Method(queue, delivery_tag=self._next_tag), BasicProperties(), body)
                 delivered += 1
         return delivered
+
+    def _requeue_unacked(self) -> None:
+        """Channel death returns unacked deliveries to the head of their
+        queues (AMQP redelivery), oldest first."""
+        for tag in sorted(self._unacked, reverse=True):
+            queue, body = self._unacked[tag]
+            self._host.queues.setdefault(queue, deque()).appendleft(body)
+        self._unacked.clear()
 
 
 class BlockingConnection:
@@ -136,6 +168,7 @@ class BlockingConnection:
         self.closed = True
         for ch in self._channels:
             ch.closed = True
+            ch._requeue_unacked()
 
 
 class _exceptions:

@@ -50,6 +50,7 @@ def test_e2e_slice(env_addr, tmp_path):
         policy=SMALL,
         mesh_shape="dp=-1",
         publish_every=1,
+        metrics_every=1,  # one metrics line per step for the assertions below
         log_dir=str(tmp_path / "logs"),
     )
     acfg = ActorConfig(

@@ -1,17 +1,14 @@
 """Full-stack learning smoke (SURVEY.md §4 item 5; VERDICT r1 item 4):
-fake env → actors → broker → learner for ~150 PPO updates, asserting the
-thing every other test only brackets — that the closed loop actually
-LEARNS (mean episode return rises significantly over training).
+fake env → actors → broker → learner, asserting the thing every other
+test only brackets — that the closed loop actually LEARNS (mean episode
+return rises significantly over training).
 
-Calibration (this exact config, CPU, seed-controlled): untrained early
-mean return ≈ 1.9 (std ≈ 1.5 across episodes); after 150 tiny updates the
-late mean ≈ 3.0 with std ≈ 0.6. With 400+ episodes per window the
-standard error of each mean is < 0.1, so the +0.5 margin below is > 5
-sigma — far from flake territory while still failing loudly if learning
-breaks.
-
-Slow (~3-5 min on one CPU core): marked `slow`; the round's final green
-run must include it (`pytest tests/ -q`, no deselect).
+Two tiers (VERDICT r2 item 7 — default gate must stay <5 min):
+- `_fast` (marker `slow`, in the default run): 60 updates, margin
+  calibrated below;
+- full (marker `nightly`, excluded from the default run by pytest.ini
+  addopts): 150 updates, +0.5 margin, round-2 calibration (early mean
+  ≈ 1.9 std 1.5, late ≈ 3.0 std 0.6, >5 sigma at 400+ episodes/window).
 """
 
 import asyncio
@@ -29,21 +26,20 @@ from dotaclient_tpu.transport import memory as mem
 from dotaclient_tpu.transport.base import connect as broker_connect
 
 SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
-N_UPDATES = 150
 N_ACTORS = 3
-MARGIN = 0.5
 
 
-@pytest.mark.slow
-def test_full_stack_learning_improves_return():
+def _run_smoke(broker_name: str, n_updates: int, min_episodes: int):
+    """Closed actor→broker→learner loop for n_updates; returns episode
+    returns in completion order across all actors."""
     service = FakeDotaService()  # shared in-process env, per-stub sessions
-    mem.reset("learn_smoke")
+    mem.reset(broker_name)
     lcfg = LearnerConfig(
         batch_size=16, seq_len=16, policy=SMALL, mesh_shape="dp=-1", publish_every=1
     )
     lcfg.ppo.lr = 1e-3
     lcfg.ppo.entropy_coef = 0.005
-    returns = []  # (episode_index, return) in completion order, all actors
+    returns = []  # episode returns in completion order, all actors
     lock = threading.Lock()
     stop = threading.Event()
 
@@ -55,7 +51,7 @@ def test_full_stack_learning_improves_return():
         async def go():
             actor = Actor(
                 acfg,
-                broker_connect("mem://learn_smoke"),
+                broker_connect(f"mem://{broker_name}"),
                 actor_id=i,
                 stub=LocalDotaServiceStub(service),
             )
@@ -73,20 +69,46 @@ def test_full_stack_learning_improves_return():
     threads = [threading.Thread(target=actor_thread, args=(i,), daemon=True) for i in range(N_ACTORS)]
     for t in threads:
         t.start()
-    learner = Learner(lcfg, broker_connect("mem://learn_smoke"))
-    steps = learner.run(num_steps=N_UPDATES, batch_timeout=300.0)
+    learner = Learner(lcfg, broker_connect(f"mem://{broker_name}"))
+    steps = learner.run(num_steps=n_updates, batch_timeout=300.0)
     stop.set()
     for t in threads:
         t.join(timeout=60)
 
-    assert steps == N_UPDATES
+    assert steps == n_updates
     with lock:
         rets = np.asarray(returns, float)
-    assert len(rets) > 200, f"too few episodes ({len(rets)}) for a stable comparison"
+    assert len(rets) > min_episodes, f"too few episodes ({len(rets)}) for a stable comparison"
+    return rets
+
+
+def _assert_improvement(rets: np.ndarray, margin: float) -> None:
     k = len(rets) // 3
     early, late = rets[:k], rets[-k:]
     improvement = late.mean() - early.mean()
-    assert improvement > MARGIN, (
+    assert improvement > margin, (
         f"no learning: early mean {early.mean():.3f} (n={k}), late mean "
-        f"{late.mean():.3f} (n={k}), improvement {improvement:.3f} <= {MARGIN}"
+        f"{late.mean():.3f} (n={k}), improvement {improvement:.3f} <= {margin}"
     )
+
+
+@pytest.mark.slow
+def test_full_stack_learning_improves_return_fast():
+    """Default-gate smoke: 60 updates (~1.5-2 min on one CPU core).
+
+    Calibration (this config, 3 runs r3, ~600 episodes each): improvement
+    +0.93 / +0.62 / +0.83 — margin 0.25 sits 2.5x below the observed
+    minimum; the nightly 150-update test keeps the tighter +0.5 bound.
+    """
+    rets = _run_smoke("learn_smoke_fast", n_updates=60, min_episodes=120)
+    _assert_improvement(rets, margin=0.25)
+
+
+@pytest.mark.nightly
+def test_full_stack_learning_improves_return():
+    """The full 150-update smoke (round-2 calibration: early mean ≈ 1.9,
+    late ≈ 3.0, +0.5 margin > 5 sigma). Behind the `nightly` marker so
+    the default `pytest -q` gate stays under 5 minutes (VERDICT r2 item
+    7); run with `pytest -m nightly` at milestones/end-of-round."""
+    rets = _run_smoke("learn_smoke", n_updates=150, min_episodes=200)
+    _assert_improvement(rets, margin=0.5)

@@ -102,6 +102,108 @@ def test_close(rmq):
     assert b._conn.closed
 
 
+def test_prefetch_bounds_unacked_buffering(monkeypatch):
+    """With explicit acks, basic_qos(prefetch) must bound how many frames
+    sit in the client buffer; the rest of a backlog stays on the broker
+    (ADVICE r2: auto_ack pulled whole backlogs into process memory)."""
+    monkeypatch.setitem(sys.modules, "pika", fake_pika)
+    fake_pika.reset()
+    from dotaclient_tpu.transport.rmq import RmqBroker
+
+    producer, consumer = RmqBroker(URL), RmqBroker(URL, prefetch=4)
+    for i in range(20):
+        producer.publish_experience(bytes([i]))
+    # take 2: the channel may deliver at most 4 unacked; 2 are acked on
+    # hand-out, so ≤2 stay buffered and ≥16 remain broker-side ready
+    got = consumer.consume_experience(max_items=2, timeout=0.5)
+    assert got == [bytes([0]), bytes([1])]
+    assert len(consumer._exp_buf) <= 2
+    ready = consumer._ch.queue_declare(queue="experience", durable=True, passive=True).method.message_count
+    assert ready >= 16
+    # depth gauge reports the full backlog (ready + client-buffered)
+    assert consumer.experience_depth() == 18
+    # the rest still arrives, in order
+    rest = consumer.consume_experience(max_items=100, timeout=0.5)
+    rest += consume_all(consumer)
+    assert got + rest == [bytes([i]) for i in range(20)]
+
+
+def consume_all(broker, limit=100):
+    out = []
+    while True:
+        batch = broker.consume_experience(max_items=limit, timeout=0.05)
+        if not batch:
+            return out
+        out.extend(batch)
+
+
+def test_unacked_frames_survive_consumer_death(monkeypatch):
+    """A consumer that dies with frames delivered-but-unacked must not
+    lose them: the broker requeues, and a fresh consumer sees every frame
+    exactly once (the durable-queue elasticity SURVEY.md §5 relies on)."""
+    monkeypatch.setitem(sys.modules, "pika", fake_pika)
+    fake_pika.reset()
+    from dotaclient_tpu.transport.rmq import RmqBroker
+
+    producer, dying = RmqBroker(URL), RmqBroker(URL, prefetch=8)
+    for i in range(8):
+        producer.publish_experience(bytes([i]))
+    got = dying.consume_experience(max_items=3, timeout=0.5)
+    assert got == [bytes([0]), bytes([1]), bytes([2])]
+    dying.close()  # 5 frames were prefetched/unacked → requeued in order
+
+    fresh = RmqBroker(URL)
+    assert consume_all(fresh) == [bytes([i]) for i in range(3, 8)]
+
+
+@pytest.mark.skipif(
+    "DOTACLIENT_TPU_AMQP_URL" not in __import__("os").environ,
+    reason="set DOTACLIENT_TPU_AMQP_URL to a live RabbitMQ to run",
+)
+def test_real_rabbitmq_roundtrip():
+    """Reference-parity against a LIVE RabbitMQ (VERDICT r2 item 8).
+
+    Gated on DOTACLIENT_TPU_AMQP_URL; exercises publish/consume ordering,
+    ack-bounded prefetch, fanout latest-wins, and depth against a real
+    broker the day an environment provides one.
+    """
+    import os
+    import uuid
+
+    pytest.importorskip("pika")
+    url = os.environ["DOTACLIENT_TPU_AMQP_URL"]
+    from dotaclient_tpu.transport import rmq as rmq_mod
+    from dotaclient_tpu.transport.rmq import RmqBroker
+
+    # unique names so repeated runs don't cross-talk
+    token = uuid.uuid4().hex[:8]
+    orig_q, orig_x = rmq_mod.EXPERIENCE_QUEUE, rmq_mod.MODEL_EXCHANGE
+    rmq_mod.EXPERIENCE_QUEUE = f"experience-test-{token}"
+    rmq_mod.MODEL_EXCHANGE = f"model-test-{token}"
+    try:
+        producer, consumer = RmqBroker(url), RmqBroker(url, prefetch=4)
+        payloads = [f"frame-{i}".encode() for i in range(12)]
+        for p in payloads:
+            producer.publish_experience(p)
+        got = consumer.consume_experience(max_items=5, timeout=5.0)
+        got += consume_all(consumer)
+        assert got == payloads
+        producer.publish_weights(b"v1")
+        producer.publish_weights(b"v2")
+        import time
+
+        deadline = time.monotonic() + 5.0
+        latest = None
+        while latest is None and time.monotonic() < deadline:
+            latest = consumer.poll_weights()
+        assert latest == b"v2"
+        consumer._ch.queue_delete(rmq_mod.EXPERIENCE_QUEUE)
+        producer.close()
+        consumer.close()
+    finally:
+        rmq_mod.EXPERIENCE_QUEUE, rmq_mod.MODEL_EXCHANGE = orig_q, orig_x
+
+
 def test_missing_pika_import_error():
     """Without pika installed the amqp:// scheme must fail with the
     actionable message, not a bare ImportError at module import."""
