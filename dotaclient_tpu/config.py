@@ -78,6 +78,21 @@ class LearnerConfig:
     # host devices — CPU smoke deployments, and hosts whose TPU plugin
     # would hang backend init.
     platform: str = ""
+    # Multi-host learner (SURVEY.md §5 "Distributed communication
+    # backend": jax.distributed over DCN if the learner ever spans
+    # hosts). When true, jax.distributed.initialize() joins this process
+    # to the cluster BEFORE backend init; jax.devices() then spans every
+    # process's chips and the mesh/shardings work unchanged (XLA routes
+    # intra-host collectives over ICI, cross-host over DCN). Each process
+    # runs this same binary with its own process_id.
+    multihost: bool = False
+    # Each resolves independently: "" / -1 = let jax auto-detect from
+    # cluster env or TPU metadata; set explicitly for manual clusters.
+    coordinator: str = ""  # host:port of process 0
+    num_processes: int = -1
+    process_id: int = -1
+    # Stop after this many train steps (0 = run forever). Smoke/CI use.
+    train_steps: int = 0
 
 
 @dataclass

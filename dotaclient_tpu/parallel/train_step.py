@@ -87,6 +87,11 @@ def build_train_step(cfg: LearnerConfig, mesh):
         step_fn,
         in_shardings=(state_shardings, batch_shardings),
         out_shardings=(state_shardings, metrics_sharding),
+        # Only the state is donated. The batch is NOT: callers (bench's
+        # device-only loop, fixed-batch convergence tests) legitimately
+        # reuse one batch across calls, and donation would delete it on
+        # TPU while CPU runs silently ignore donation — a trap that
+        # would only fire on silicon.
         donate_argnums=(0,),
     )
     return train_step, state_shardings, batch_sh

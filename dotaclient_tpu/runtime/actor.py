@@ -261,6 +261,18 @@ class Actor:
             return False
         try:
             named, version = deserialize_weights(frame)
+            # Monotonic guard: a frame older than what we run is never
+            # applied (a delayed publish — e.g. one that sat blocked in a
+            # publisher thread through a broker outage — must not regress
+            # actors to stale weights; versions only move forward).
+            if version < self.version:
+                _log.warning(
+                    "actor %d: ignoring stale weight frame v%d (< v%d)",
+                    self.actor_id,
+                    version,
+                    self.version,
+                )
+                return False
             self.params = unflatten_params(named, self.params)
             self.version = version
             self.last_weight_time = time.monotonic()

@@ -73,9 +73,17 @@ class WeightPublisher:
         self.coalesced = 0  # versions superseded before sending
 
     def start(self) -> "WeightPublisher":
-        # restartable after stop(), same contract as StagingBuffer.start
+        # restartable after stop(), same contract as StagingBuffer.start.
+        # If a previous thread is still draining (stop()'s bounded join
+        # timed out on a hung broker), it stays the active thread — it
+        # will see _stop=False and keep serving; spawning a second one
+        # would race two publishers and could deliver stale versions
+        # after newer ones.
         with self._cond:
             self._stop = False
+            if self._thread is not None and self._thread.is_alive():
+                self._cond.notify()
+                return self
         self._thread = threading.Thread(target=self._run, daemon=True, name="weight-publisher")
         self._thread.start()
         return self
@@ -93,6 +101,10 @@ class WeightPublisher:
                 while self._slot is None and not self._stop:
                     self._cond.wait()
                 if self._stop and self._slot is None:
+                    # clear the handle under the SAME lock hold as the
+                    # exit decision, so a concurrent start() never sees a
+                    # thread that is alive but already committed to exit
+                    self._thread = None
                     return
                 np_params, version = self._slot
                 self._slot = None
@@ -110,8 +122,9 @@ class WeightPublisher:
                 self._slot = None
             self._stop = True
             self._cond.notify()
-        if self._thread:
-            self._thread.join(timeout=10)
+            t = self._thread  # local ref: the thread nulls the handle on exit
+        if t is not None:
+            t.join(timeout=10)
 
 
 class Learner:
@@ -296,6 +309,20 @@ def main(argv=None):
     cfg = parse_config(LearnerConfig(), argv)
     if cfg.platform:
         jax.config.update("jax_platforms", cfg.platform)
+    if cfg.multihost:
+        # Must run before any backend touch: after this, jax.devices()
+        # spans every process's chips and the existing mesh/shardings
+        # scale across hosts with zero further changes. Each kwarg is
+        # passed independently — an unset flag ("" / -1) defers to jax's
+        # cluster-env/metadata auto-detection, a set one overrides it.
+        kw = {}
+        if cfg.coordinator:
+            kw["coordinator_address"] = cfg.coordinator
+        if cfg.num_processes >= 0:
+            kw["num_processes"] = cfg.num_processes
+        if cfg.process_id >= 0:
+            kw["process_id"] = cfg.process_id
+        jax.distributed.initialize(**kw)
     broker = broker_connect(cfg.broker_url)
     learner = Learner(cfg, broker)
     _log.info(
@@ -306,7 +333,7 @@ def main(argv=None):
         len(jax.devices()),
     )
     try:
-        learner.run()
+        learner.run(num_steps=cfg.train_steps or None)
     finally:
         learner.close()
 

@@ -16,6 +16,17 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
 
+# Persistent XLA compilation cache, MACHINE-LOCAL on purpose (not in the
+# repo): AOT CPU executables are ISA-specific, and a cache that traveled
+# with the checkout could SIGILL on a weaker host. Warm runs skip the
+# ~60-100s of recompiles a fresh pytest process otherwise pays. Exported
+# via env so subprocess tests (multihost) share it.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", f"/tmp/dotaclient_tpu_jax_cache_{os.getuid()}"
+)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -87,6 +87,26 @@ def test_actor_hot_swaps_weights(env):
     assert versions[-1] == 17
 
 
+def test_actor_ignores_stale_weight_frame(env):
+    """A delayed publish (e.g. a publisher thread that sat blocked
+    through a broker outage) must never regress an actor to older
+    weights: versions only move forward."""
+    actor, broker, cfg = make_actor(env, "actor_stale")
+    new_params = init_params(cfg.policy, jax.random.PRNGKey(5))
+    broker.publish_weights(serialize_weights(flatten_params(new_params), version=9))
+    assert actor.maybe_update_weights()
+    assert actor.version == 9
+    old_params = init_params(cfg.policy, jax.random.PRNGKey(6))
+    broker.publish_weights(serialize_weights(flatten_params(old_params), version=4))
+    assert not actor.maybe_update_weights()  # stale: ignored
+    assert actor.version == 9
+    for a, b in zip(jax.tree.leaves(actor.params), jax.tree.leaves(new_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # equal-version rebroadcast (learner restart republishes v9) applies
+    broker.publish_weights(serialize_weights(flatten_params(old_params), version=9))
+    assert actor.maybe_update_weights()
+
+
 def test_actor_aux_targets(env):
     actor, broker, cfg = make_actor(env, "actor_t3")
     actor.cfg.policy = PolicyConfig(

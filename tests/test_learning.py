@@ -94,14 +94,15 @@ def _assert_improvement(rets: np.ndarray, margin: float) -> None:
 
 @pytest.mark.slow
 def test_full_stack_learning_improves_return_fast():
-    """Default-gate smoke: 60 updates (~1.5-2 min on one CPU core).
+    """Default-gate smoke: 45 updates (~75s on one CPU core).
 
-    Calibration (this config, 3 runs r3, ~600 episodes each): improvement
-    +0.93 / +0.62 / +0.83 — margin 0.25 sits 2.5x below the observed
-    minimum; the nightly 150-update test keeps the tighter +0.5 bound.
+    Calibration (this config, 3 runs r3, ~460 episodes each): improvement
+    +0.40 / +0.50 / +0.48 — margin 0.2 is half the observed minimum;
+    the nightly 150-update test keeps the tighter +0.5 bound.
+    (60-update calibration, for reference: +0.93 / +0.62 / +0.83.)
     """
-    rets = _run_smoke("learn_smoke_fast", n_updates=60, min_episodes=120)
-    _assert_improvement(rets, margin=0.25)
+    rets = _run_smoke("learn_smoke_fast", n_updates=45, min_episodes=100)
+    _assert_improvement(rets, margin=0.2)
 
 
 @pytest.mark.nightly

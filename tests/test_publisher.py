@@ -1,0 +1,99 @@
+"""WeightPublisher: off-thread weight fanout with latest-wins coalescing
+(runtime/learner.py — the r3 pipelining change that moved serialize +
+broker I/O off the train loop's critical path)."""
+
+import threading
+import time
+
+import numpy as np
+
+from dotaclient_tpu.runtime.learner import WeightPublisher
+from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.serialize import deserialize_weights
+
+
+def _params(v: float):
+    return {"dense": {"kernel": np.full((4, 4), v, np.float32)}}
+
+
+class _RecordingBroker(Broker):
+    def __init__(self, publish_delay: float = 0.0):
+        self.frames = []
+        self.publish_delay = publish_delay
+        self.fail_next = 0
+
+    def publish_weights(self, data: bytes) -> None:
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise ConnectionError("injected broker outage")
+        if self.publish_delay:
+            time.sleep(self.publish_delay)
+        self.frames.append(data)
+
+    def publish_experience(self, data: bytes) -> None:
+        raise AssertionError("publisher must not touch experience")
+
+    def consume_experience(self, max_items, timeout=None):
+        raise AssertionError("publisher must not consume")
+
+    def poll_weights(self):
+        return self.frames[-1] if self.frames else None
+
+
+def test_publishes_in_order_and_stop_flushes():
+    broker = _RecordingBroker()
+    pub = WeightPublisher(broker).start()
+    for v in range(1, 4):
+        pub.submit(_params(float(v)), version=v)
+        # wait for the drain rather than sleeping a fixed interval — a
+        # descheduled publisher thread must not fake a coalesce
+        deadline = time.monotonic() + 10.0
+        while pub.published < v and time.monotonic() < deadline:
+            time.sleep(0.005)
+    pub.stop()  # default flush=True drains any pending slot
+    assert pub.published == 3 and pub.coalesced == 0
+    versions = [deserialize_weights(f)[1] for f in broker.frames]
+    assert versions == [1, 2, 3]
+
+
+def test_coalesces_to_latest_under_slow_broker():
+    broker = _RecordingBroker(publish_delay=0.15)
+    pub = WeightPublisher(broker).start()
+    # submit faster than the broker drains: intermediate versions must be
+    # superseded, never queued (actors only want the newest weights)
+    for v in range(1, 8):
+        pub.submit(_params(float(v)), version=v)
+        time.sleep(0.01)
+    pub.stop()
+    versions = [deserialize_weights(f)[1] for f in broker.frames]
+    assert versions[-1] == 7, "newest version must always be delivered"
+    assert pub.coalesced > 0, "slow broker must coalesce, not queue"
+    assert len(versions) < 7
+    assert versions == sorted(versions), "never deliver out of order"
+    named, _ = deserialize_weights(broker.frames[-1])
+    np.testing.assert_array_equal(dict(named)["dense/kernel"], np.full((4, 4), 7.0, np.float32))
+
+
+def test_broker_error_does_not_kill_publisher():
+    broker = _RecordingBroker()
+    broker.fail_next = 1
+    pub = WeightPublisher(broker).start()
+    pub.submit(_params(1.0), version=1)  # eaten by the injected outage
+    deadline = time.monotonic() + 5.0
+    while pub.published == 0 and time.monotonic() < deadline:
+        pub.submit(_params(2.0), version=2)
+        time.sleep(0.02)
+    pub.stop()
+    assert pub.published >= 1, "publisher thread must survive a broker error"
+    assert deserialize_weights(broker.frames[-1])[1] == 2
+
+
+def test_restartable_after_stop():
+    broker = _RecordingBroker()
+    pub = WeightPublisher(broker).start()
+    pub.submit(_params(1.0), version=1)
+    pub.stop()
+    pub.start()  # phased drivers restart (same contract as StagingBuffer)
+    pub.submit(_params(2.0), version=2)
+    pub.stop()
+    assert [deserialize_weights(f)[1] for f in broker.frames] == [1, 2]
