@@ -146,9 +146,9 @@ def main(argv=None):
     import jax
 
     from dotaclient_tpu.config import EvalConfig, parse_config
+    from dotaclient_tpu.runtime.actor import apply_weight_frame
     from dotaclient_tpu.runtime.metrics import MetricsLogger
     from dotaclient_tpu.transport.base import connect as broker_connect
-    from dotaclient_tpu.transport.serialize import deserialize_weights, unflatten_params
 
     logging.basicConfig(level=logging.INFO)
     cfg = parse_config(EvalConfig(), argv)
@@ -157,21 +157,19 @@ def main(argv=None):
     broker = broker_connect(cfg.actor.broker_url)
     metrics = MetricsLogger(cfg.log_dir)
     evaluator = Evaluator(cfg.actor)
-    params = evaluator._actor.params
+    # the evaluator's inner actor is the weight target — the shared
+    # apply_weight_frame gives it the same stale-frame guard + learner-
+    # restart resync the rollout actors have
+    agent = evaluator._actor
     last_eval = -cfg.eval_every  # evaluate version 0 immediately
-    version = 0
     try:
         while True:
             frame = broker.poll_weights()
             if frame is not None:
-                try:
-                    named, version = deserialize_weights(frame)
-                    params = unflatten_params(named, params)
-                except Exception as e:  # a bad broadcast must never kill
-                    # the evaluator (same stance as the actor's guard)
-                    _log.warning("bad weight frame: %s", e)
+                apply_weight_frame(agent, frame, "evaluator")
+            version = agent.version
             if version - last_eval >= cfg.eval_every:
-                res = evaluator.evaluate(params, n_episodes=cfg.episodes, version=version)
+                res = evaluator.evaluate(agent.params, n_episodes=cfg.episodes, version=version)
                 last_eval = version
                 metrics.log(
                     version,

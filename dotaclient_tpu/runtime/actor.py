@@ -61,6 +61,53 @@ class StaleWeightsError(RuntimeError):
     (SURVEY.md §5 "stale-version kill switch")."""
 
 
+# After this many CONSECUTIVE older-version frames, conclude the learner
+# restarted at a lower version (no checkpoint) and resynchronize instead
+# of rejecting forever. One delayed/stale frame (the case the monotonic
+# guard exists for) never repeats 3 times — fresh broadcasts interleave.
+_RESTART_RESYNC_AFTER = 3
+
+
+def apply_weight_frame(agent, frame: bytes, log_name: str, on_applied=None) -> bool:
+    """Shared weight hot-swap for Actor / SelfPlayActor / Evaluator.
+
+    - malformed frames are logged and ignored (a bad broadcast must
+      never kill a subscriber);
+    - frames OLDER than what the agent runs are rejected (a publish that
+      sat blocked through a broker outage must not regress weights) —
+      but _RESTART_RESYNC_AFTER consecutive rejections mean the learner
+      genuinely restarted at a lower version, so the agent resyncs
+      rather than running ancient weights forever;
+    - `on_applied(named_params, version)` runs after a successful swap
+      (league snapshotting hook).
+    """
+    try:
+        named, version = deserialize_weights(frame)
+    except Exception as e:  # truncated frames raise struct.error etc.
+        _log.warning("%s: bad weight frame: %s", log_name, e)
+        return False
+    if version < agent.version:
+        agent._stale_rejects = getattr(agent, "_stale_rejects", 0) + 1
+        if agent._stale_rejects < _RESTART_RESYNC_AFTER:
+            _log.warning(
+                "%s: ignoring stale weight frame v%d (< v%d)", log_name, version, agent.version
+            )
+            return False
+        _log.warning(
+            "%s: %d consecutive older frames — assuming learner restart, resyncing to v%d",
+            log_name,
+            agent._stale_rejects,
+            version,
+        )
+    agent._stale_rejects = 0
+    agent.params = unflatten_params(named, agent.params)
+    agent.version = version
+    agent.last_weight_time = time.monotonic()
+    if on_applied is not None:
+        on_applied(named, version)
+    return True
+
+
 def check_weight_freshness(actor) -> None:
     """Shared kill-switch check for Actor and SelfPlayActor (both carry
     cfg.max_weight_age_s and last_weight_time)."""
@@ -259,28 +306,7 @@ class Actor:
         frame = self.broker.poll_weights()
         if frame is None:
             return False
-        try:
-            named, version = deserialize_weights(frame)
-            # Monotonic guard: a frame older than what we run is never
-            # applied (a delayed publish — e.g. one that sat blocked in a
-            # publisher thread through a broker outage — must not regress
-            # actors to stale weights; versions only move forward).
-            if version < self.version:
-                _log.warning(
-                    "actor %d: ignoring stale weight frame v%d (< v%d)",
-                    self.actor_id,
-                    version,
-                    self.version,
-                )
-                return False
-            self.params = unflatten_params(named, self.params)
-            self.version = version
-            self.last_weight_time = time.monotonic()
-            return True
-        except Exception as e:  # truncated frames raise struct.error etc. —
-            # a bad broadcast must never kill the actor
-            _log.warning("actor %d: bad weight frame: %s", self.actor_id, e)
-            return False
+        return apply_weight_frame(self, frame, f"actor {self.actor_id}")
 
     def check_weight_freshness(self) -> None:
         """Kill switch: raise if broadcasts stopped (cfg.max_weight_age_s

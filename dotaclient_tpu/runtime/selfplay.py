@@ -48,6 +48,7 @@ from dotaclient_tpu.protos import dotaservice_pb2 as ds
 from dotaclient_tpu.protos import worldstate_pb2 as ws
 from dotaclient_tpu.runtime.actor import (
     _Chunk,
+    apply_weight_frame,
     build_action,
     check_weight_freshness,
     connect_env_async,
@@ -55,11 +56,7 @@ from dotaclient_tpu.runtime.actor import (
     reset_env_stub,
 )
 from dotaclient_tpu.transport.base import Broker
-from dotaclient_tpu.transport.serialize import (
-    deserialize_weights,
-    serialize_rollout,
-    unflatten_params,
-)
+from dotaclient_tpu.transport.serialize import serialize_rollout, unflatten_params
 
 _log = logging.getLogger(__name__)
 
@@ -136,17 +133,12 @@ class SelfPlayActor:
         frame = self.broker.poll_weights()
         if frame is None:
             return False
-        try:
-            named, version = deserialize_weights(frame)
-            self.params = unflatten_params(named, self.params)
-            self.version = version
-            self.last_weight_time = time.monotonic()
-            if self.league is not None:
-                self.league.maybe_snapshot(version, named)
-            return True
-        except Exception as e:  # a bad broadcast must never kill the actor
-            _log.warning("selfplay actor %d: bad weight frame: %s", self.actor_id, e)
-            return False
+        on_applied = None
+        if self.league is not None:
+            on_applied = lambda named, version: self.league.maybe_snapshot(version, named)
+        return apply_weight_frame(
+            self, frame, f"selfplay actor {self.actor_id}", on_applied=on_applied
+        )
 
     # ------------------------------------------------------------- episode
 

@@ -21,9 +21,35 @@ if "xla_force_host_platform_device_count" not in _flags:
 # with the checkout could SIGILL on a weaker host. Warm runs skip the
 # ~60-100s of recompiles a fresh pytest process otherwise pays. Exported
 # via env so subprocess tests (multihost) share it.
-_cache_dir = os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", f"/tmp/dotaclient_tpu_jax_cache_{os.getuid()}"
-)
+#
+# The dir is trusted ONLY if we own it with 0700 perms — cache entries
+# are serialized native executables, so a path another user pre-created
+# on a shared machine would hand them code execution. On any doubt,
+# fall back to a fresh private dir (cold cache, still correct).
+
+
+def _trusted_cache_dir() -> str:
+    import stat
+    import tempfile
+
+    path = f"/tmp/dotaclient_tpu_jax_cache_{os.getuid()}"
+    try:
+        os.mkdir(path, 0o700)  # exclusive create: ours by construction
+        return path
+    except FileExistsError:
+        st = os.lstat(path)
+        if (
+            stat.S_ISDIR(st.st_mode)
+            and st.st_uid == os.getuid()
+            and not (st.st_mode & (stat.S_IWGRP | stat.S_IWOTH))
+        ):
+            return path
+    except OSError:
+        pass
+    return tempfile.mkdtemp(prefix="dotaclient_tpu_jax_cache_")
+
+
+_cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _trusted_cache_dir())
 
 import jax  # noqa: E402
 

@@ -107,6 +107,32 @@ def test_actor_ignores_stale_weight_frame(env):
     assert actor.maybe_update_weights()
 
 
+def test_actor_resyncs_after_learner_restart_without_checkpoint(env):
+    """A learner that restarts WITHOUT a checkpoint re-publishes from
+    v0. One or two older frames are treated as stale-delivery noise, but
+    a consistent stream of them means the learner genuinely lives at a
+    lower version — the actor must resync rather than reject broadcasts
+    forever (running ancient weights while stamping high versions)."""
+    actor, broker, cfg = make_actor(env, "actor_restart")
+    p_v500 = init_params(cfg.policy, jax.random.PRNGKey(7))
+    broker.publish_weights(serialize_weights(flatten_params(p_v500), version=500))
+    assert actor.maybe_update_weights()
+    assert actor.version == 500
+    # learner restarts at v0 and keeps training/publishing
+    restart_params = init_params(cfg.policy, jax.random.PRNGKey(8))
+    for v in (1, 2):
+        broker.publish_weights(serialize_weights(flatten_params(restart_params), version=v))
+        assert not actor.maybe_update_weights()  # first rejections: stale-guard
+        assert actor.version == 500
+    broker.publish_weights(serialize_weights(flatten_params(restart_params), version=3))
+    assert actor.maybe_update_weights()  # third consecutive: resync
+    assert actor.version == 3
+    # a genuinely stale one-off afterwards is still rejected
+    broker.publish_weights(serialize_weights(flatten_params(p_v500), version=1))
+    assert not actor.maybe_update_weights()
+    assert actor.version == 3
+
+
 def test_actor_aux_targets(env):
     actor, broker, cfg = make_actor(env, "actor_t3")
     actor.cfg.policy = PolicyConfig(

@@ -142,3 +142,82 @@ def test_oneof_last_set_wins():
     act.attackTarget.target = 3
     assert act.WhichOneof("actionData") == "attackTarget"
     assert not act.HasField("moveDirectly")
+
+
+def _find_reference_proto():
+    """Locate the real Valve worldstate proto if the reference mount is
+    ever populated (it has been empty rounds 1-3)."""
+    import glob
+    import os
+
+    for pattern in (
+        "/root/reference/**/dota_gcmessages_common_bot_script.proto",
+        "/root/reference/**/CMsgBotWorldState*.proto",
+        "/root/reference/**/*bot_script*.proto",
+    ):
+        hits = glob.glob(pattern, recursive=True)
+        if hits:
+            return hits[0]
+    return None
+
+
+_REF_PROTO = _find_reference_proto()
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.skipif(_REF_PROTO is None, reason="reference mount empty (rounds 1-3)")
+def test_vendored_numbering_matches_reference_proto():
+    """Auto-arms the moment /root/reference/ is populated: diffs the
+    vendored transcription's field numbering against the real file so
+    the [MED]-confidence numbering caveat resolves itself. Parses only
+    `name = number` pairs — the reference file's CONTENT is otherwise
+    untrusted and is not executed or imported."""
+    import re
+
+    def field_numbers(path):
+        """{ 'Message.Nested.field_name': number } — fields are keyed by
+        their enclosing message path: bare names repeat across messages
+        (`location`, `team_id`, `slot`, ... — 142 fields, 119 unique
+        names in the vendored file), so a flat dict would pair fields
+        from unrelated messages."""
+        msg_re = re.compile(r"^\s*message\s+(\w+)\s*\{")
+        # labeled fields AND oneof members (`MoveToTarget moveToTarget = 6;`
+        # has no label); two tokens before `=` excludes enum entries
+        field_re = re.compile(
+            r"(?:^|\{)\s*(?:(?:optional|repeated|required)\s+)?"
+            r"([A-Za-z_][\w.]*)\s+(\w+)\s*=\s*(\d+)\s*[;\[]"
+        )
+        _KEYWORDS = {"message", "enum", "oneof", "option", "rpc", "extend"}
+        out = {}
+        depth = 0
+        stack = []  # (message_name, depth at which its body lives)
+        for line in open(path, errors="replace"):
+            m = msg_re.match(line)
+            if m:
+                stack.append((m.group(1), depth + 1))
+                line_body = line.split("{", 1)[1]  # one-line `message X { ... }`
+            else:
+                line_body = line
+            f = field_re.search("{" + line_body if m else line_body)
+            if f and stack and f.group(1) not in _KEYWORDS:
+                out[".".join(n for n, _ in stack) + "." + f.group(2)] = int(f.group(3))
+            # enum/oneof braces change depth too but are not messages —
+            # a message pops only when depth falls below its body depth
+            depth += line.count("{") - line.count("}")
+            while stack and depth < stack[-1][1]:
+                stack.pop()
+        return out
+
+    ours = field_numbers("dotaclient_tpu/protos/valve_worldstate.proto")
+    theirs = field_numbers(_REF_PROTO)
+    # key by message-path suffix so an extra outer package/message level
+    # in either file doesn't break the join: match on Message.field tail
+    def tails(d):
+        return {".".join(k.split(".")[-2:]): v for k, v in d.items()}
+
+    ours_t, theirs_t = tails(ours), tails(theirs)
+    shared = set(ours_t) & set(theirs_t)
+    assert len(shared) > 40, f"too few shared Message.field keys ({len(shared)}) — wrong file?"
+    mismatched = {n: (ours_t[n], theirs_t[n]) for n in shared if ours_t[n] != theirs_t[n]}
+    assert not mismatched, f"vendored numbering diverges (ours, reference): {mismatched}"
