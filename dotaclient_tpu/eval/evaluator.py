@@ -168,6 +168,10 @@ def main(argv=None):
             if frame is not None:
                 apply_weight_frame(agent, frame, "evaluator")
             version = agent.version
+            # learner-restart resync moves version BACKWARDS — clamp the
+            # eval anchor so evaluation resumes immediately instead of
+            # waiting for the new learner to re-reach the old version
+            last_eval = min(last_eval, version)
             if version - last_eval >= cfg.eval_every:
                 res = evaluator.evaluate(agent.params, n_episodes=cfg.episodes, version=version)
                 last_eval = version

@@ -100,7 +100,14 @@ def apply_weight_frame(agent, frame: bytes, log_name: str, on_applied=None) -> b
             version,
         )
     agent._stale_rejects = 0
-    agent.params = unflatten_params(named, agent.params)
+    try:
+        # a frame that deserializes but doesn't match the agent's param
+        # template (learner restarted with a different PolicyConfig)
+        # must ALSO never kill the subscriber
+        agent.params = unflatten_params(named, agent.params)
+    except Exception as e:
+        _log.warning("%s: weight frame does not fit params (%s); ignoring", log_name, e)
+        return False
     agent.version = version
     agent.last_weight_time = time.monotonic()
     if on_applied is not None:
@@ -150,15 +157,22 @@ async def reset_env_stub(actor) -> None:
 
 
 def make_actor_step(cfg: ActorConfig):
-    """jit'd single-step inference: sampling stays on device."""
+    """jit'd single-step inference: sampling stays on device.
+
+    The rng split happens INSIDE the compiled program and the advanced
+    rng is returned as a carry — a host-side jax.random.split per tick
+    is a second compiled dispatch that costs ~35% of the whole actor
+    step at B=1 (measured r3: 925 → 1,424 steps/s fused, 1 CPU core).
+    """
     net = P.PolicyNet(cfg.policy)
 
     @jax.jit
     def step(params, state, obs, rng):
+        rng, key = jax.random.split(rng)
         new_state, out = net.apply(params, state, obs)
-        action = ad.sample(rng, out.dist)
+        action = ad.sample(key, out.dist)
         logp = ad.log_prob(out.dist, action)
-        return new_state, action, logp, out.value
+        return new_state, action, logp, out.value, rng
 
     return step
 
@@ -354,8 +368,7 @@ class Actor:
 
         while not done:
             obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
-            self.rng, key = jax.random.split(self.rng)
-            state, action, logp, value = self.step_fn(self.params, state, obs_b, key)
+            state, action, logp, value, self.rng = self.step_fn(self.params, state, obs_b, self.rng)
 
             hero = F.find_hero(world, self.player_id)
             if hero is not None:

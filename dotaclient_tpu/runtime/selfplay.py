@@ -173,13 +173,14 @@ class SelfPlayActor:
         self.rollouts_published += 1
         side.chunk = _Chunk(side.state)
 
-    def _batched_step(self, params, group: list, key) -> None:
+    def _batched_step(self, params, group: list) -> None:
         """ONE jit call for a group of sides (B = len(group)) — this is
         the TPU-first scaling story for team play: 5v5 mirror is a single
-        B=10 policy step per tick, not ten B=1 steps."""
+        B=10 policy step per tick, not ten B=1 steps. The rng carry
+        (self.rng) advances inside the compiled step."""
         obs_b = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *[s.obs for s in group])
         state_b = jax.tree.map(lambda *xs: jnp.concatenate(xs), *[s.state for s in group])
-        state_b, action_b, logp_b, value_b = self.step_fn(params, state_b, obs_b, key)
+        state_b, action_b, logp_b, value_b, self.rng = self.step_fn(params, state_b, obs_b, self.rng)
         action_h = jax.device_get(action_b)
         logp_h = jax.device_get(logp_b)
         value_h = jax.device_get(value_b)
@@ -229,14 +230,12 @@ class SelfPlayActor:
 
         done = False
         while not done:
-            self.rng, key = jax.random.split(self.rng)
             if mirror:
                 # every controlled hero, both teams, one compiled call
-                self._batched_step(self.params, live_team + opp_team, key)
+                self._batched_step(self.params, live_team + opp_team)
             else:
-                key_live, key_opp = jax.random.split(key)
-                self._batched_step(self.params, live_team, key_live)
-                self._batched_step(self._opp_params, opp_team, key_opp)
+                self._batched_step(self.params, live_team)
+                self._batched_step(self._opp_params, opp_team)
 
             actions: Dict[int, ds.Action] = {}
             for s in sides.values():

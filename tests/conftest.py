@@ -37,13 +37,18 @@ def _trusted_cache_dir() -> str:
         os.mkdir(path, 0o700)  # exclusive create: ours by construction
         return path
     except FileExistsError:
-        st = os.lstat(path)
-        if (
-            stat.S_ISDIR(st.st_mode)
-            and st.st_uid == os.getuid()
-            and not (st.st_mode & (stat.S_IWGRP | stat.S_IWOTH))
-        ):
-            return path
+        # separate try: the dir can vanish between mkdir and lstat (tmp
+        # cleaner, racing run) — any failure here means fall back
+        try:
+            st = os.lstat(path)
+            if (
+                stat.S_ISDIR(st.st_mode)
+                and st.st_uid == os.getuid()
+                and not (st.st_mode & (stat.S_IWGRP | stat.S_IWOTH))
+            ):
+                return path
+        except OSError:
+            pass
     except OSError:
         pass
     return tempfile.mkdtemp(prefix="dotaclient_tpu_jax_cache_")

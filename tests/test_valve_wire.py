@@ -183,25 +183,28 @@ def test_vendored_numbering_matches_reference_proto():
         from unrelated messages."""
         msg_re = re.compile(r"^\s*message\s+(\w+)\s*\{")
         # labeled fields AND oneof members (`MoveToTarget moveToTarget = 6;`
-        # has no label); two tokens before `=` excludes enum entries
+        # has no label); requiring two tokens before `=` excludes enum
+        # entries, and the `;`/`[` tail excludes `returns (...)` etc.
         field_re = re.compile(
-            r"(?:^|\{)\s*(?:(?:optional|repeated|required)\s+)?"
+            r"(?:(?:optional|repeated|required)\s+)?"
             r"([A-Za-z_][\w.]*)\s+(\w+)\s*=\s*(\d+)\s*[;\[]"
         )
         _KEYWORDS = {"message", "enum", "oneof", "option", "rpc", "extend"}
         out = {}
         depth = 0
         stack = []  # (message_name, depth at which its body lives)
-        for line in open(path, errors="replace"):
+        for raw in open(path, errors="replace"):
+            line = raw.split("//", 1)[0]  # commented-out fields must not count
             m = msg_re.match(line)
             if m:
                 stack.append((m.group(1), depth + 1))
                 line_body = line.split("{", 1)[1]  # one-line `message X { ... }`
             else:
                 line_body = line
-            f = field_re.search("{" + line_body if m else line_body)
-            if f and stack and f.group(1) not in _KEYWORDS:
-                out[".".join(n for n, _ in stack) + "." + f.group(2)] = int(f.group(3))
+            # finditer: a compact line may declare several fields
+            for f in field_re.finditer(line_body):
+                if stack and f.group(1) not in _KEYWORDS:
+                    out[".".join(n for n, _ in stack) + "." + f.group(2)] = int(f.group(3))
             # enum/oneof braces change depth too but are not messages —
             # a message pops only when depth falls below its body depth
             depth += line.count("{") - line.count("}")
