@@ -17,9 +17,28 @@ from dataclasses import dataclass, field
 class PolicyConfig:
     """Architecture of the LSTM actor-critic (reference: policy.py)."""
 
+    # Temporal core family: "lstm" (flagship, the reference architecture)
+    # or "transformer" (long-context family: causal attention over the
+    # chunk, chunk-local context, ring-shardable time axis —
+    # models/transformer_policy.py).
+    arch: str = "lstm"
     unit_embed_dim: int = 128
-    lstm_hidden: int = 128
+    lstm_hidden: int = 128  # temporal-core width (d_model for the transformer family)
     mlp_hidden: int = 128
+    # Transformer-family shape (ignored for arch="lstm").
+    tf_layers: int = 2
+    tf_heads: int = 4
+    # Actor KV-cache capacity. Invariant (enforced in make_actor_step):
+    # >= rollout_len — the actor steps at most rollout_len frames per
+    # chunk before next_chunk resets the cache (the bootstrap obs is
+    # never stepped). Default leaves one slot of headroom over the
+    # default rollout_len=16.
+    tf_context: int = 17
+    # Learner-side sequence parallelism: name of the mesh axis to shard
+    # the time dimension over ("" = off). Engages ring attention
+    # (ops/ring_attention.py) inside the unroll; requires the unrolled
+    # frame count (seq_len+1) to divide by the axis size.
+    tf_sp_axis: str = ""
     n_move_bins: int = 9  # 9-way discretized move offsets per axis
     move_step: float = 350.0  # map units per outermost move-grid cell
     # Auxiliary value heads (benchmark config 5: win-prob, last-hit, net-worth).

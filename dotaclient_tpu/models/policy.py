@@ -11,9 +11,11 @@ selected sub-heads. TPU-first decisions here:
   learner a teacher-forced full unroll; both are the same `PolicyCore`
   applied directly or through `nn.scan` over the time axis (params
   broadcast), so step-vs-unroll equivalence is structural, not tested-in.
-- **`lax.scan` over time, batch over devices.** The time axis stays inside
-  one device (sequence parallelism is deliberately N/A at chunk length
-  ~16 — SURVEY.md §5); scaling is over the batch via the mesh.
+- **`lax.scan` over time, batch over devices.** The LSTM family's time
+  axis stays inside one device (chunk length ~16, the reference regime —
+  SURVEY.md §5); scaling is over the batch via the mesh. Long chunks are
+  the transformer family's job (models/transformer_policy.py), where the
+  time axis itself shards over an `sp` mesh axis.
 - **bfloat16 compute, float32 params and heads.** Matmuls hit the MXU in
   bf16; logits/value are cast to f32 before masking/sampling/loss so the
   distribution math is stable.
@@ -88,9 +90,78 @@ class LSTMCell(nn.Module):
         return (c_T, h_T), h_seq
 
 
+def obs_trunk(cfg: PolicyConfig, obs: F.Observation):
+    """Embeddings + pooling + trunk MLP, shared by both policy families.
+
+    Must be called inside a compact scope (Flax registers the Dense
+    layers on the module whose scope is active), so layer names stay
+    flat ("unit_mlp1", …) and the LSTM family's param tree is identical
+    to the pre-refactor layout. Returns (trunk [.., H], unit_emb
+    [.., U, D]) — position-independent, so in unroll mode everything
+    here is one [B·T]-batched MXU matmul.
+    """
+    dt = _dtype(cfg)
+    D = cfg.unit_embed_dim
+
+    unit_mask = obs.unit_mask
+    units = obs.unit_feats.astype(dt)
+    x = nn.Dense(cfg.mlp_hidden, dtype=dt, name="unit_mlp1")(units)
+    x = nn.relu(x)
+    unit_emb = nn.Dense(D, dtype=dt, name="unit_mlp2")(x)  # [B, U, D]
+
+    # Masked max+mean pooling to a fixed-size neighbourhood context.
+    m = unit_mask[..., None]
+    neg = jnp.asarray(BIG_NEG, dt)
+    pool_max = jnp.max(jnp.where(m, unit_emb, neg), axis=-2)
+    any_unit = jnp.any(unit_mask, axis=-1, keepdims=True)
+    pool_max = jnp.where(any_unit, pool_max, 0.0)
+    denom = jnp.maximum(jnp.sum(m, axis=-2), 1).astype(dt)
+    pool_mean = jnp.sum(jnp.where(m, unit_emb, 0.0), axis=-2) / denom
+
+    hero = nn.Dense(cfg.mlp_hidden, dtype=dt, name="hero_mlp")(obs.hero_feats.astype(dt))
+    glob = nn.Dense(cfg.mlp_hidden // 4, dtype=dt, name="global_mlp")(obs.global_feats.astype(dt))
+    trunk = jnp.concatenate([nn.relu(hero), nn.relu(glob), pool_max, pool_mean], axis=-1)
+    trunk = nn.relu(nn.Dense(cfg.lstm_hidden, dtype=dt, name="trunk")(trunk))
+    return trunk, unit_emb
+
+
+def action_heads(
+    cfg: PolicyConfig, out: jnp.ndarray, unit_emb: jnp.ndarray, obs: F.Observation
+) -> PolicyOutput:
+    """Masked action heads + value (+aux), shared by both families.
+    `out` is the temporal core's output in f32; logits compute in f32
+    for stable masking/softmax."""
+    D = cfg.unit_embed_dim
+    type_logits = nn.Dense(F.N_ACTION_TYPES, dtype=jnp.float32, name="type_head")(out)
+    move_x = nn.Dense(cfg.n_move_bins, dtype=jnp.float32, name="move_x_head")(out)
+    move_y = nn.Dense(cfg.n_move_bins, dtype=jnp.float32, name="move_y_head")(out)
+    # Target selection = dot-product attention of a core-output query
+    # against the unit embeddings (reference's target head).
+    query = nn.Dense(D, dtype=jnp.float32, name="target_query")(out)
+    target_logits = jnp.einsum("...d,...ud->...u", query, unit_emb.astype(jnp.float32))
+    target_logits = target_logits / jnp.sqrt(jnp.asarray(D, jnp.float32))
+
+    dist = Dist(
+        type_logp=masked_log_softmax(type_logits, obs.action_mask),
+        move_x_logp=jax.nn.log_softmax(move_x, axis=-1),
+        move_y_logp=jax.nn.log_softmax(move_y, axis=-1),
+        target_logp=masked_log_softmax(target_logits, obs.target_mask),
+    )
+    value = nn.Dense(1, dtype=jnp.float32, name="value_head")(out)[..., 0]
+
+    aux = None
+    if cfg.aux_heads:
+        aux = AuxOutputs(
+            win_logit=nn.Dense(1, dtype=jnp.float32, name="aux_win")(out)[..., 0],
+            last_hit=nn.Dense(1, dtype=jnp.float32, name="aux_lh")(out)[..., 0],
+            net_worth=nn.Dense(1, dtype=jnp.float32, name="aux_nw")(out)[..., 0],
+        )
+    return PolicyOutput(dist=dist, value=value, aux=aux)
+
+
 class PolicyCore(nn.Module):
-    """The policy network: featurized obs + LSTM state → action dist +
-    value. One module, both modes — single step (obs leaves [B, ...])
+    """The LSTM policy network: featurized obs + LSTM state → action dist
+    + value. One module, both modes — single step (obs leaves [B, ...])
     and teacher-forced unroll (obs leaves [B, T, ...]). Every layer here
     except the LSTM recurrence is position-independent, so in unroll mode
     the embeddings, trunk, and heads all run as single [B·T] batched MXU
@@ -103,88 +174,86 @@ class PolicyCore(nn.Module):
         self, carry: LSTMState, obs: F.Observation, unroll: bool = False
     ) -> Tuple[LSTMState, PolicyOutput]:
         cfg = self.cfg
-        dt = _dtype(cfg)
-        D = cfg.unit_embed_dim
+        trunk, unit_emb = obs_trunk(cfg, obs)
 
-        unit_mask = obs.unit_mask
-        units = obs.unit_feats.astype(dt)
-        x = nn.Dense(cfg.mlp_hidden, dtype=dt, name="unit_mlp1")(units)
-        x = nn.relu(x)
-        unit_emb = nn.Dense(D, dtype=dt, name="unit_mlp2")(x)  # [B, U, D]
-
-        # Masked max+mean pooling to a fixed-size neighbourhood context.
-        m = unit_mask[..., None]
-        neg = jnp.asarray(BIG_NEG, dt)
-        pool_max = jnp.max(jnp.where(m, unit_emb, neg), axis=-2)
-        any_unit = jnp.any(unit_mask, axis=-1, keepdims=True)
-        pool_max = jnp.where(any_unit, pool_max, 0.0)
-        denom = jnp.maximum(jnp.sum(m, axis=-2), 1).astype(dt)
-        pool_mean = jnp.sum(jnp.where(m, unit_emb, 0.0), axis=-2) / denom
-
-        hero = nn.Dense(cfg.mlp_hidden, dtype=dt, name="hero_mlp")(obs.hero_feats.astype(dt))
-        glob = nn.Dense(cfg.mlp_hidden // 4, dtype=dt, name="global_mlp")(obs.global_feats.astype(dt))
-        trunk = jnp.concatenate([nn.relu(hero), nn.relu(glob), pool_max, pool_mean], axis=-1)
-        trunk = nn.relu(nn.Dense(cfg.lstm_hidden, dtype=dt, name="trunk")(trunk))
-
-        # LSTM output stays f32: every head below computes in f32, so a
-        # bf16 round-trip here would be pure precision loss.
-        carry, out = LSTMCell(cfg.lstm_hidden, dtype=dt, impl=cfg.lstm_impl, name="lstm")(
+        # LSTM output stays f32: every head computes in f32, so a bf16
+        # round-trip here would be pure precision loss.
+        carry, out = LSTMCell(cfg.lstm_hidden, dtype=_dtype(cfg), impl=cfg.lstm_impl, name="lstm")(
             carry, trunk, unroll=unroll
         )
-
-        # Heads — logits in f32 for stable masking/softmax.
-        type_logits = nn.Dense(F.N_ACTION_TYPES, dtype=jnp.float32, name="type_head")(out)
-        move_x = nn.Dense(cfg.n_move_bins, dtype=jnp.float32, name="move_x_head")(out)
-        move_y = nn.Dense(cfg.n_move_bins, dtype=jnp.float32, name="move_y_head")(out)
-        # Target selection = dot-product attention of an lstm-out query
-        # against the unit embeddings (reference's target head).
-        query = nn.Dense(D, dtype=jnp.float32, name="target_query")(out)
-        target_logits = jnp.einsum("...d,...ud->...u", query, unit_emb.astype(jnp.float32))
-        target_logits = target_logits / jnp.sqrt(jnp.asarray(D, jnp.float32))
-
-        dist = Dist(
-            type_logp=masked_log_softmax(type_logits, obs.action_mask),
-            move_x_logp=jax.nn.log_softmax(move_x, axis=-1),
-            move_y_logp=jax.nn.log_softmax(move_y, axis=-1),
-            target_logp=masked_log_softmax(target_logits, obs.target_mask),
-        )
-        value = nn.Dense(1, dtype=jnp.float32, name="value_head")(out)[..., 0]
-
-        aux = None
-        if cfg.aux_heads:
-            aux = AuxOutputs(
-                win_logit=nn.Dense(1, dtype=jnp.float32, name="aux_win")(out)[..., 0],
-                last_hit=nn.Dense(1, dtype=jnp.float32, name="aux_lh")(out)[..., 0],
-                net_worth=nn.Dense(1, dtype=jnp.float32, name="aux_nw")(out)[..., 0],
-            )
-        return carry, PolicyOutput(dist=dist, value=value, aux=aux)
+        return carry, action_heads(cfg, out, unit_emb, obs)
 
 
 class PolicyNet(nn.Module):
-    """Public policy module.
+    """Public policy module — family-agnostic front door.
 
     - `apply(params, state, obs)` — single step, obs leaves [B, ...].
     - `apply(params, state, obs_seq, unroll=True)` — teacher-forced unroll,
       obs leaves [B, T, ...]; returns outputs with a [B, T] time axis and
-      the final LSTM state.
+      the final temporal state.
     Params are identical between the two modes (every layer is shared;
-    the time axis only exists inside the LSTM recurrence).
+    the time axis only exists inside the temporal core). cfg.arch picks
+    the core: "lstm" (flagship) or "transformer" (long-context family —
+    models/transformer_policy.py; its unroll ignores `state`, context is
+    chunk-local). `sp_mesh` is only read by the transformer family's
+    unroll, to ring-shard the time axis over cfg.tf_sp_axis.
     """
 
     cfg: PolicyConfig
+    sp_mesh: Optional[object] = None  # jax.sharding.Mesh; None = no SP
 
     def _assert_shapes(self, obs: F.Observation) -> None:
         assert obs.unit_feats.shape[-2:] == (F.MAX_UNITS, F.UNIT_FEATURES)
 
     @nn.compact
-    def __call__(self, state: LSTMState, obs: F.Observation, unroll: bool = False):
+    def __call__(self, state, obs: F.Observation, unroll: bool = False):
         self._assert_shapes(obs)
+        if self.cfg.arch == "transformer":
+            # Import here: transformer_policy imports this module's
+            # shared trunk/heads.
+            from dotaclient_tpu.models.transformer_policy import TransformerPolicyCore
+
+            return TransformerPolicyCore(self.cfg, self.sp_mesh, name="core")(state, obs, unroll)
         return PolicyCore(self.cfg, name="core")(state, obs, unroll)
 
-def initial_state(cfg: PolicyConfig, batch_shape) -> LSTMState:
-    """LSTM zero-state without needing a module instance (host-side use)."""
+def initial_state(cfg: PolicyConfig, batch_shape):
+    """Fresh temporal state without needing a module instance (host-side
+    use): LSTM (c, h) zeros, or the transformer family's empty KVCache.
+    Every leaf is batch-leading in both families."""
+    if cfg.arch == "transformer":
+        from dotaclient_tpu.models.transformer_policy import init_cache
+
+        return init_cache(cfg, batch_shape)
     shape = tuple(batch_shape) + (cfg.lstm_hidden,)
     return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+
+def wire_state(cfg: PolicyConfig, state):
+    """The (c, h) [B, H] f32 pair the fixed wire format ships with each
+    rollout (transport/serialize.py). The LSTM's state IS that pair; a
+    transformer KVCache maps to zeros — the learner's unroll is
+    chunk-local and ignores initial state, so nothing real is lost and
+    the wire format stays family-agnostic."""
+    if cfg.arch == "transformer":
+        import numpy as np
+
+        B = state.idx.shape[0]
+        z = np.zeros((B, cfg.lstm_hidden), np.float32)
+        return (z, z)
+    return state
+
+
+def reset_between_chunks(cfg: PolicyConfig, state):
+    """Chunk-boundary state transition for the actor. The LSTM carries
+    its state across chunks (the learner receives it on the wire —
+    SURVEY.md §7 "LSTM state handoff"); the transformer family resets to
+    an empty cache so acting context matches the learner's chunk-local
+    teacher-forced re-eval exactly."""
+    if cfg.arch == "transformer":
+        from dotaclient_tpu.models.transformer_policy import init_cache
+
+        return init_cache(cfg, (state.idx.shape[0],))
+    return state
 
 
 def init_params(cfg: PolicyConfig, rng: jax.Array):

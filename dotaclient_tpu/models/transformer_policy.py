@@ -1,0 +1,206 @@
+"""Transformer actor-critic — the long-context policy family.
+
+The flagship family is the LSTM (models/policy.py), matching the
+reference's architecture (SURVEY.md §3.3 "Policy forward"). This family
+exists for the scale regime the reference never reached: observation
+histories of hundreds-to-thousands of steps, where an LSTM's fixed-width
+carry is the bottleneck and the TPU-right design is a causal transformer
+over the time axis with the O(T²) attention sharded over an `sp` mesh
+axis (ops/ring_attention.py).
+
+Interface contract — identical to the LSTM family, so the actor loop,
+train step, staging and wire format are all family-agnostic:
+
+- `unroll=False` (actor): the carried state is a `KVCache`; one step
+  writes the new token's K/V at each row's slot and attends over the
+  cache. Per-row write indices mean batched actors at different episode
+  phases share one compiled step.
+- `unroll=True` (learner): teacher-forced causal attention over the
+  whole [B, T, ...] chunk; the passed state is IGNORED — context is
+  chunk-local by design, and the actor resets its cache at every chunk
+  boundary (models.policy.reset_between_chunks) so acting-time and
+  re-eval-time distributions are identical. This is the transformer's
+  analogue of shipping the LSTM carry with each chunk (SURVEY.md §7
+  "LSTM state handoff"); the trade — no cross-chunk memory — is bought
+  back by making chunks long (seq_len 128+), which is exactly the
+  regime attention wants and sequence parallelism pays for.
+
+The observation trunk and every action head are the shared functions in
+models/policy.py (`obs_trunk` / `action_heads`), so the two families
+differ only in their temporal core.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from dotaclient_tpu.config import PolicyConfig
+from dotaclient_tpu.ops import attention as A
+from dotaclient_tpu.ops import ring_attention as RA
+
+
+class KVCache(NamedTuple):
+    """Actor-side attention state. Every leaf is BATCH-LEADING (like the
+    LSTM's (c, h)) so the generic state plumbing — selfplay's per-side
+    concat/slice batching, the actor's row resets — works unchanged:
+    k/v [B, L, C, N, Dh]; pos [B, C] holds absolute positions with
+    EMPTY_POS in unwritten slots (shared across layers — every layer
+    sees the same timeline); idx [B] is each row's next write slot."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    pos: jnp.ndarray
+    idx: jnp.ndarray
+
+
+def init_cache(cfg: PolicyConfig, batch_shape) -> KVCache:
+    B = int(batch_shape[0]) if len(batch_shape) else 1
+    L, C, N = cfg.tf_layers, cfg.tf_context, cfg.tf_heads
+    Dh = cfg.lstm_hidden // N
+    return KVCache(
+        k=jnp.zeros((B, L, C, N, Dh), jnp.float32),
+        v=jnp.zeros((B, L, C, N, Dh), jnp.float32),
+        pos=jnp.full((B, C), A.EMPTY_POS, jnp.int32),
+        idx=jnp.zeros((B,), jnp.int32),
+    )
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: LN → causal MHA (+residual) → LN →
+    GELU MLP (+residual). Matmuls in `dtype` (MXU); LN, softmax and the
+    residual stream in f32."""
+
+    d_model: int
+    n_heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+    sp_mesh: Optional[Mesh] = None
+    sp_axis: str = ""
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,  # [B, T, D] f32 residual stream
+        positions: jnp.ndarray,  # [B, T] int32 absolute positions
+        cache: Optional[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]] = None,
+    ):
+        """cache=None: causal self-attention over the T axis (unroll
+        mode; ring-sharded when sp_mesh/sp_axis are set). Otherwise
+        cache=(k_cache [B,C,N,Dh], v_cache, cache_pos [B,C] ALREADY
+        including this token's position, write_onehot [B,C]): T==1
+        stepping — the block writes its fresh K/V into the cache at
+        write_onehot and attends over the merged cache. Returns
+        (x_out, None) in unroll mode, (x_out, (k_cache', v_cache')) in
+        step mode."""
+        D, N = self.d_model, self.n_heads
+        Dh = D // N
+        dt = self.dtype
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        qkv = nn.Dense(3 * D, dtype=dt, name="qkv")(h.astype(dt))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # RoPE at this token's absolute position; cached K were rotated
+        # at write time, so angles are consistent across modes.
+        q = A.rope(q.reshape(q.shape[:-1] + (N, Dh)), positions)
+        k = A.rope(k.reshape(k.shape[:-1] + (N, Dh)), positions)
+        v = v.reshape(v.shape[:-1] + (N, Dh))
+
+        new_cache = None
+        if cache is None:
+            attn = RA.attend(q, k, v, positions, positions, mesh=self.sp_mesh, sp_axis=self.sp_axis)
+        else:
+            k_cache, v_cache, cache_pos, onehot = cache
+            w = onehot[:, :, None, None].astype(jnp.float32)  # [B, C, 1, 1]
+            k_cache = k_cache * (1.0 - w) + k.astype(jnp.float32) * w
+            v_cache = v_cache * (1.0 - w) + v.astype(jnp.float32) * w
+            attn = RA.attend(q, k_cache, v_cache, positions, cache_pos)
+            new_cache = (k_cache, v_cache)
+        out = nn.Dense(D, dtype=dt, name="attn_out")(
+            attn.astype(dt).reshape(attn.shape[:-2] + (D,))
+        )
+        x = x + out.astype(jnp.float32)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(4 * D, dtype=dt, name="mlp_up")(h.astype(dt))
+        h = nn.gelu(h)
+        h = nn.Dense(D, dtype=dt, name="mlp_down")(h)
+        return x + h.astype(jnp.float32), new_cache
+
+
+class TransformerCore(nn.Module):
+    """Temporal core: trunk features → context features.
+
+    Unroll: x [B, T, D] → [B, T, D], carry passed through untouched
+    (chunk-local context). Step: x [B, D] → [B, D], carry is a KVCache.
+    """
+
+    cfg: PolicyConfig
+    sp_mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, carry, x: jnp.ndarray, unroll: bool = False):
+        cfg = self.cfg
+        D, N, L = cfg.lstm_hidden, cfg.tf_heads, cfg.tf_layers
+        if D % N:
+            raise ValueError(f"lstm_hidden={D} not divisible by tf_heads={N}")
+        if (D // N) % 2:
+            raise ValueError(
+                f"head dim {D // N} (lstm_hidden={D} / tf_heads={N}) must be "
+                f"even — RoPE rotates feature pairs"
+            )
+        dt = jnp.dtype(cfg.dtype)
+
+        if unroll:
+            B, T = x.shape[0], x.shape[1]
+            positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+            h = x.astype(jnp.float32)
+            for i in range(L):
+                h, _ = Block(D, N, dt, self.sp_mesh, cfg.tf_sp_axis, name=f"block{i}")(
+                    h, positions
+                )
+            return carry, h
+
+        assert isinstance(carry, KVCache), "transformer step mode needs a KVCache carry"
+        C = carry.pos.shape[1]
+        positions = carry.idx[:, None]  # [B, 1] — this step's absolute position
+        # Ring-buffer write: past capacity the oldest slot is overwritten,
+        # degrading gracefully to sliding-window attention over the last C
+        # tokens (absolute positions keep the causal mask and RoPE exact).
+        # The shipping actor never wraps — it resets the cache every chunk
+        # and tf_context >= chunk frames — but an unconditional one-hot of
+        # an out-of-range index would silently DROP the write instead.
+        onehot = jax.nn.one_hot(carry.idx % C, C, dtype=jnp.float32)  # [B, C]
+        new_pos = jnp.where(onehot > 0, positions, carry.pos).astype(jnp.int32)
+
+        h = x.astype(jnp.float32)[:, None, :]  # [B, 1, D]
+        ks, vs = [], []
+        for i in range(L):
+            h, (k_i, v_i) = Block(D, N, dt, name=f"block{i}")(
+                h, positions, cache=(carry.k[:, i], carry.v[:, i], new_pos, onehot)
+            )
+            ks.append(k_i)
+            vs.append(v_i)
+        new_carry = KVCache(
+            k=jnp.stack(ks, axis=1), v=jnp.stack(vs, axis=1), pos=new_pos, idx=carry.idx + 1
+        )
+        return new_carry, h[:, 0, :]
+
+
+class TransformerPolicyCore(nn.Module):
+    """Shared trunk + transformer temporal core + shared heads — the
+    drop-in alternative to models.policy.PolicyCore."""
+
+    cfg: PolicyConfig
+    sp_mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, carry, obs, unroll: bool = False):
+        from dotaclient_tpu.models.policy import action_heads, obs_trunk
+
+        trunk, unit_emb = obs_trunk(self.cfg, obs)
+        carry, out = TransformerCore(self.cfg, self.sp_mesh, name="tf")(carry, trunk, unroll)
+        return carry, action_heads(self.cfg, out, unit_emb, obs)

@@ -1,0 +1,144 @@
+"""Causal attention over the time axis — the transformer family's core op.
+
+The reference's only temporal model is the LSTM (SURVEY.md §3.3); its
+"long-context / sequence parallelism" row is N/A because chunk length is
+~16. This op exists for the scale path the reference never had: training
+on long chunks (T in the hundreds-to-thousands) where the O(T²) attention
+is the dominant FLOP/memory term and the time axis itself must shard over
+devices (ops/ring_attention.py rides on the block primitive here).
+
+Design, TPU-first:
+
+- **Positions are data, masking is arithmetic.** Every variant takes
+  absolute int32 positions for queries and keys and derives causality as
+  `k_pos <= q_pos`. No Python control flow, no shape-dependent mask
+  construction — the same compiled code serves full unroll, KV-cache
+  stepping (empty cache slots carry a sentinel position that can never
+  satisfy the inequality), and ring blocks (rotating K/V shards carry
+  their positions with them, so no block-offset bookkeeping exists at
+  all).
+- **Streaming softmax as the shared primitive.** `accumulate_block` is
+  the flash-attention inner step (running max `m`, normalizer `l`,
+  unnormalized accumulator `acc`); full attention is the one-block
+  special case and ring attention is the N-block loop. One set of
+  numerics to test, f32 throughout the softmax regardless of the matmul
+  dtype (bf16 inputs hit the MXU; the exp/normalizer math does not
+  deserve bf16).
+- **RoPE for positions.** Rotary embeddings commute with KV caching and
+  with ring rotation (angles depend only on absolute positions, which
+  travel with the tensors), unlike learned absolute embeddings which
+  would pin the context length at init time.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel position for "no key here" (empty KV-cache slot). Any real
+# query position is < this, so the causal test k_pos <= q_pos masks it.
+EMPTY_POS = jnp.iinfo(jnp.int32).max
+
+# Logit value for masked scores. Finite (not -inf) so a hypothetical
+# all-masked row yields zeros after the explicit `where` in the exp, not
+# NaN. (Causal attention always has >= 1 valid key — the query itself —
+# but the primitive must not rely on its caller's geometry.)
+_NEG = -1e30
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding.
+
+    x [.., T, N, Dh] (Dh even), positions [.., T] int32 absolute
+    positions. Angle math in f32; result cast back to x.dtype.
+    """
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)  # [half]
+    # Sentinel positions would produce garbage angles; they belong to
+    # empty cache slots whose scores are masked anyway, so zero them to
+    # keep the trig finite.
+    pos = jnp.where(positions == EMPTY_POS, 0, positions).astype(jnp.float32)
+    ang = pos[..., None] * freqs  # [.., T, half]
+    cos = jnp.cos(ang)[..., None, :]  # [.., T, 1, half] — broadcast over heads
+    sin = jnp.sin(ang)[..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def accumulate_block(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    k_pos: jnp.ndarray,
+    m: jnp.ndarray,
+    l: jnp.ndarray,
+    acc: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One streaming-softmax step over a K/V block.
+
+    q [.., Tq, N, Dh]; k, v [.., Tk, N, Dh]; q_pos [.., Tq]; k_pos [.., Tk].
+    Carries (all f32): m [.., N, Tq] running max, l [.., N, Tq] running
+    normalizer, acc [.., N, Tq, Dh] unnormalized output. Returns updated
+    carries; `finalize_attention` turns them into the attention output.
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    # [.., N, Tq, Tk] — matmul in the input dtype (MXU), scores in f32.
+    s = jnp.einsum("...qnd,...knd->...nqk", q, k, preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * scale
+    valid = (k_pos[..., None, None, :] <= q_pos[..., None, :, None]) & (
+        k_pos[..., None, None, :] != EMPTY_POS
+    )
+    s = jnp.where(valid, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # Explicit where: if an entire row is masked, m_new == _NEG-ish and
+    # exp(s - m_new) would be exp(0) = 1 for every masked slot.
+    p = jnp.where(valid, jnp.exp(s - m_new[..., None]), 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "...nqk,...knd->...nqd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def init_carry(q: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Zero-state (m, l, acc) for a streaming pass with query block `q`."""
+    lead = q.shape[:-3]
+    Tq, N, Dh = q.shape[-3:]
+    m = jnp.full(lead + (N, Tq), _NEG, jnp.float32)
+    l = jnp.zeros(lead + (N, Tq), jnp.float32)
+    acc = jnp.zeros(lead + (N, Tq, Dh), jnp.float32)
+    return m, l, acc
+
+
+def finalize_attention(
+    m: jnp.ndarray, l: jnp.ndarray, acc: jnp.ndarray, dtype=None
+) -> jnp.ndarray:
+    """(m, l, acc) carries → attention output [.., Tq, N, Dh]."""
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # all-masked rows → 0
+    out = jnp.moveaxis(out, -3, -2)  # [.., N, Tq, Dh] → [.., Tq, N, Dh]
+    return out.astype(dtype) if dtype is not None else out
+
+
+def causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    k_pos: jnp.ndarray,
+) -> jnp.ndarray:
+    """Position-masked causal attention, single block.
+
+    q [.., Tq, N, Dh], k/v [.., Tk, N, Dh], q_pos [.., Tq], k_pos [.., Tk]
+    → [.., Tq, N, Dh] in q.dtype. This is both the reference the ring
+    path is tested against and the shipping implementation whenever the
+    whole time axis fits one device.
+    """
+    m, l, acc = init_carry(q)
+    m, l, acc = accumulate_block(q, k, v, q_pos, k_pos, m, l, acc)
+    return finalize_attention(m, l, acc, dtype=q.dtype)

@@ -254,18 +254,16 @@ def lstm_recurrence(x_proj, w_h, c0, h0, impl: str = "auto"):
     VMEM, else lax.scan. "pallas_interpret" runs the kernel in interpret
     mode (CPU tests)."""
     if impl == "auto":
-        # Threshold provenance: in-session r1 measurements on v5e (B=256,
-        # T=16, bf16) had the kernel tying XLA's scan at H=128 (16µs) and
-        # winning from H=256 up (27µs vs 61µs at H=256, 32µs vs 40µs at
-        # H=512). The reproducible artifact is scripts/bench_lstm.py →
-        # LSTM_BENCH.json; it could not be re-run on silicon in r2-r3
-        # (chip unreachable all round — TPU_PROBE_LOG.md), so until a
-        # TPU-backed LSTM_BENCH.json lands, treat the kernel as a SCALE
-        # RESERVE: auto only engages it at H≥256, off the H=128 flagship
-        # hot path either way.
+        # Threshold provenance: LSTM_BENCH.json, measured ON SILICON
+        # (TPU v5 lite, 2026-07-30, B=256 T=16 bf16): pallas fwd+bwd
+        # 18.5µs vs scan 29.9µs at H=128, 18.9 vs 21.8 at H=256, tie at
+        # H=512 (25.3 vs 25.1). The kernel therefore serves the flagship
+        # H=128 hot path; above the measured range scan is at parity and
+        # avoids untested VMEM geometries. Re-run scripts/bench_lstm.py
+        # to regenerate the artifact before moving these bounds.
         on_tpu = jax.default_backend() == "tpu"
-        big = x_proj.shape[-1] // 4 >= 256
-        impl = "pallas" if on_tpu and big and _pallas_ok(x_proj) else "scan"
+        H = x_proj.shape[-1] // 4
+        impl = "pallas" if on_tpu and 128 <= H < 512 and _pallas_ok(x_proj) else "scan"
     if impl == "scan":
         return lstm_scan(x_proj, w_h, c0, h0)
     if impl == "pallas":

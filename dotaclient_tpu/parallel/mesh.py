@@ -14,9 +14,15 @@ a `jax.sharding.Mesh` and lets XLA insert the collectives:
   so the same code serves a grown model (SURVEY.md §2 rebuild
   disposition for TP).
 
-PP/SP/EP are deliberately absent: the time axis stays inside one device
-(`lax.scan`), chunk length ~16 makes sequence parallelism N/A, and the
-model has no experts (SURVEY.md §5 "Long-context / sequence parallelism").
+- `sp` axis: sequence parallelism for the transformer family's
+  long-context training — the obs TIME axis shards over it and the
+  unroll's attention runs as a ppermute ring (ops/ring_attention.py).
+  The flagship LSTM family keeps its time axis inside one device
+  (`lax.scan`, chunk ~16 — the reference regime, SURVEY.md §5); the sp
+  axis is the scale path beyond it.
+
+PP/EP are deliberately absent: the model has no pipeline-depth or
+experts to shard (SURVEY.md §2 parallelism checklist).
 """
 
 from __future__ import annotations
@@ -65,6 +71,12 @@ def make_mesh(spec: str = "dp=-1", devices=None) -> Mesh:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (batch) axis over dp; replicate everything else."""
     return NamedSharding(mesh, P("dp" if "dp" in mesh.axis_names else None))
+
+
+def time_sharding(mesh: Mesh, sp_axis: str) -> NamedSharding:
+    """[B, T, ...] leaves: batch over dp (if present), time over the
+    sequence-parallel axis (transformer-family long-context mode)."""
+    return NamedSharding(mesh, P("dp" if "dp" in mesh.axis_names else None, sp_axis))
 
 
 def _leaf_spec(leaf, tp: int) -> P:

@@ -49,18 +49,41 @@ def init_train_state(cfg: LearnerConfig, rng: jax.Array) -> TrainState:
 
 
 def build_train_step(cfg: LearnerConfig, mesh):
-    """Returns (train_step, state_shardings, batch_sharding).
+    """Returns (train_step, state_shardings, batch_shardings).
 
     `train_step(state, batch) -> (state', metrics)` is jit-compiled with
-    explicit in/out shardings over `mesh`.
+    explicit in/out shardings over `mesh`. `batch_shardings` is a
+    TrainBatch-shaped PYTREE of NamedShardings — callers must device_put
+    host batches with it verbatim (`jax.device_put(batch, batch_shardings)`):
+    in sequence-parallel mode the obs leaves shard over (dp, sp) while
+    the [B, T] scalars stay dp-only, so a single flat sharding would
+    disagree with the jit's in_shardings and fail at dispatch.
     """
-    dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axis_sizes.get("dp", 1)
     if cfg.batch_size % max(dp, 1):
         raise ValueError(
             f"batch_size={cfg.batch_size} must be divisible by the mesh dp "
             f"axis ({dp}); adjust --batch_size or --mesh_shape"
         )
-    net = PolicyNet(cfg.policy)
+    # Sequence parallelism (transformer family only): shard the obs time
+    # axis over cfg.policy.tf_sp_axis and run ring attention inside the
+    # unroll. The unrolled chunk is seq_len+1 frames (bootstrap frame
+    # included), so THAT count must divide by the axis.
+    sp = cfg.policy.tf_sp_axis
+    if sp and sp not in axis_sizes:
+        raise ValueError(
+            f"tf_sp_axis={sp!r} names no axis of mesh {dict(axis_sizes)!r} — "
+            f"sequence parallelism would be silently disabled; add the axis "
+            f"to --mesh_shape or clear tf_sp_axis"
+        )
+    use_sp = cfg.policy.arch == "transformer" and bool(sp)
+    if use_sp and (cfg.seq_len + 1) % axis_sizes[sp]:
+        raise ValueError(
+            f"sequence parallelism: seq_len+1={cfg.seq_len + 1} frames must "
+            f"divide by mesh axis {sp}={axis_sizes[sp]} (pick seq_len = k*{axis_sizes[sp]}-1)"
+        )
+    net = PolicyNet(cfg.policy, sp_mesh=mesh if use_sp else None)
     opt = make_optimizer(cfg)
 
     def step_fn(state: TrainState, batch: TrainBatch) -> Tuple[TrainState, Dict]:
@@ -81,6 +104,14 @@ def build_train_step(cfg: LearnerConfig, mesh):
     )
     batch_sh = mesh_lib.batch_sharding(mesh)
     batch_shardings = jax.tree.map(lambda _: batch_sh, _batch_template(cfg))
+    if use_sp:
+        # Only the obs leaves carry the (seq_len+1)-frame time axis the
+        # ring shards; the [B, T] scalars (rewards, actions, masks) stay
+        # dp-only — they are tiny and GAE scans them time-locally.
+        obs_sh = mesh_lib.time_sharding(mesh, sp)
+        batch_shardings = batch_shardings._replace(
+            obs=jax.tree.map(lambda _: obs_sh, batch_shardings.obs)
+        )
     metrics_sharding = mesh_lib.replicated(mesh)
 
     train_step = jax.jit(
@@ -94,7 +125,7 @@ def build_train_step(cfg: LearnerConfig, mesh):
         # would only fire on silicon.
         donate_argnums=(0,),
     )
-    return train_step, state_shardings, batch_sh
+    return train_step, state_shardings, batch_shardings
 
 
 def _batch_template(cfg: LearnerConfig):

@@ -164,6 +164,16 @@ def make_actor_step(cfg: ActorConfig):
     is a second compiled dispatch that costs ~35% of the whole actor
     step at B=1 (measured r3: 925 → 1,424 steps/s fused, 1 CPU core).
     """
+    if cfg.policy.arch == "transformer" and cfg.policy.tf_context < cfg.rollout_len:
+        # The cache is reset every chunk (next_chunk), so a capacity >=
+        # rollout_len means it never wraps mid-chunk. A wrap would slide
+        # the acting context window while the learner re-evaluates with
+        # full chunk context — silently wrong PPO ratios, so refuse.
+        raise ValueError(
+            f"tf_context={cfg.policy.tf_context} < rollout_len={cfg.rollout_len}: "
+            f"the KV cache would wrap mid-chunk and acting context would no "
+            f"longer match the learner's chunk-local re-eval"
+        )
     net = P.PolicyNet(cfg.policy)
 
     @jax.jit
@@ -221,8 +231,20 @@ def build_actions_proto(
     return ds.Actions(actions=[a], team_id=team_id, dota_time=dota_time)
 
 
+def next_chunk(policy_cfg, state):
+    """Chunk-boundary transition shared by Actor and SelfPlayActor:
+    returns (state', fresh chunk). The LSTM carries state across chunks
+    (shipped on the wire as the learner's initial carry); the
+    transformer family resets its KV cache here so acting context is
+    chunk-local, exactly like the learner's re-eval
+    (models.policy.reset_between_chunks)."""
+    state = P.reset_between_chunks(policy_cfg, state)
+    return state, _Chunk(P.wire_state(policy_cfg, state))
+
+
 class _Chunk:
-    """Accumulates one rollout chunk between broker publishes."""
+    """Accumulates one rollout chunk between broker publishes. Takes the
+    wire-format (c, h) [1, H] pair (models.policy.wire_state)."""
 
     def __init__(self, initial_state: Tuple[np.ndarray, np.ndarray]):
         self.initial_state = (np.asarray(initial_state[0][0]), np.asarray(initial_state[1][0]))
@@ -358,8 +380,7 @@ class Actor:
         )
         resp = await self.stub.reset(config)
         world = resp.world_state
-        state = P.initial_state(cfg.policy, (1,))
-        chunk = _Chunk(state)
+        state, chunk = next_chunk(cfg.policy, P.initial_state(cfg.policy, (1,)))
         last_hero: Optional[ws.Unit] = None
         episode_return = 0.0
         done = False
@@ -418,7 +439,7 @@ class Actor:
                 )
                 self.broker.publish_experience(serialize_rollout(rollout))
                 self.rollouts_published += 1
-                chunk = _Chunk(state)
+                state, chunk = next_chunk(cfg.policy, state)
                 self.maybe_update_weights()
 
             world = next_world

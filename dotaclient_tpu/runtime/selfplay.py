@@ -47,12 +47,12 @@ from dotaclient_tpu.ops import action_dist as ad
 from dotaclient_tpu.protos import dotaservice_pb2 as ds
 from dotaclient_tpu.protos import worldstate_pb2 as ws
 from dotaclient_tpu.runtime.actor import (
-    _Chunk,
     apply_weight_frame,
     build_action,
     check_weight_freshness,
     connect_env_async,
     make_actor_step,
+    next_chunk,
     reset_env_stub,
 )
 from dotaclient_tpu.transport.base import Broker
@@ -80,8 +80,7 @@ class _Side:
     def __init__(self, player_id: int, team_id: int, cfg: ActorConfig):
         self.player_id = player_id
         self.team_id = team_id
-        self.state = P.initial_state(cfg.policy, (1,))
-        self.chunk = _Chunk(self.state)
+        self.state, self.chunk = next_chunk(cfg.policy, P.initial_state(cfg.policy, (1,)))
         self.world: Optional[ws.World] = None
         self.obs: Optional[F.Observation] = None
         self.handles: Optional[np.ndarray] = None
@@ -171,7 +170,7 @@ class SelfPlayActor:
         )
         self.broker.publish_experience(serialize_rollout(rollout))
         self.rollouts_published += 1
-        side.chunk = _Chunk(side.state)
+        side.state, side.chunk = next_chunk(self.cfg.policy, side.state)
 
     def _batched_step(self, params, group: list) -> None:
         """ONE jit call for a group of sides (B = len(group)) — this is
@@ -306,7 +305,7 @@ class SelfPlayActor:
                     if publish:
                         self._publish(s, win, done)
                     else:
-                        s.chunk = _Chunk(s.state)
+                        s.state, s.chunk = next_chunk(cfg.policy, s.state)
                     if s is live and done:
                         self.last_win = win
                 self.maybe_update_weights()

@@ -1,0 +1,183 @@
+"""Attention ops: oracle softmax, position masking, RoPE, and ring-vs-
+single-device equivalence (forward + gradients) on the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.ops import attention as A
+from dotaclient_tpu.ops import ring_attention as RA
+from dotaclient_tpu.parallel import mesh as mesh_lib
+
+
+def _rand(shape, seed, dtype=np.float32):
+    return np.random.RandomState(seed).randn(*shape).astype(dtype)
+
+
+def _naive_causal(q, k, v, q_pos, k_pos):
+    """Dense-softmax oracle in NumPy float64."""
+    q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
+    B, Tq, N, Dh = q.shape
+    Tk = k.shape[1]
+    out = np.zeros_like(q)
+    for b in range(B):
+        for n in range(N):
+            s = q[b, :, n] @ k[b, :, n].T / np.sqrt(Dh)  # [Tq, Tk]
+            valid = (np.asarray(k_pos)[b][None, :] <= np.asarray(q_pos)[b][:, None]) & (
+                np.asarray(k_pos)[b][None, :] != int(A.EMPTY_POS)
+            )
+            s = np.where(valid, s, -np.inf)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = np.where(valid, p, 0.0)
+            denom = p.sum(-1, keepdims=True)
+            p = np.divide(p, denom, out=np.zeros_like(p), where=denom > 0)
+            out[b, :, n] = p @ v[b, :, n]
+    return out
+
+
+def _positions(B, T):
+    return np.broadcast_to(np.arange(T, dtype=np.int32), (B, T)).copy()
+
+
+class TestCausalAttention:
+    def test_matches_naive_oracle(self):
+        B, T, N, Dh = 2, 12, 3, 8
+        q, k, v = _rand((B, T, N, Dh), 0), _rand((B, T, N, Dh), 1), _rand((B, T, N, Dh), 2)
+        pos = _positions(B, T)
+        got = A.causal_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), pos, pos)
+        np.testing.assert_allclose(got, _naive_causal(q, k, v, pos, pos), rtol=1e-5, atol=1e-5)
+
+    def test_causality_future_keys_ignored(self):
+        # Changing a future key/value must not change a past query's output.
+        B, T, N, Dh = 1, 8, 2, 4
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (3, 4, 5))
+        pos = _positions(B, T)
+        base = A.causal_attention(q, k, v, pos, pos)
+        k2 = k.at[:, -1].add(100.0)
+        v2 = v.at[:, -1].add(100.0)
+        pert = A.causal_attention(q, k2, v2, pos, pos)
+        np.testing.assert_allclose(base[:, :-1], pert[:, :-1], rtol=1e-6)
+        assert not np.allclose(base[:, -1], pert[:, -1])
+
+    def test_empty_sentinel_slots_never_attended(self):
+        # A cache of length 8 with only 3 written slots == attention over
+        # just those 3 — garbage in the tail slots is invisible.
+        B, C, N, Dh = 2, 8, 2, 4
+        k_full = _rand((B, C, N, Dh), 6)
+        v_full = _rand((B, C, N, Dh), 7)
+        k_full[:, 3:] = 1e6  # garbage in unwritten slots
+        v_full[:, 3:] = -1e6
+        k_pos = np.full((B, C), int(A.EMPTY_POS), np.int32)
+        k_pos[:, :3] = np.arange(3, dtype=np.int32)
+        q = jnp.asarray(_rand((B, 1, N, Dh), 8))
+        q_pos = np.full((B, 1), 2, np.int32)
+        got = A.causal_attention(q, jnp.asarray(k_full), jnp.asarray(v_full), q_pos, k_pos)
+        want = A.causal_attention(
+            q,
+            jnp.asarray(k_full[:, :3]),
+            jnp.asarray(v_full[:, :3]),
+            q_pos,
+            k_pos[:, :3],
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_bf16_inputs_f32_softmax(self):
+        B, T, N, Dh = 2, 8, 2, 8
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s), jnp.bfloat16) for s in (9, 10, 11))
+        pos = _positions(B, T)
+        got = A.causal_attention(q, k, v, pos, pos)
+        assert got.dtype == jnp.bfloat16
+        ref = _naive_causal(np.asarray(q, np.float32), np.asarray(k, np.float32),
+                            np.asarray(v, np.float32), pos, pos)
+        np.testing.assert_allclose(np.asarray(got, np.float32), ref, rtol=0.05, atol=0.05)
+
+
+class TestRope:
+    def test_position_zero_is_identity(self):
+        x = jnp.asarray(_rand((2, 1, 2, 8), 12))
+        pos = np.zeros((2, 1), np.int32)
+        np.testing.assert_allclose(A.rope(x, pos), x, rtol=1e-6)
+
+    def test_preserves_norm(self):
+        x = jnp.asarray(_rand((2, 6, 2, 8), 13))
+        pos = _positions(2, 6)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(A.rope(x, pos), axis=-1), jnp.linalg.norm(x, axis=-1), rtol=1e-5
+        )
+
+    def test_scores_depend_only_on_relative_position(self):
+        # <rope(q, p+d), rope(k, p)> must be invariant in p.
+        q = jnp.asarray(_rand((1, 1, 1, 8), 14))
+        k = jnp.asarray(_rand((1, 1, 1, 8), 15))
+
+        def score(pq, pk):
+            rq = A.rope(q, np.asarray([[pq]], np.int32))
+            rk = A.rope(k, np.asarray([[pk]], np.int32))
+            return float(jnp.sum(rq * rk))
+
+        assert score(5, 2) == pytest.approx(score(105, 102), rel=1e-4)
+        assert score(7, 7) == pytest.approx(score(0, 0), rel=1e-4)
+
+    def test_sentinel_position_stays_finite(self):
+        x = jnp.asarray(_rand((1, 3, 2, 8), 16))
+        pos = np.full((1, 3), int(A.EMPTY_POS), np.int32)
+        assert np.isfinite(np.asarray(A.rope(x, pos))).all()
+
+
+class TestRingAttention:
+    @pytest.fixture(scope="class")
+    def sp_mesh(self):
+        return mesh_lib.make_mesh("sp=8")
+
+    def test_matches_single_device(self, sp_mesh):
+        B, T, N, Dh = 2, 32, 2, 8
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (20, 21, 22))
+        pos = _positions(B, T)
+        ring = RA.ring_causal_attention(q, k, v, pos, pos, sp_mesh)
+        full = A.causal_attention(q, k, v, pos, pos)
+        np.testing.assert_allclose(ring, full, rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_single_device(self, sp_mesh):
+        B, T, N, Dh = 1, 16, 2, 4
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (23, 24, 25))
+        pos = _positions(B, T)
+        cot = jnp.asarray(_rand((B, T, N, Dh), 26))  # fixed cotangent
+
+        def loss_ring(q, k, v):
+            return jnp.sum(RA.ring_causal_attention(q, k, v, pos, pos, sp_mesh) * cot)
+
+        def loss_full(q, k, v):
+            return jnp.sum(A.causal_attention(q, k, v, pos, pos) * cot)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for gr, gf in zip(g_ring, g_full):
+            np.testing.assert_allclose(gr, gf, rtol=1e-4, atol=1e-5)
+
+    def test_composes_under_jit(self, sp_mesh):
+        B, T, N, Dh = 2, 16, 2, 8
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (27, 28, 29))
+        pos = _positions(B, T)
+
+        @jax.jit
+        def f(q, k, v):
+            return RA.ring_causal_attention(q, k, v, pos, pos, sp_mesh)
+
+        np.testing.assert_allclose(
+            f(q, k, v), A.causal_attention(q, k, v, pos, pos), rtol=1e-5, atol=1e-6
+        )
+
+    def test_rejects_indivisible_time_axis(self, sp_mesh):
+        q = jnp.zeros((1, 12, 2, 4))
+        pos = _positions(1, 12)
+        with pytest.raises(ValueError, match="not divisible"):
+            RA.ring_causal_attention(q, q, q, pos, pos, sp_mesh)
+
+    def test_dispatch_helper(self, sp_mesh):
+        B, T, N, Dh = 1, 16, 2, 4
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (30, 31, 32))
+        pos = _positions(B, T)
+        via_ring = RA.attend(q, k, v, pos, pos, mesh=sp_mesh, sp_axis="sp")
+        via_full = RA.attend(q, k, v, pos, pos)
+        np.testing.assert_allclose(via_ring, via_full, rtol=1e-5, atol=1e-6)
