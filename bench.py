@@ -208,8 +208,15 @@ def main() -> None:
     train_step, state_sh, batch_sh = build_train_step(cfg, mesh)
     state = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh)
 
-    # ---- device-only rate (context): pre-packed batch, no host pipeline
-    batch = jax.device_put(jax.tree.map(np.asarray, make_train_batch(cfg, 0)), batch_sh)
+    # ---- device-only rate (context): pre-packed batch, no host pipeline.
+    # Same host-side obs cast as the staging path, so this section times
+    # the ONE executable production runs (and the e2e section below hits
+    # the already-compiled program instead of a second multi-minute
+    # compile inside a scarce TPU window).
+    from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
+
+    batch = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, make_train_batch(cfg, 0)))
+    batch = jax.device_put(batch, batch_sh)
     state, metrics = train_step(state, batch)
     jax.block_until_ready(metrics["loss"])
     t0 = time.perf_counter()

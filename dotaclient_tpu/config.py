@@ -90,6 +90,11 @@ class LearnerConfig:
     # C++ batch packer on the staging path (falls back to python when the
     # build/load fails or DOTACLIENT_TPU_NO_NATIVE=1 is set)
     native_packer: bool = True
+    # Stage obs floats in the policy compute dtype (bf16) on the host:
+    # numerically identical (the policy's first op is the same cast) and
+    # halves the dominant host→device transfer (runtime/staging.py
+    # _cast_obs). Off = ship f32 and cast on device.
+    stage_obs_compute_dtype: bool = True
     # jax.profiler server port (0 = off); connect with TensorBoard's
     # profile plugin or jax.profiler.trace to capture device traces
     profile_port: int = 0

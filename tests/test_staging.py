@@ -225,3 +225,67 @@ def test_staging_stress_many_producers_with_stats_reader():
     assert stats["dropped_bad"] == 0 and stats["dropped_stale"] == 0
     assert batches == total // cfg.batch_size
     assert stats["active_actors"] == n_producers  # every producer heartbeated
+
+
+def test_staging_casts_obs_to_compute_dtype():
+    """bf16-policy learners receive obs floats already in bf16 (host-side
+    cast, halves the H2D transfer) — numerically identical to the
+    device-side cast the policy would do, so metrics must match a
+    f32-staged batch exactly."""
+    import jax
+    import ml_dtypes
+
+    from dotaclient_tpu.parallel import mesh as mesh_lib
+    from dotaclient_tpu.parallel.train_step import build_train_step, init_train_state
+
+    def staged_batch(stage_cast):
+        mem.reset("stage_cast")
+        broker = connect("mem://stage_cast")
+        cfg = LearnerConfig(
+            batch_size=2,
+            seq_len=8,
+            policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16),
+            stage_obs_compute_dtype=stage_cast,
+        )
+        for i in range(2):
+            broker.publish_experience(serialize_rollout(make_rollout(L=8, H=8, seed=i)))
+        buf = StagingBuffer(cfg, connect("mem://stage_cast"), version_fn=lambda: 0).start()
+        try:
+            batch = buf.get_batch(timeout=30.0)
+        finally:
+            buf.stop()
+        assert batch is not None
+        return cfg, batch
+
+    cfg, cast_batch = staged_batch(True)
+    assert cast_batch.obs.unit_feats.dtype == ml_dtypes.bfloat16
+    assert cast_batch.obs.unit_mask.dtype == np.bool_  # masks untouched
+    assert cast_batch.rewards.dtype == np.float32  # loss scalars untouched
+    _, f32_batch = staged_batch(False)
+    assert f32_batch.obs.unit_feats.dtype == np.float32
+
+    mesh = mesh_lib.make_mesh("dp=2", devices=jax.devices()[:2])
+    train_step, state_sh, _ = build_train_step(cfg, mesh)
+    state = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh)
+    _, m_cast = train_step(state, cast_batch)
+    state2 = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh)
+    _, m_f32 = train_step(state2, f32_batch)
+    for k in m_f32:
+        assert float(m_cast[k]) == pytest.approx(float(m_f32[k]), rel=1e-5, abs=1e-6), k
+
+
+def test_float32_policy_staging_not_cast():
+    mem.reset("stage_f32")
+    broker = connect("mem://stage_f32")
+    cfg = LearnerConfig(
+        batch_size=1,
+        seq_len=8,
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="float32"),
+    )
+    broker.publish_experience(serialize_rollout(make_rollout(L=8, H=8, seed=0)))
+    buf = StagingBuffer(cfg, connect("mem://stage_f32"), version_fn=lambda: 0).start()
+    try:
+        batch = buf.get_batch(timeout=30.0)
+    finally:
+        buf.stop()
+    assert batch.obs.unit_feats.dtype == np.float32
