@@ -93,8 +93,13 @@ class LearnerConfig:
     # Stage obs floats in the policy compute dtype (bf16) on the host:
     # numerically identical (the policy's first op is the same cast) and
     # halves the dominant host→device transfer (runtime/staging.py
-    # _cast_obs). Off = ship f32 and cast on device.
+    # cast_obs_to_compute_dtype). Off = ship f32 and cast on device.
     stage_obs_compute_dtype: bool = True
+    # Move each batch to the device as 4 dtype-grouped buffers instead of
+    # 17 pytree leaves (parallel/fused_io.py): per-transfer overhead
+    # dominated the on-silicon e2e bench. Auto-falls back to the per-leaf
+    # tree path in sequence-parallel mode.
+    fused_h2d: bool = True
     # jax.profiler server port (0 = off); connect with TensorBoard's
     # profile plugin or jax.profiler.trace to capture device traces
     profile_port: int = 0

@@ -1,0 +1,123 @@
+"""Fused host→device batch transfer: 17 pytree leaves → 4 buffers.
+
+On-silicon motivation (BENCH_TPU_20260730T0510.json + isolated transfer
+measurements on the tunneled v5 lite): the e2e bottleneck is the batch
+device_put, and the cost is dominated by PER-TRANSFER overhead, not
+bytes — the same 5.65 MB moves in 4.4 ms as one array but 8.3 ms as the
+TrainBatch's 17 leaves (~0.28 ms per leaf of tunnel RPC latency). The
+TPU mandate is "minimize host↔device transfers"; this module makes the
+transfer count 4 (one per dtype: f32 / bf16 / int32 / bool-as-uint8)
+regardless of how many leaves the batch grows.
+
+Mechanics:
+- Every TrainBatch leaf is batch-leading, so each flattens to
+  [B, cols] and a dtype group concatenates along axis 1 into one
+  [B, group_cols] buffer. That keeps the leading axis intact, so the
+  group buffers shard over dp EXACTLY like the tree did — this is not a
+  dp=1 special case.
+- Packing (host, one memcpy per leaf) runs on the learner's fetch path,
+  which already overlaps the in-flight device step; unpacking (slice +
+  reshape per leaf) runs INSIDE the jit train step, where XLA fuses it
+  into the first consumers for free.
+- Sequence-parallel mode is the one exclusion: sp shards the obs TIME
+  axis, which column-flattening would destroy. The learner falls back
+  to the per-leaf tree path when sp is active (parallel/train_step.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Stable group keys. Bool packs as uint8 (XLA preds are byte-wide on the
+# wire anyway); everything else transfers in its native dtype.
+_GROUP_OF = {
+    np.dtype(np.float32): "f32",
+    np.dtype(np.int32): "i32",
+    np.dtype(np.bool_): "u8",
+    np.dtype(np.uint8): "u8",
+}
+
+
+def _group_key(dtype) -> str:
+    dtype = np.dtype(dtype)
+    if dtype in _GROUP_OF:
+        return _GROUP_OF[dtype]
+    # ml_dtypes.bfloat16 has no stable np.dtype singleton; match by name.
+    if dtype.name == "bfloat16":
+        return "bf16"
+    raise TypeError(f"fused_io: unsupported batch leaf dtype {dtype}")
+
+
+_GROUP_DTYPES = {"f32": np.float32, "i32": np.int32, "u8": np.uint8, "bf16": "bfloat16"}
+
+
+class _LeafSlot(NamedTuple):
+    index: int  # position in the flattened batch
+    shape: Tuple[int, ...]  # full leaf shape (incl. batch dim)
+    dtype: Any  # ORIGINAL dtype (bool restored on unpack)
+    start: int  # column offset inside the group buffer
+    cols: int
+
+
+class FusedBatchIO:
+    """Pack/unpack between a TrainBatch pytree and dtype-grouped
+    [B, cols] buffers. Built once per (config, mesh) from a template
+    batch; the layout is static, so the jit unpack is pure slicing."""
+
+    def __init__(self, template, mesh: Mesh):
+        leaves, self.treedef = jax.tree.flatten(template)
+        B = leaves[0].shape[0]
+        if any(leaf.shape[0] != B for leaf in leaves):
+            raise ValueError("fused_io: every batch leaf must be batch-leading")
+        self.batch = B
+        self.slots: Dict[str, List[_LeafSlot]] = {}
+        cols: Dict[str, int] = {}
+        for i, leaf in enumerate(leaves):
+            key = _group_key(leaf.dtype)
+            n = int(np.prod(leaf.shape[1:], dtype=np.int64)) if leaf.ndim > 1 else 1
+            self.slots.setdefault(key, []).append(
+                _LeafSlot(i, tuple(leaf.shape), leaf.dtype, cols.get(key, 0), n)
+            )
+            cols[key] = cols.get(key, 0) + n
+        self.group_cols = cols
+        dp = "dp" if "dp" in mesh.axis_names else None
+        self.shardings = {k: NamedSharding(mesh, P(dp, None)) for k in cols}
+
+    # ----------------------------------------------------------- host side
+
+    def pack(self, batch) -> Dict[str, np.ndarray]:
+        """TrainBatch (numpy leaves) → {group: [B, cols] contiguous}.
+        One memcpy per leaf; runs on the learner fetch path, overlapped
+        with the in-flight device step."""
+        leaves = jax.tree.leaves(batch)
+        out = {}
+        for key, slots in self.slots.items():
+            buf = np.empty((self.batch, self.group_cols[key]), dtype=_GROUP_DTYPES[key])
+            for s in slots:
+                leaf = np.asarray(leaves[s.index])
+                buf[:, s.start : s.start + s.cols] = leaf.reshape(self.batch, -1).astype(
+                    buf.dtype, copy=False
+                )
+            out[key] = buf
+        return out
+
+    # --------------------------------------------------------- device side
+
+    def unpack(self, groups: Dict[str, jnp.ndarray]):
+        """{group: [B, cols]} → TrainBatch, inside jit. Slices + reshapes
+        only — XLA fuses them into the first consumers."""
+        leaves: List[Any] = [None] * sum(len(s) for s in self.slots.values())
+        for key, slots in self.slots.items():
+            buf = groups[key]
+            for s in slots:
+                x = jax.lax.slice_in_dim(buf, s.start, s.start + s.cols, axis=1)
+                x = x.reshape(s.shape)
+                if np.dtype(s.dtype) == np.bool_:
+                    x = x != 0
+                leaves[s.index] = x
+        return jax.tree.unflatten(self.treedef, leaves)

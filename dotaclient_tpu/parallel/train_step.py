@@ -48,17 +48,25 @@ def init_train_state(cfg: LearnerConfig, rng: jax.Array) -> TrainState:
     return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
 
 
-def build_train_step(cfg: LearnerConfig, mesh):
-    """Returns (train_step, state_shardings, batch_shardings).
+def is_sequence_parallel(cfg: LearnerConfig, mesh) -> bool:
+    """THE definition of 'sp is active' — owned here, used by both
+    train-step builders and by the Learner's fused-vs-tree choice, so
+    the predicate cannot fork. Raises on a tf_sp_axis that names no
+    mesh axis (silent disablement would masquerade as a perf bug)."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sp = cfg.policy.tf_sp_axis
+    if sp and sp not in axis_sizes:
+        raise ValueError(
+            f"tf_sp_axis={sp!r} names no axis of mesh {dict(axis_sizes)!r} — "
+            f"sequence parallelism would be silently disabled; add the axis "
+            f"to --mesh_shape or clear tf_sp_axis"
+        )
+    return cfg.policy.arch == "transformer" and bool(sp)
 
-    `train_step(state, batch) -> (state', metrics)` is jit-compiled with
-    explicit in/out shardings over `mesh`. `batch_shardings` is a
-    TrainBatch-shaped PYTREE of NamedShardings — callers must device_put
-    host batches with it verbatim (`jax.device_put(batch, batch_shardings)`):
-    in sequence-parallel mode the obs leaves shard over (dp, sp) while
-    the [B, T] scalars stay dp-only, so a single flat sharding would
-    disagree with the jit's in_shardings and fail at dispatch.
-    """
+
+def _build_core(cfg: LearnerConfig, mesh):
+    """Shared guts of the two train-step builders: validated config,
+    the un-jitted step_fn, and the state shardings."""
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     dp = axis_sizes.get("dp", 1)
     if cfg.batch_size % max(dp, 1):
@@ -71,13 +79,7 @@ def build_train_step(cfg: LearnerConfig, mesh):
     # unroll. The unrolled chunk is seq_len+1 frames (bootstrap frame
     # included), so THAT count must divide by the axis.
     sp = cfg.policy.tf_sp_axis
-    if sp and sp not in axis_sizes:
-        raise ValueError(
-            f"tf_sp_axis={sp!r} names no axis of mesh {dict(axis_sizes)!r} — "
-            f"sequence parallelism would be silently disabled; add the axis "
-            f"to --mesh_shape or clear tf_sp_axis"
-        )
-    use_sp = cfg.policy.arch == "transformer" and bool(sp)
+    use_sp = is_sequence_parallel(cfg, mesh)
     if use_sp and (cfg.seq_len + 1) % axis_sizes[sp]:
         raise ValueError(
             f"sequence parallelism: seq_len+1={cfg.seq_len + 1} frames must "
@@ -102,6 +104,21 @@ def build_train_step(cfg: LearnerConfig, mesh):
         opt_state=mesh_lib.param_shardings(mesh, state_template.opt_state),
         step=mesh_lib.replicated(mesh),
     )
+    return step_fn, state_shardings, use_sp, sp
+
+
+def build_train_step(cfg: LearnerConfig, mesh):
+    """Returns (train_step, state_shardings, batch_shardings).
+
+    `train_step(state, batch) -> (state', metrics)` is jit-compiled with
+    explicit in/out shardings over `mesh`. `batch_shardings` is a
+    TrainBatch-shaped PYTREE of NamedShardings — callers must device_put
+    host batches with it verbatim (`jax.device_put(batch, batch_shardings)`):
+    in sequence-parallel mode the obs leaves shard over (dp, sp) while
+    the [B, T] scalars stay dp-only, so a single flat sharding would
+    disagree with the jit's in_shardings and fail at dispatch.
+    """
+    step_fn, state_shardings, use_sp, sp = _build_core(cfg, mesh)
     batch_sh = mesh_lib.batch_sharding(mesh)
     batch_shardings = jax.tree.map(lambda _: batch_sh, _batch_template(cfg))
     if use_sp:
@@ -126,6 +143,47 @@ def build_train_step(cfg: LearnerConfig, mesh):
         donate_argnums=(0,),
     )
     return train_step, state_shardings, batch_shardings
+
+
+def build_fused_train_step(cfg: LearnerConfig, mesh):
+    """Returns (fused_step, state_shardings, io: FusedBatchIO).
+
+    Same compiled math as build_train_step, but the batch crosses the
+    host→device boundary as FOUR dtype-grouped [B, cols] buffers instead
+    of 17 pytree leaves — the per-transfer overhead of the tunneled chip
+    dominated the e2e bench (parallel/fused_io.py). Callers move a host
+    TrainBatch with `jax.device_put(io.pack(batch), io.shardings)` and
+    call `fused_step(state, groups)`; the unpack runs inside the jit and
+    fuses into the first consumers. Refused in sequence-parallel mode
+    (column-flattening would destroy the sp time-axis sharding) — use
+    the tree path there.
+    """
+    step_fn, state_shardings, use_sp, _ = _build_core(cfg, mesh)
+    if use_sp:
+        raise ValueError(
+            "fused H2D transfer is incompatible with sequence parallelism "
+            "(tf_sp_axis set); use build_train_step"
+        )
+    from dotaclient_tpu.parallel.fused_io import FusedBatchIO
+    from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
+
+    import numpy as np
+
+    # Template must match what staging actually emits — obs already in
+    # the compute dtype when stage_obs_compute_dtype is on.
+    template = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, _batch_template(cfg)))
+    io = FusedBatchIO(template, mesh)
+
+    def fused_fn(state: TrainState, groups):
+        return step_fn(state, io.unpack(groups))
+
+    fused_step = jax.jit(
+        fused_fn,
+        in_shardings=(state_shardings, io.shardings),
+        out_shardings=(state_shardings, mesh_lib.replicated(mesh)),
+        donate_argnums=(0,),
+    )
+    return fused_step, state_shardings, io
 
 
 def _batch_template(cfg: LearnerConfig):

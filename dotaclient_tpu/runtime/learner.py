@@ -132,7 +132,22 @@ class Learner:
         self.cfg = cfg
         self.broker = broker
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg.mesh_shape)
-        self.train_step, self.state_shardings, self.batch_sharding = build_train_step(cfg, self.mesh)
+        # Fused 4-buffer H2D path when enabled and not sequence-parallel
+        # (fused_io.py); per-leaf tree path otherwise. Same compiled math.
+        self.fused_io = None
+        from dotaclient_tpu.parallel.train_step import is_sequence_parallel
+
+        if cfg.fused_h2d and not is_sequence_parallel(cfg, self.mesh):
+            from dotaclient_tpu.parallel.train_step import build_fused_train_step
+
+            self.train_step, self.state_shardings, self.fused_io = build_fused_train_step(
+                cfg, self.mesh
+            )
+            self.batch_sharding = None
+        else:
+            self.train_step, self.state_shardings, self.batch_sharding = build_train_step(
+                cfg, self.mesh
+            )
         self.version = 0
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         self.state: TrainState = jax.device_put(state, self.state_shardings)
@@ -181,7 +196,10 @@ class Learner:
         if batch is None:
             return None, 0, t1 - t0, 0.0
         env_steps = int(np.sum(batch.mask))
-        batch_dev = jax.device_put(batch, self.batch_sharding)
+        if self.fused_io is not None:
+            batch_dev = jax.device_put(self.fused_io.pack(batch), self.fused_io.shardings)
+        else:
+            batch_dev = jax.device_put(batch, self.batch_sharding)
         return batch_dev, env_steps, t1 - t0, time.perf_counter() - t1
 
     def run(

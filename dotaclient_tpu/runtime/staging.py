@@ -212,9 +212,6 @@ class StagingBuffer:
             )
         else:
             batch = pack_rollouts(items, self.cfg.seq_len, self.cfg.policy.aux_heads)
-        return self._cast_obs(batch)
-
-    def _cast_obs(self, batch: TrainBatch) -> TrainBatch:
         return cast_obs_to_compute_dtype(self.cfg, batch)
 
     def _parse(self, frame: bytes):

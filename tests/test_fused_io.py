@@ -1,0 +1,114 @@
+"""Fused 4-buffer H2D path: pack/unpack roundtrip, exact metric parity
+with the per-leaf tree path, dp shardability, and the sp exclusion."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.parallel import mesh as mesh_lib
+from dotaclient_tpu.parallel.fused_io import FusedBatchIO
+from dotaclient_tpu.parallel.train_step import (
+    build_fused_train_step,
+    build_train_step,
+    init_train_state,
+    make_train_batch,
+)
+from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
+
+
+def _cfg(aux=False, dtype="float32", **kw):
+    return LearnerConfig(
+        batch_size=8,
+        seq_len=8,
+        policy=PolicyConfig(
+            unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype=dtype, aux_heads=aux
+        ),
+        **kw,
+    )
+
+
+def _host_batch(cfg, seed=0):
+    return cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, make_train_batch(cfg, seed)))
+
+
+class TestRoundtrip:
+    @pytest.mark.parametrize("aux", [False, True])
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_pack_unpack_identity(self, aux, dtype):
+        cfg = _cfg(aux=aux, dtype=dtype)
+        mesh = mesh_lib.make_mesh("dp=-1")
+        batch = _host_batch(cfg)
+        io = FusedBatchIO(batch, mesh)
+        groups = io.pack(batch)
+        # bf16-staged configs ship 4 groups; pure-f32 configs ship 3
+        assert set(groups) == ({"f32", "i32", "u8", "bf16"} if dtype == "bfloat16" else {"f32", "i32", "u8"})
+        out = jax.jit(io.unpack)(groups)
+        in_leaves, in_def = jax.tree.flatten(batch)
+        out_leaves, out_def = jax.tree.flatten(out)
+        assert in_def == out_def
+        for a, b in zip(in_leaves, out_leaves):
+            assert a.shape == b.shape and np.dtype(a.dtype) == np.dtype(b.dtype)
+            np.testing.assert_array_equal(np.asarray(b), a)
+
+    def test_non_batch_leading_leaf_rejected(self):
+        cfg = _cfg()
+        mesh = mesh_lib.make_mesh("dp=-1")
+        batch = _host_batch(cfg)
+        bad = batch._replace(mask=batch.mask[:4])
+        with pytest.raises(ValueError, match="batch-leading"):
+            FusedBatchIO(bad, mesh)
+
+
+class TestFusedTrainStep:
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_metrics_match_tree_path(self, dtype):
+        """The fused step must compute the identical function — same
+        metrics as the per-leaf path on the same batch and init."""
+        cfg = _cfg(aux=True, dtype=dtype)
+        mesh = mesh_lib.make_mesh("dp=2,tp=4")
+        batch = _host_batch(cfg)
+
+        tree_step, state_sh, batch_shardings = build_train_step(cfg, mesh)
+        state = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh)
+        _, m_tree = tree_step(state, jax.device_put(batch, batch_shardings))
+
+        fused_step, state_sh2, io = build_fused_train_step(cfg, mesh)
+        state2 = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh2)
+        _, m_fused = fused_step(state2, jax.device_put(io.pack(batch), io.shardings))
+
+        for k in m_tree:
+            assert float(m_fused[k]) == pytest.approx(float(m_tree[k]), rel=1e-5, abs=1e-7), k
+
+    def test_group_buffers_shard_over_dp(self):
+        cfg = _cfg()
+        mesh = mesh_lib.make_mesh("dp=8")
+        fused_step, _, io = build_fused_train_step(cfg, mesh)
+        groups = jax.device_put(io.pack(_host_batch(cfg)), io.shardings)
+        for k, g in groups.items():
+            assert len(g.sharding.device_set) == 8, k
+            # leading (batch) axis split 8 ways
+            shard_shapes = {s.data.shape for s in g.addressable_shards}
+            assert shard_shapes == {(cfg.batch_size // 8, g.shape[1])}, k
+
+    def test_refused_under_sequence_parallelism(self):
+        cfg = _cfg()
+        cfg.policy.arch = "transformer"
+        cfg.policy.tf_sp_axis = "sp"
+        cfg.seq_len = 7
+        mesh = mesh_lib.make_mesh("dp=2,sp=4")
+        with pytest.raises(ValueError, match="sequence parallelism"):
+            build_fused_train_step(cfg, mesh)
+
+    def test_learner_uses_fused_path_by_default(self):
+        from dotaclient_tpu.runtime.learner import Learner
+        from dotaclient_tpu.transport import memory as mem
+        from dotaclient_tpu.transport.base import connect
+
+        mem.reset("fused_lrn")
+        learner = Learner(_cfg(), connect("mem://fused_lrn"))
+        assert learner.fused_io is not None
+        mem.reset("tree_lrn")
+        learner2 = Learner(_cfg(fused_h2d=False), connect("mem://tree_lrn"))
+        assert learner2.fused_io is None and learner2.batch_sharding is not None
