@@ -155,3 +155,48 @@ def test_checkpoint_resume(tmp_path):
     assert learner2.version == 2
     for a, b in zip(jax.tree.leaves(params_before), jax.tree.leaves(jax.device_get(learner2.state.params))):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_resume_transformer_family(tmp_path):
+    """Orbax round-trips the transformer family's TrainState (different
+    param tree than the LSTM): params and step counter restore exactly."""
+    import jax
+
+    tf_policy = PolicyConfig(
+        arch="transformer",
+        unit_embed_dim=16,
+        lstm_hidden=16,
+        mlp_hidden=16,
+        dtype="float32",
+        tf_layers=2,
+        tf_heads=2,
+        tf_context=5,
+    )
+    lcfg = LearnerConfig(
+        batch_size=8,
+        seq_len=4,
+        policy=tf_policy,
+        mesh_shape="dp=-1",
+        checkpoint_dir=str(tmp_path / "ckpt_tf"),
+        checkpoint_every=2,
+    )
+    mem.reset("ck_tf")
+    learner = Learner(lcfg, broker_connect("mem://ck_tf"))
+    from dotaclient_tpu.transport.serialize import serialize_rollout
+    from tests.test_transport import make_rollout
+
+    broker = broker_connect("mem://ck_tf")
+    for i in range(16):
+        broker.publish_experience(serialize_rollout(make_rollout(L=4, H=16, version=0, seed=i)))
+    learner.run(num_steps=2, batch_timeout=60.0)
+    learner.checkpoint()
+    if learner.checkpointer is not None:
+        learner.checkpointer._mngr.wait_until_finished()
+    params_before = jax.device_get(learner.state.params)
+
+    learner2 = Learner(lcfg, broker_connect("mem://ck_tf"))
+    assert learner2.version == 2
+    for a, b in zip(
+        jax.tree.leaves(params_before), jax.tree.leaves(jax.device_get(learner2.state.params))
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

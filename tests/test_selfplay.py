@@ -183,3 +183,34 @@ def test_5v5_mirror_publishes_per_hero_trajectories(env_addr):
     first_window = rollouts[:10]
     hero_rows = {r.obs.hero_feats[: r.length].tobytes() for r in first_window}
     assert len(hero_rows) == 10
+
+
+def test_mirror_selfplay_with_transformer_family(env_addr):
+    """The batched selfplay step concatenates per-side states along the
+    leading axis — KVCache leaves are batch-leading by contract, so the
+    transformer family must flow through mirror mode unchanged: both
+    sides publish, wire states are zeros, trajectories valid."""
+    tf_policy = PolicyConfig(
+        arch="transformer",
+        unit_embed_dim=16,
+        lstm_hidden=16,
+        mlp_hidden=16,
+        dtype="float32",
+        tf_layers=1,
+        tf_heads=2,
+        tf_context=9,
+    )
+    mem.reset("sp_tf")
+    broker = broker_connect("mem://sp_tf")
+    actor = SelfPlayActor(make_cfg(env_addr, policy=tf_policy), broker, actor_id=0)
+    run_one(actor)
+    frames = broker.consume_experience(1000, timeout=0.2)
+    assert len(frames) == actor.rollouts_published and len(frames) >= 2
+    sides = set()
+    for f in frames:
+        r = deserialize_rollout(f)
+        assert 1 <= r.length <= 8
+        assert not r.initial_state[0].any()  # transformer wire state is zeros
+        sides.add(float(r.obs.global_feats[0, 4]))  # team feature: +1 radiant, -1 dire
+    # mirror publishes BOTH the radiant and dire trajectories
+    assert sides == {1.0, -1.0}
