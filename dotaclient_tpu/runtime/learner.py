@@ -22,10 +22,11 @@ semantics require it —
   current step runs (double buffering; jax async dispatch);
 - metrics are device_get only every `metrics_every` steps (each fetch is
   a full device sync);
-- weight publishes fetch params on the loop thread (required: the jit
-  step donates the state, so params must be read before the next
-  dispatch invalidates them) but serialize+broker-publish runs on a
-  dedicated publisher thread with latest-wins coalescing.
+- weight publishes dispatch ONE on-device flatten (ParamFlattener) and
+  hand the device buffer to a dedicated publisher thread, which pays
+  the blocking single-transfer host read + serialize + broker I/O with
+  latest-wins coalescing. Stream ordering keeps this safe against the
+  train step's state donation (flatten is dispatched first).
 """
 
 from __future__ import annotations
@@ -47,10 +48,57 @@ from dotaclient_tpu.parallel.train_step import (
 )
 from dotaclient_tpu.runtime.metrics import MetricsLogger
 from dotaclient_tpu.runtime.staging import StagingBuffer
+from dotaclient_tpu.transport import serialize as serialize_mod
 from dotaclient_tpu.transport.base import Broker
 from dotaclient_tpu.transport.serialize import flatten_params, serialize_weights
 
 _log = logging.getLogger(__name__)
+
+
+class ParamFlattener:
+    """ONE device→host transfer per weight publish instead of one per
+    param leaf.
+
+    The flagship params tree has ~30 leaves; over the tunneled chip each
+    D2H read pays ~0.28 ms of RPC latency (the same per-transfer
+    overhead parallel/fused_io.py fixed on the H2D side), so a per-leaf
+    device_get costs ~8 ms — ON THE LOOP THREAD, every publish_every
+    steps. Instead a tiny jit concatenates the raveled leaves into one
+    f32 buffer ON DEVICE (async dispatch, ~1 copy of ~1 MB); the
+    blocking host read of that single buffer happens on the publisher
+    thread. Stream ordering makes this donation-safe: the flatten
+    program is dispatched BEFORE the next (state-donating) train step,
+    so it reads the params before donation can reuse them.
+    """
+
+    def __init__(self, params_template):
+        self._slots = []  # (name, shape, start, size) in canonical order
+        off = 0
+        for name, leaf in serialize_mod.named_param_leaves(params_template):
+            n = int(np.prod(leaf.shape, dtype=np.int64)) if leaf.ndim else 1
+            self._slots.append((name, tuple(leaf.shape), off, n))
+            off += n
+
+        def flat_fn(params):
+            import jax.numpy as jnp
+
+            leaves = serialize_mod.named_param_leaves(params)
+            return jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for _, l in leaves])
+
+        self._jit = jax.jit(flat_fn)
+
+    def flatten_on_device(self, params):
+        """Async-dispatched; returns the device buffer immediately."""
+        return self._jit(params)
+
+    def to_named(self, flat_dev) -> list:
+        """Blocking host read + split — publisher-thread side. Output
+        matches transport.serialize.flatten_params exactly."""
+        flat = np.asarray(flat_dev, dtype=np.float32)
+        return [
+            (name, flat[start : start + size].reshape(shape))
+            for name, shape, start, size in self._slots
+        ]
 
 
 class WeightPublisher:
@@ -59,11 +107,18 @@ class WeightPublisher:
     Latest-wins single slot: if the loop submits version v+1 while v is
     still serializing, v is superseded — actors only ever want the
     newest weights (transport/base.py fanout semantics), so coalescing
-    is correct, not lossy. The expensive work (flatten + wire framing +
-    broker I/O) happens here; the loop thread only pays the device_get.
+    is correct, not lossy. The expensive work (host read of the fused
+    param buffer + wire framing + broker I/O) happens here; the loop
+    thread only pays an async jit dispatch.
+
+    `materialize(payload) -> named (name, f32 array) list` converts
+    whatever the loop submitted on THIS thread; the default handles a
+    host params pytree (tests, simple drivers), the Learner passes
+    `ParamFlattener.to_named` with a device buffer payload.
     """
 
-    def __init__(self, broker: Broker):
+    def __init__(self, broker: Broker, materialize=None):
+        self._materialize = materialize if materialize is not None else flatten_params
         self._broker = broker
         self._cond = threading.Condition()
         self._slot = None  # (np_params, version) — latest pending
@@ -109,7 +164,7 @@ class WeightPublisher:
                 np_params, version = self._slot
                 self._slot = None
             try:
-                frame = serialize_weights(flatten_params(np_params), version=version)
+                frame = serialize_weights(self._materialize(np_params), version=version)
                 self._broker.publish_weights(frame)
                 self.published += 1
             except Exception:
@@ -152,7 +207,8 @@ class Learner:
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         self.state: TrainState = jax.device_put(state, self.state_shardings)
         self.staging = StagingBuffer(cfg, broker, version_fn=lambda: self.version)
-        self.publisher = WeightPublisher(broker)
+        self.flattener = ParamFlattener(state.params)
+        self.publisher = WeightPublisher(broker, materialize=self.flattener.to_named)
         self.metrics = MetricsLogger(cfg.log_dir)
         self.env_steps_done = 0  # total real (unmasked) env steps trained on
         if cfg.profile_port:
@@ -278,12 +334,14 @@ class Learner:
                     next_batch, next_env_steps = None, 0
 
                 if self.version % cfg.publish_every == 0:
-                    # device_get must precede the next dispatch: the jit
-                    # step donates the state, so these params die the
-                    # moment step v+1 is enqueued. The get blocks only
-                    # until step v completes; serialize+publish happens
-                    # on the publisher thread.
-                    self.publisher.submit(jax.device_get(self.state.params), self.version)
+                    # One async on-device flatten dispatch; the blocking
+                    # host read of the single buffer happens on the
+                    # publisher thread. Donation-safe because this
+                    # dispatch precedes the next (state-donating) train
+                    # step in stream order (ParamFlattener docstring).
+                    self.publisher.submit(
+                        self.flattener.flatten_on_device(self.state.params), self.version
+                    )
                 if self.checkpointer is not None and self.version % cfg.checkpoint_every == 0:
                     self.checkpoint()
 

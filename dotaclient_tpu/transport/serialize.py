@@ -28,7 +28,7 @@ Weight frame layout:
 from __future__ import annotations
 
 import struct
-from typing import List, NamedTuple, Optional, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -216,16 +216,24 @@ def deserialize_weights(data: bytes) -> Tuple[List[Tuple[str, np.ndarray]], int]
     return out, version
 
 
-def flatten_params(params) -> List[Tuple[str, np.ndarray]]:
-    """Flax params pytree → sorted (path, f32 array) list."""
+def named_param_leaves(params) -> List[Tuple[str, Any]]:
+    """(path-name, leaf) pairs in the CANONICAL sorted order every
+    params consumer shares (wire format, checkpoint diffing, and the
+    learner's fused single-buffer publish layout). Leaves are returned
+    as-is — works on concrete arrays and on tracers inside jit."""
     import jax
 
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     out = []
     for path, leaf in flat:
         name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        out.append((name, np.asarray(leaf, np.float32)))
-    return sorted(out)
+        out.append((name, leaf))
+    return sorted(out, key=lambda kv: kv[0])
+
+
+def flatten_params(params) -> List[Tuple[str, np.ndarray]]:
+    """Flax params pytree → sorted (path, f32 array) list."""
+    return [(name, np.asarray(leaf, np.float32)) for name, leaf in named_param_leaves(params)]
 
 
 def unflatten_params(named_arrays, template):

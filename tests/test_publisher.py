@@ -97,3 +97,73 @@ def test_restartable_after_stop():
     pub.submit(_params(2.0), version=2)
     pub.stop()
     assert [deserialize_weights(f)[1] for f in broker.frames] == [1, 2]
+
+
+def test_param_flattener_matches_flatten_params():
+    """The fused single-buffer publish layout must reproduce
+    flatten_params' canonical named list exactly — the wire consumers
+    (actor hot-swap, league snapshots) see identical frames."""
+    import jax
+
+    from dotaclient_tpu.config import PolicyConfig
+    from dotaclient_tpu.models.policy import init_params
+    from dotaclient_tpu.runtime.learner import ParamFlattener
+    from dotaclient_tpu.transport.serialize import flatten_params
+
+    for arch in ("lstm", "transformer"):
+        cfg = PolicyConfig(
+            arch=arch,
+            unit_embed_dim=16,
+            lstm_hidden=16,
+            mlp_hidden=16,
+            dtype="float32",
+            tf_layers=1,
+            tf_heads=2,
+            tf_context=4,
+        )
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        fl = ParamFlattener(params)
+        got = fl.to_named(fl.flatten_on_device(params))
+        want = flatten_params(jax.device_get(params))
+        assert [n for n, _ in got] == [n for n, _ in want]
+        for (n, a), (_, b) in zip(got, want):
+            assert a.shape == b.shape, n
+            np.testing.assert_array_equal(a, b, err_msg=n)
+
+
+def test_learner_publishes_correct_weights_via_fused_path():
+    """End of a short run: the newest broadcast frame deserializes to the
+    learner's CURRENT params (async flatten + publisher-thread read did
+    not tear or reorder)."""
+    import jax
+
+    from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+    from dotaclient_tpu.runtime.learner import Learner
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+    from dotaclient_tpu.transport.serialize import flatten_params, serialize_rollout
+    from tests.test_transport import make_rollout
+
+    mem.reset("fpub")
+
+    broker = connect("mem://fpub")
+    for i in range(16):
+        broker.publish_experience(serialize_rollout(make_rollout(L=4, H=16, version=0, seed=i)))
+    cfg = LearnerConfig(
+        batch_size=8,
+        seq_len=4,
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"),
+        publish_every=1,
+    )
+    learner = Learner(cfg, connect("mem://fpub"))
+    sub = connect("mem://fpub")
+    learner.run(num_steps=2, batch_timeout=60.0)
+    frame = sub.poll_weights()
+    assert frame is not None
+    named, version = deserialize_weights(frame)
+    assert version == learner.version == 2
+    want = dict(flatten_params(jax.device_get(learner.state.params)))
+    got = dict(named)
+    assert set(got) == set(want)
+    for n in want:
+        np.testing.assert_array_equal(got[n], want[n], err_msg=n)
