@@ -39,6 +39,12 @@ class PolicyConfig:
     # (ops/ring_attention.py) inside the unroll; requires the unrolled
     # frame count (seq_len+1) to divide by the axis size.
     tf_sp_axis: str = ""
+    # Rematerialize transformer blocks in the learner unroll
+    # (jax.checkpoint): activations are recomputed in the backward
+    # instead of stored, trading ~1/3 more FLOPs for O(L) less
+    # activation memory — the standard long-context lever. No effect on
+    # actor stepping (no backward) or on the math (tested identical).
+    tf_remat: bool = False
     n_move_bins: int = 9  # 9-way discretized move offsets per axis
     move_step: float = 350.0  # map units per outermost move-grid cell
     # Auxiliary value heads (benchmark config 5: win-prob, last-hit, net-worth).

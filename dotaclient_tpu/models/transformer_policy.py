@@ -158,8 +158,12 @@ class TransformerCore(nn.Module):
             B, T = x.shape[0], x.shape[1]
             positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
             h = x.astype(jnp.float32)
+            # cfg.tf_remat: recompute each block's activations in the
+            # backward instead of storing them (jax.checkpoint) —
+            # O(T·D) residuals per block instead of every intermediate.
+            block_cls = nn.remat(Block, static_argnums=()) if cfg.tf_remat else Block
             for i in range(L):
-                h, _ = Block(D, N, dt, self.sp_mesh, cfg.tf_sp_axis, name=f"block{i}")(
+                h, _ = block_cls(D, N, dt, self.sp_mesh, cfg.tf_sp_axis, name=f"block{i}")(
                     h, positions
                 )
             return carry, h

@@ -258,3 +258,24 @@ class TestActorIntegration:
                 assert not r.initial_state[0].any()  # transformer wire state is zeros
         finally:
             server.stop(0)
+
+
+class TestRemat:
+    def test_remat_identical_loss_and_grads(self):
+        """tf_remat must change memory behavior only: loss and gradients
+        bit-compare against the stored-activation path."""
+        cfg_a = _tf_learner_cfg("dp=8", "")
+        cfg_b = _tf_learner_cfg("dp=8", "")
+        cfg_b.policy.tf_remat = True
+        m_a = _run_one_step(cfg_a)
+        m_b = _run_one_step(cfg_b)
+        for k in m_a:
+            assert m_b[k] == pytest.approx(m_a[k], rel=1e-6, abs=1e-8), k
+
+    def test_remat_composes_with_sequence_parallelism(self):
+        cfg = _tf_learner_cfg("dp=2,sp=4", "sp")
+        cfg.policy.tf_remat = True
+        m = _run_one_step(cfg)
+        ref = _run_one_step(_tf_learner_cfg("dp=8", ""))
+        for k in ref:
+            assert m[k] == pytest.approx(ref[k], rel=1e-4, abs=1e-5), k
