@@ -243,9 +243,17 @@ def main() -> None:
 
     # ---- end-to-end rate: producers → broker → staging → device, with
     # the learner's round-3 overlap (prefetch + device_put of batch N+1
-    # while step N runs; no per-iteration device sync)
+    # while step N runs; no per-iteration device sync), INCLUDING the
+    # per-step weight publish exactly as Learner.run does it at the
+    # default publish_every=1 (one async on-device flatten dispatch on
+    # this thread; single-buffer host read + serialize on the publisher
+    # thread) — the headline covers the full production loop.
+    from dotaclient_tpu.runtime.learner import ParamFlattener, WeightPublisher
+
     stop = _start_producers(cfg, "bench")
     staging = StagingBuffer(cfg, connect("mem://bench"), version_fn=lambda: 0).start()
+    flattener = ParamFlattener(jax.device_get(state.params))
+    publisher = WeightPublisher(connect("mem://bench"), materialize=flattener.to_named).start()
 
     def fetch():
         # pack (host memcpy) charges the wait bucket; device_put_s stays
@@ -260,20 +268,23 @@ def main() -> None:
     warm, _, _, _ = fetch()
     state, metrics = train_step(state, warm)
     jax.block_until_ready(metrics["loss"])
+    jax.block_until_ready(flattener.flatten_on_device(state.params))  # compile outside the window
     n_iters = 12
     env_steps = 0
     t_wait = t_put = 0.0
     nxt, nxt_steps, w, p = fetch()
     t0 = time.perf_counter()
-    for _ in range(n_iters):
+    for i in range(n_iters):
         dev, env_n = nxt, nxt_steps
         state, metrics = train_step(state, dev)  # async dispatch
+        publisher.submit(flattener.flatten_on_device(state.params), i + 1)
         env_steps += env_n
         nxt, nxt_steps, w, p = fetch()  # overlaps the in-flight step
         t_wait += w
         t_put += p
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
+    publisher.stop()  # outside the timed window: drain is teardown, not loop cost
     stop.set()
     staging.stop()
 

@@ -161,7 +161,7 @@ class TransformerCore(nn.Module):
             # cfg.tf_remat: recompute each block's activations in the
             # backward instead of storing them (jax.checkpoint) —
             # O(T·D) residuals per block instead of every intermediate.
-            block_cls = nn.remat(Block, static_argnums=()) if cfg.tf_remat else Block
+            block_cls = nn.remat(Block) if cfg.tf_remat else Block
             for i in range(L):
                 h, _ = block_cls(D, N, dt, self.sp_mesh, cfg.tf_sp_axis, name=f"block{i}")(
                     h, positions
