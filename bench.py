@@ -252,7 +252,7 @@ def main() -> None:
 
     stop = _start_producers(cfg, "bench")
     staging = StagingBuffer(cfg, connect("mem://bench"), version_fn=lambda: 0).start()
-    flattener = ParamFlattener(jax.device_get(state.params))
+    flattener = ParamFlattener(state.params)
     publisher = WeightPublisher(connect("mem://bench"), materialize=flattener.to_named).start()
 
     def fetch():

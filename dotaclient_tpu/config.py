@@ -39,6 +39,11 @@ class PolicyConfig:
     # (ops/ring_attention.py) inside the unroll; requires the unrolled
     # frame count (seq_len+1) to divide by the axis size.
     tf_sp_axis: str = ""
+    # Collective pattern for sequence-parallel attention: "ring"
+    # (ppermute K/V streaming, any topology, no head constraint) or
+    # "ulysses" (all-to-all head re-sharding; needs tf_heads divisible
+    # by the sp axis). Same math either way — ops/ring_attention.py.
+    tf_sp_mode: str = "ring"
     # Rematerialize transformer blocks in the learner unroll
     # (jax.checkpoint): activations are recomputed in the backward
     # instead of stored, trading ~1/3 more FLOPs for O(L) less

@@ -80,6 +80,7 @@ class Block(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     sp_mesh: Optional[Mesh] = None
     sp_axis: str = ""
+    sp_mode: str = "ring"
 
     @nn.compact
     def __call__(
@@ -111,7 +112,10 @@ class Block(nn.Module):
 
         new_cache = None
         if cache is None:
-            attn = RA.attend(q, k, v, positions, positions, mesh=self.sp_mesh, sp_axis=self.sp_axis)
+            attn = RA.attend(
+                q, k, v, positions, positions,
+                mesh=self.sp_mesh, sp_axis=self.sp_axis, sp_mode=self.sp_mode,
+            )
         else:
             k_cache, v_cache, cache_pos, onehot = cache
             w = onehot[:, :, None, None].astype(jnp.float32)  # [B, C, 1, 1]
@@ -163,9 +167,9 @@ class TransformerCore(nn.Module):
             # O(T·D) residuals per block instead of every intermediate.
             block_cls = nn.remat(Block) if cfg.tf_remat else Block
             for i in range(L):
-                h, _ = block_cls(D, N, dt, self.sp_mesh, cfg.tf_sp_axis, name=f"block{i}")(
-                    h, positions
-                )
+                h, _ = block_cls(
+                    D, N, dt, self.sp_mesh, cfg.tf_sp_axis, cfg.tf_sp_mode, name=f"block{i}"
+                )(h, positions)
             return carry, h
 
         assert isinstance(carry, KVCache), "transformer step mode needs a KVCache carry"

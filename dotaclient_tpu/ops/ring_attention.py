@@ -1,5 +1,7 @@
-"""Ring attention: causal attention with the TIME axis sharded over a
-mesh axis — the sequence-parallel scale path.
+"""Sequence-parallel causal attention: the TIME axis sharded over a
+mesh axis, in both canonical collective patterns — the ppermute RING
+(default) and the all-to-all ULYSSES variant (`ulysses_causal_attention`
+below; trade-offs in its docstring). `attend` dispatches.
 
 The reference never needed this (LSTM, chunk length ~16 — SURVEY.md §5
 "Long-context / sequence parallelism"); it exists for the transformer
@@ -43,6 +45,26 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dotaclient_tpu.ops import attention as A
 
 
+def _sp_shard_map(body, mesh: Mesh, axis_name: str, q):
+    """Shared shard_map plumbing for both SP patterns: time-divisibility
+    check, dp-aware specs, vma-check opt-out (the streaming carries and
+    collective re-shards are manual by design; correctness is pinned by
+    the single-device parity tests)."""
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    if q.shape[1] % n:
+        raise ValueError(f"time axis {q.shape[1]} not divisible by {axis_name}={n}")
+    b_ax = "dp" if "dp" in mesh.axis_names else None
+    seq = P(b_ax, axis_name, None, None)
+    pos = P(b_ax, axis_name)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, pos, pos),
+        out_specs=seq,
+        check_vma=False,
+    ), n
+
+
 def _ring_body(q, k, v, q_pos, k_pos, *, axis_name: str, n: int):
     """Runs inside shard_map: all arrays are the local shards."""
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -79,30 +101,64 @@ def ring_causal_attention(
     Composable under an outer jit: shard_map with an explicit mesh
     inlines into the surrounding SPMD program.
     """
-    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
-    if q.shape[1] % n:
-        raise ValueError(f"time axis {q.shape[1]} not divisible by {axis_name}={n}")
-    body = functools.partial(_ring_body, axis_name=axis_name, n=n)
     # The batch axis rides dp when the mesh has one (learner meshes are
     # dp×sp): the body is elementwise over batch, so dp needs no
     # collectives — but omitting it from the specs would declare the
     # inputs dp-replicated and force an all-gather of the dp shards.
-    b_ax = "dp" if "dp" in mesh.axis_names else None
-    seq = P(b_ax, axis_name, None, None)
-    pos = P(b_ax, axis_name)
-    return shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(seq, seq, seq, pos, pos),
-        out_specs=seq,
-        # The streaming-softmax scan carry is initialized unvarying
-        # (zeros/-inf) and becomes device-varying after the first
-        # accumulate — exactly the pattern the varying-manual-axes
-        # checker rejects without pcast annotations on every carry leaf.
-        # The body is correct by the ring-equivalence tests; skip the
-        # static check rather than scatter pcasts through the math.
-        check_vma=False,
-    )(q, k, v, q_pos, k_pos)
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    mapped, _ = _sp_shard_map(
+        functools.partial(_ring_body, axis_name=axis_name, n=n), mesh, axis_name, q
+    )
+    return mapped(q, k, v, q_pos, k_pos)
+
+
+def _ulysses_body(q, k, v, q_pos, k_pos, *, axis_name: str):
+    """Runs inside shard_map: time-sharded inputs → head-sharded
+    attention → time-sharded output, via two all_to_alls."""
+    # [B, T/n, N, Dh] → [B, T, N/n, Dh]: every device trades its time
+    # shard of (N/n) head groups for the full time axis of one group.
+    a2a = lambda x: jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    q_pos_full = jax.lax.all_gather(q_pos, axis_name, axis=1, tiled=True)  # [B, T]
+    k_pos_full = jax.lax.all_gather(k_pos, axis_name, axis=1, tiled=True)
+    out = A.causal_attention(qg, kg, vg, q_pos_full, k_pos_full)
+    # [B, T, N/n, Dh] → [B, T/n, N, Dh]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    k_pos: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """All-to-all (Ulysses-style) sequence parallelism: the dual of the
+    ring. Instead of streaming K/V blocks past stationary queries, two
+    `all_to_all` collectives re-shard the tensors from time-sharded to
+    HEAD-sharded, each device runs ordinary full-context attention for
+    its head group, and a second all_to_all restores time sharding.
+
+    Trade-offs vs the ring (both ship; pick per topology via
+    PolicyConfig.tf_sp_mode): Ulysses moves each tensor twice in two
+    bursts (good when all-to-all bandwidth is plentiful, e.g. a single
+    ICI pod slice) and needs tf_heads % axis_size == 0; the ring moves
+    K/V n times point-to-point to nearest neighbours (rides any ring
+    topology, no head-count constraint) and never materializes the full
+    time axis on a device. Same function computed either way — both are
+    tested for exact parity against single-device attention.
+    """
+    mapped, n = _sp_shard_map(
+        functools.partial(_ulysses_body, axis_name=axis_name), mesh, axis_name, q
+    )
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses: heads {q.shape[2]} not divisible by {axis_name}={n} "
+            f"(use tf_sp_mode='ring', which has no head constraint)"
+        )
+    return mapped(q, k, v, q_pos, k_pos)
 
 
 def attend(
@@ -113,10 +169,17 @@ def attend(
     k_pos: jnp.ndarray,
     mesh: Optional[Mesh] = None,
     sp_axis: str = "",
+    sp_mode: str = "ring",
 ) -> jnp.ndarray:
-    """Dispatch: ring attention when a mesh with an `sp` axis is supplied
-    (learner long-context mode), plain single-block attention otherwise
-    (actor stepping, short chunks, tests)."""
+    """Dispatch: sequence-parallel attention when a mesh with an `sp`
+    axis is supplied (learner long-context mode) — `sp_mode` picks the
+    collective pattern ("ring" ppermute streaming | "ulysses"
+    all-to-all head re-sharding) — plain single-block attention
+    otherwise (actor stepping, short chunks, tests)."""
     if mesh is not None and sp_axis and sp_axis in mesh.axis_names:
+        if sp_mode == "ulysses":
+            return ulysses_causal_attention(q, k, v, q_pos, k_pos, mesh, sp_axis)
+        if sp_mode != "ring":
+            raise ValueError(f"unknown sp_mode {sp_mode!r} (ring|ulysses)")
         return ring_causal_attention(q, k, v, q_pos, k_pos, mesh, sp_axis)
     return A.causal_attention(q, k, v, q_pos, k_pos)

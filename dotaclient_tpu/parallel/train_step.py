@@ -80,11 +80,21 @@ def _build_core(cfg: LearnerConfig, mesh):
     # included), so THAT count must divide by the axis.
     sp = cfg.policy.tf_sp_axis
     use_sp = is_sequence_parallel(cfg, mesh)
-    if use_sp and (cfg.seq_len + 1) % axis_sizes[sp]:
-        raise ValueError(
-            f"sequence parallelism: seq_len+1={cfg.seq_len + 1} frames must "
-            f"divide by mesh axis {sp}={axis_sizes[sp]} (pick seq_len = k*{axis_sizes[sp]}-1)"
-        )
+    if use_sp:
+        if (cfg.seq_len + 1) % axis_sizes[sp]:
+            raise ValueError(
+                f"sequence parallelism: seq_len+1={cfg.seq_len + 1} frames must "
+                f"divide by mesh axis {sp}={axis_sizes[sp]} (pick seq_len = k*{axis_sizes[sp]}-1)"
+            )
+        # Surface sp_mode misconfigurations at BUILD time like the
+        # divisibility check above, not at first trace mid-run.
+        if cfg.policy.tf_sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown tf_sp_mode {cfg.policy.tf_sp_mode!r} (ring|ulysses)")
+        if cfg.policy.tf_sp_mode == "ulysses" and cfg.policy.tf_heads % axis_sizes[sp]:
+            raise ValueError(
+                f"ulysses: tf_heads={cfg.policy.tf_heads} not divisible by mesh "
+                f"axis {sp}={axis_sizes[sp]} (use tf_sp_mode='ring')"
+            )
     net = PolicyNet(cfg.policy, sp_mesh=mesh if use_sp else None)
     opt = make_optimizer(cfg)
 

@@ -181,3 +181,58 @@ class TestRingAttention:
         via_ring = RA.attend(q, k, v, pos, pos, mesh=sp_mesh, sp_axis="sp")
         via_full = RA.attend(q, k, v, pos, pos)
         np.testing.assert_allclose(via_ring, via_full, rtol=1e-5, atol=1e-6)
+
+
+class TestUlyssesAttention:
+    @pytest.fixture(scope="class")
+    def sp_mesh(self):
+        return mesh_lib.make_mesh("sp=8")
+
+    def test_matches_single_device(self, sp_mesh):
+        B, T, N, Dh = 2, 32, 8, 8  # heads divisible by sp=8
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (40, 41, 42))
+        pos = _positions(B, T)
+        uly = RA.ulysses_causal_attention(q, k, v, pos, pos, sp_mesh)
+        full = A.causal_attention(q, k, v, pos, pos)
+        np.testing.assert_allclose(uly, full, rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_single_device(self, sp_mesh):
+        B, T, N, Dh = 1, 16, 8, 4
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (43, 44, 45))
+        pos = _positions(B, T)
+        cot = jnp.asarray(_rand((B, T, N, Dh), 46))
+
+        g_uly = jax.grad(
+            lambda q, k, v: jnp.sum(RA.ulysses_causal_attention(q, k, v, pos, pos, sp_mesh) * cot),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_full = jax.grad(
+            lambda q, k, v: jnp.sum(A.causal_attention(q, k, v, pos, pos) * cot),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for gu, gf in zip(g_uly, g_full):
+            np.testing.assert_allclose(gu, gf, rtol=1e-4, atol=1e-5)
+
+    def test_matches_ring(self, sp_mesh):
+        """Both SP patterns compute the same function."""
+        B, T, N, Dh = 2, 16, 8, 4
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (47, 48, 49))
+        pos = _positions(B, T)
+        uly = RA.ulysses_causal_attention(q, k, v, pos, pos, sp_mesh)
+        ring = RA.ring_causal_attention(q, k, v, pos, pos, sp_mesh)
+        np.testing.assert_allclose(uly, ring, rtol=1e-5, atol=1e-6)
+
+    def test_rejects_indivisible_heads(self, sp_mesh):
+        q = jnp.zeros((1, 16, 4, 8))  # 4 heads % sp=8 != 0
+        pos = _positions(1, 16)
+        with pytest.raises(ValueError, match="heads"):
+            RA.ulysses_causal_attention(q, q, q, pos, pos, sp_mesh)
+
+    def test_dispatch_mode(self, sp_mesh):
+        B, T, N, Dh = 1, 16, 8, 4
+        q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (50, 51, 52))
+        pos = _positions(B, T)
+        via = RA.attend(q, k, v, pos, pos, mesh=sp_mesh, sp_axis="sp", sp_mode="ulysses")
+        np.testing.assert_allclose(via, A.causal_attention(q, k, v, pos, pos), rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError, match="sp_mode"):
+            RA.attend(q, k, v, pos, pos, mesh=sp_mesh, sp_axis="sp", sp_mode="bogus")

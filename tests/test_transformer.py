@@ -279,3 +279,29 @@ class TestRemat:
         ref = _run_one_step(_tf_learner_cfg("dp=8", ""))
         for k in ref:
             assert m[k] == pytest.approx(ref[k], rel=1e-4, abs=1e-5), k
+
+
+class TestUlyssesTrainStep:
+    def test_ulysses_sp_matches_dp_only(self):
+        """Full PPO step with all-to-all sequence parallelism == local
+        attention (same batch, same init)."""
+        cfg = _tf_learner_cfg("dp=2,sp=4", "sp")
+        cfg.policy.tf_sp_mode = "ulysses"  # tf_heads=2... need divisible by 4
+        cfg.policy.tf_heads = 4
+        cfg.policy.tf_context = 8
+        m_uly = _run_one_step(cfg)
+        ref = _tf_learner_cfg("dp=8", "")
+        ref.policy.tf_heads = 4
+        m_ref = _run_one_step(ref)
+        for k in m_ref:
+            assert m_uly[k] == pytest.approx(m_ref[k], rel=1e-4, abs=1e-5), k
+
+
+def test_ulysses_misconfig_rejected_at_build_time():
+    cfg = _tf_learner_cfg("dp=2,sp=4", "sp")
+    cfg.policy.tf_sp_mode = "ulysses"  # tf_heads=2 % 4 != 0
+    with pytest.raises(ValueError, match="tf_heads"):
+        build_train_step(cfg, mesh_lib.make_mesh(cfg.mesh_shape))
+    cfg.policy.tf_sp_mode = "bogus"
+    with pytest.raises(ValueError, match="tf_sp_mode"):
+        build_train_step(cfg, mesh_lib.make_mesh(cfg.mesh_shape))
