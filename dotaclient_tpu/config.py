@@ -44,6 +44,14 @@ class PolicyConfig:
     # "ulysses" (all-to-all head re-sharding; needs tf_heads divisible
     # by the sp axis). Same math either way — ops/ring_attention.py.
     tf_sp_mode: str = "ring"
+    # Key-block size for the blockwise (flash-formulation) LOCAL
+    # attention in the learner unroll: caps peak intermediates at
+    # [N, T, block] instead of [N, T, T] for long single-device chunks.
+    # 0 = dense. Engages only when the key axis exceeds the block.
+    # Applies to local attention AND to the ulysses SP path (whose
+    # per-head-group attention sees the full time axis); the ring is
+    # blockwise by construction and ignores it.
+    tf_attn_block: int = 0
     # Rematerialize transformer blocks in the learner unroll
     # (jax.checkpoint): activations are recomputed in the backward
     # instead of stored, trading ~1/3 more FLOPs for O(L) less

@@ -81,6 +81,7 @@ class Block(nn.Module):
     sp_mesh: Optional[Mesh] = None
     sp_axis: str = ""
     sp_mode: str = "ring"
+    kv_block: int = 0
 
     @nn.compact
     def __call__(
@@ -115,6 +116,7 @@ class Block(nn.Module):
             attn = RA.attend(
                 q, k, v, positions, positions,
                 mesh=self.sp_mesh, sp_axis=self.sp_axis, sp_mode=self.sp_mode,
+                kv_block=self.kv_block,
             )
         else:
             k_cache, v_cache, cache_pos, onehot = cache
@@ -168,7 +170,8 @@ class TransformerCore(nn.Module):
             block_cls = nn.remat(Block) if cfg.tf_remat else Block
             for i in range(L):
                 h, _ = block_cls(
-                    D, N, dt, self.sp_mesh, cfg.tf_sp_axis, cfg.tf_sp_mode, name=f"block{i}"
+                    D, N, dt, self.sp_mesh, cfg.tf_sp_axis, cfg.tf_sp_mode,
+                    cfg.tf_attn_block, name=f"block{i}"
                 )(h, positions)
             return carry, h
 

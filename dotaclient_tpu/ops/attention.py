@@ -142,3 +142,48 @@ def causal_attention(
     m, l, acc = init_carry(q)
     m, l, acc = accumulate_block(q, k, v, q_pos, k_pos, m, l, acc)
     return finalize_attention(m, l, acc, dtype=q.dtype)
+
+
+def blockwise_causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    k_pos: jnp.ndarray,
+    kv_block: int,
+) -> jnp.ndarray:
+    """Flash-formulation local attention: `lax.scan` of the streaming
+    primitive over key blocks.
+
+    Same function as `causal_attention`, but peak intermediate memory is
+    [.., N, Tq, kv_block] instead of [.., N, Tq, Tk] — the lever for
+    long chunks on ONE device (the sequence-parallel paths in
+    ops/ring_attention.py get the same blockwise behavior from the ring
+    structure itself). A ragged final block is padded with EMPTY_POS
+    keys, which the position masking erases — no special-casing. The
+    compiler-friendly formulation (static shapes, scan) is deliberate:
+    XLA schedules it well on TPU; a hand-written Pallas kernel is the
+    step to take only if a profile shows the fusion falling short
+    (ops/lstm.py precedent: measure on silicon first).
+    """
+    B_lead = k.shape[:-3]
+    Tk, N, Dh = k.shape[-3:]
+    nb = -(-Tk // kv_block)
+    pad = nb * kv_block - Tk
+    if pad:
+        pad_cfg = [(0, 0)] * (len(B_lead)) + [(0, pad), (0, 0), (0, 0)]
+        k = jnp.pad(k, pad_cfg)
+        v = jnp.pad(v, pad_cfg)
+        k_pos = jnp.pad(k_pos, [(0, 0)] * len(B_lead) + [(0, pad)], constant_values=EMPTY_POS)
+    # time-major blocks for the scan: [nb, .., kv_block, N, Dh]
+    kb = jnp.moveaxis(k.reshape(B_lead + (nb, kv_block, N, Dh)), len(B_lead), 0)
+    vb = jnp.moveaxis(v.reshape(B_lead + (nb, kv_block, N, Dh)), len(B_lead), 0)
+    pb = jnp.moveaxis(k_pos.reshape(B_lead + (nb, kv_block)), len(B_lead), 0)
+
+    def step(carry, xs):
+        m, l, acc = carry
+        k_i, v_i, p_i = xs
+        return accumulate_block(q, k_i, v_i, q_pos, p_i, m, l, acc), None
+
+    carry, _ = jax.lax.scan(step, init_carry(q), (kb, vb, pb))
+    return finalize_attention(*carry, dtype=q.dtype)

@@ -45,11 +45,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dotaclient_tpu.ops import attention as A
 
 
-def _sp_shard_map(body, mesh: Mesh, axis_name: str, q):
+def _sp_shard_map(body_factory, mesh: Mesh, axis_name: str, q):
     """Shared shard_map plumbing for both SP patterns: time-divisibility
     check, dp-aware specs, vma-check opt-out (the streaming carries and
     collective re-shards are manual by design; correctness is pinned by
-    the single-device parity tests)."""
+    the single-device parity tests). `body_factory(n)` receives the axis
+    size — the single place it is derived."""
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
     if q.shape[1] % n:
         raise ValueError(f"time axis {q.shape[1]} not divisible by {axis_name}={n}")
@@ -57,7 +58,7 @@ def _sp_shard_map(body, mesh: Mesh, axis_name: str, q):
     seq = P(b_ax, axis_name, None, None)
     pos = P(b_ax, axis_name)
     return shard_map(
-        body,
+        body_factory(n),
         mesh=mesh,
         in_specs=(seq, seq, seq, pos, pos),
         out_specs=seq,
@@ -105,14 +106,13 @@ def ring_causal_attention(
     # dp×sp): the body is elementwise over batch, so dp needs no
     # collectives — but omitting it from the specs would declare the
     # inputs dp-replicated and force an all-gather of the dp shards.
-    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
     mapped, _ = _sp_shard_map(
-        functools.partial(_ring_body, axis_name=axis_name, n=n), mesh, axis_name, q
+        lambda n: functools.partial(_ring_body, axis_name=axis_name, n=n), mesh, axis_name, q
     )
     return mapped(q, k, v, q_pos, k_pos)
 
 
-def _ulysses_body(q, k, v, q_pos, k_pos, *, axis_name: str):
+def _ulysses_body(q, k, v, q_pos, k_pos, *, axis_name: str, kv_block: int):
     """Runs inside shard_map: time-sharded inputs → head-sharded
     attention → time-sharded output, via two all_to_alls."""
     # [B, T/n, N, Dh] → [B, T, N/n, Dh]: every device trades its time
@@ -121,7 +121,14 @@ def _ulysses_body(q, k, v, q_pos, k_pos, *, axis_name: str):
     qg, kg, vg = a2a(q), a2a(k), a2a(v)
     q_pos_full = jax.lax.all_gather(q_pos, axis_name, axis=1, tiled=True)  # [B, T]
     k_pos_full = jax.lax.all_gather(k_pos, axis_name, axis=1, tiled=True)
-    out = A.causal_attention(qg, kg, vg, q_pos_full, k_pos_full)
+    # Unlike the ring (blockwise by construction), the local attention
+    # here sees the FULL time axis — at long T the dense score matrix is
+    # exactly what sequence parallelism exists to avoid, so honor
+    # kv_block and stream over key blocks.
+    if kv_block and kg.shape[1] > kv_block:
+        out = A.blockwise_causal_attention(qg, kg, vg, q_pos_full, k_pos_full, kv_block)
+    else:
+        out = A.causal_attention(qg, kg, vg, q_pos_full, k_pos_full)
     # [B, T, N/n, Dh] → [B, T/n, N, Dh]
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -134,6 +141,7 @@ def ulysses_causal_attention(
     k_pos: jnp.ndarray,
     mesh: Mesh,
     axis_name: str = "sp",
+    kv_block: int = 0,
 ) -> jnp.ndarray:
     """All-to-all (Ulysses-style) sequence parallelism: the dual of the
     ring. Instead of streaming K/V blocks past stationary queries, two
@@ -151,7 +159,10 @@ def ulysses_causal_attention(
     tested for exact parity against single-device attention.
     """
     mapped, n = _sp_shard_map(
-        functools.partial(_ulysses_body, axis_name=axis_name), mesh, axis_name, q
+        lambda n: functools.partial(_ulysses_body, axis_name=axis_name, kv_block=kv_block),
+        mesh,
+        axis_name,
+        q,
     )
     if q.shape[2] % n:
         raise ValueError(
@@ -170,16 +181,21 @@ def attend(
     mesh: Optional[Mesh] = None,
     sp_axis: str = "",
     sp_mode: str = "ring",
+    kv_block: int = 0,
 ) -> jnp.ndarray:
     """Dispatch: sequence-parallel attention when a mesh with an `sp`
     axis is supplied (learner long-context mode) — `sp_mode` picks the
     collective pattern ("ring" ppermute streaming | "ulysses"
-    all-to-all head re-sharding) — plain single-block attention
-    otherwise (actor stepping, short chunks, tests)."""
+    all-to-all head re-sharding). Otherwise local attention: blockwise
+    flash formulation when `kv_block` is set and the key axis exceeds
+    it (long single-device chunks), dense single-block else (actor
+    stepping, short chunks, tests)."""
     if mesh is not None and sp_axis and sp_axis in mesh.axis_names:
         if sp_mode == "ulysses":
-            return ulysses_causal_attention(q, k, v, q_pos, k_pos, mesh, sp_axis)
+            return ulysses_causal_attention(q, k, v, q_pos, k_pos, mesh, sp_axis, kv_block)
         if sp_mode != "ring":
             raise ValueError(f"unknown sp_mode {sp_mode!r} (ring|ulysses)")
         return ring_causal_attention(q, k, v, q_pos, k_pos, mesh, sp_axis)
+    if kv_block and k.shape[-3] > kv_block:
+        return A.blockwise_causal_attention(q, k, v, q_pos, k_pos, kv_block)
     return A.causal_attention(q, k, v, q_pos, k_pos)

@@ -61,10 +61,11 @@ class TestStepUnrollEquivalence:
         _, out_unroll = net.apply(params, P.initial_state(TF_SMALL, (B,)), obs_seq, unroll=True)
 
         state = P.initial_state(TF_SMALL, (B,))
+        step = jax.jit(net.apply)  # one compile, T fast calls
         vals, tlogp, mlogp = [], [], []
         for t in range(T):
             obs_t = jax.tree.map(lambda x: x[:, t], obs_seq)
-            state, out = net.apply(params, state, obs_t)
+            state, out = step(params, state, obs_t)
             vals.append(out.value)
             tlogp.append(out.dist.type_logp)
             mlogp.append(out.dist.move_x_logp)
@@ -146,9 +147,10 @@ class TestStateHelpers:
         C = TF_SMALL.tf_context
         state = P.initial_state(TF_SMALL, (1,))
         r = np.random.RandomState(5)
+        step = jax.jit(net.apply)
         for t in range(C + 3):
             obs_t = jax.tree.map(lambda x: jnp.asarray(x)[:, 0], _obs(r, 1, 1))
-            state, _ = net.apply(params, state, obs_t)
+            state, _ = step(params, state, obs_t)
         pos = np.sort(np.asarray(state.pos[0]))
         # the cache holds exactly the last C absolute positions
         np.testing.assert_array_equal(pos, np.arange(3, C + 3))
@@ -305,3 +307,13 @@ def test_ulysses_misconfig_rejected_at_build_time():
     cfg.policy.tf_sp_mode = "bogus"
     with pytest.raises(ValueError, match="tf_sp_mode"):
         build_train_step(cfg, mesh_lib.make_mesh(cfg.mesh_shape))
+
+
+def test_blockwise_local_attention_train_step_parity():
+    """tf_attn_block changes memory shape only: same metrics as dense."""
+    cfg_blk = _tf_learner_cfg("dp=8", "")
+    cfg_blk.policy.tf_attn_block = 4  # 8 frames -> 2 key blocks
+    m_blk = _run_one_step(cfg_blk)
+    m_dense = _run_one_step(_tf_learner_cfg("dp=8", ""))
+    for k in m_dense:
+        assert m_blk[k] == pytest.approx(m_dense[k], rel=1e-5, abs=1e-7), k
