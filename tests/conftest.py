@@ -22,6 +22,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 # ~60-100s of recompiles a fresh pytest process otherwise pays. Exported
 # via env so subprocess tests (multihost) share it.
 #
+# Observed r3, SAME machine: entries written by processes whose XLA
+# target-feature detection differed (e.g. a TPU-plugin parent that fell
+# back to CPU) load with "machine features don't match ... could SIGILL"
+# warnings in OTHER processes, and such loads have wedged standalone
+# drivers outright. The cache is therefore for pytest processes only —
+# do NOT export JAX_COMPILATION_CACHE_DIR into bench.py or ad-hoc
+# scripts; if a wedge is suspected, delete the dir (it regenerates).
+#
 # The dir is trusted ONLY if we own it with 0700 perms — cache entries
 # are serialized native executables, so a path another user pre-created
 # on a shared machine would hand them code execution. On any doubt,
