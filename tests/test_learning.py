@@ -29,13 +29,14 @@ SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="fl
 N_ACTORS = 3
 
 
-def _run_smoke(broker_name: str, n_updates: int, min_episodes: int, policy=SMALL, seq_len=16):
+def _run_smoke(broker_name: str, n_updates: int, min_episodes: int, policy=SMALL, seq_len=16,
+               mesh_shape="dp=-1"):
     """Closed actor→broker→learner loop for n_updates; returns episode
     returns in completion order across all actors."""
     service = FakeDotaService()  # shared in-process env, per-stub sessions
     mem.reset(broker_name)
     lcfg = LearnerConfig(
-        batch_size=16, seq_len=seq_len, policy=policy, mesh_shape="dp=-1", publish_every=1
+        batch_size=16, seq_len=seq_len, policy=policy, mesh_shape=mesh_shape, publish_every=1
     )
     lcfg.ppo.lr = 1e-3
     lcfg.ppo.entropy_coef = 0.005
@@ -137,3 +138,39 @@ def test_transformer_family_learning_improves_return():
         "learn_smoke_tf", n_updates=60, min_episodes=100, policy=tf_policy, seq_len=15
     )
     _assert_improvement(rets, margin=0.2)
+
+
+@pytest.mark.nightly
+def test_long_chunk_sequence_parallel_learning():
+    """The long-context regime END TO END: 31-step chunks (double the
+    flagship) acted through the KV cache, learned with the time axis
+    sharded dp=2 x sp=4 (ring attention) and blocks rematerialized —
+    the full long-context feature stack in one closed loop, and return
+    must still rise.
+
+    Calibration (this config, r3): 644 episodes, early mean 1.06 std
+    1.32, late mean 2.84 std 0.83, improvement +1.78 (~16 sigma at
+    k=214-episode windows); two earlier runs also passed at the same
+    shape. Margin 0.5 is under a third of the observed improvement and
+    ~5 sigma of window noise at the 300-episode floor."""
+    tf_policy = PolicyConfig(
+        arch="transformer",
+        unit_embed_dim=16,
+        lstm_hidden=16,
+        mlp_hidden=16,
+        dtype="float32",
+        tf_layers=2,
+        tf_heads=2,
+        tf_context=32,
+        tf_sp_axis="sp",
+        tf_remat=True,
+    )
+    rets = _run_smoke(
+        "learn_smoke_sp",
+        n_updates=40,
+        min_episodes=300,
+        policy=tf_policy,
+        seq_len=31,  # 32 frames % sp=4 == 0
+        mesh_shape="dp=2,sp=4",
+    )
+    _assert_improvement(rets, margin=0.5)
