@@ -196,6 +196,8 @@ class TestUlyssesAttention:
         full = A.causal_attention(q, k, v, pos, pos)
         np.testing.assert_allclose(uly, full, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.nightly  # ring grads cover the default gate; this is the
+    # ulysses-specific backward (compile-heavy shard_map VJP)
     def test_gradients_match_single_device(self, sp_mesh):
         B, T, N, Dh = 1, 16, 8, 4
         q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (43, 44, 45))
@@ -274,6 +276,8 @@ class TestBlockwiseAttention:
         np.testing.assert_allclose(via, A.causal_attention(q, k, v, pos, pos), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.nightly  # blockwise-vs-dense parity is covered in the default
+# gate at the op level (TestBlockwiseAttention); this is the ulysses composition
 def test_ulysses_blockwise_matches_dense():
     """kv_block threading through the ulysses path changes memory only."""
     mesh = mesh_lib.make_mesh("sp=8")

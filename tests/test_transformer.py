@@ -274,6 +274,8 @@ class TestRemat:
         for k in m_a:
             assert m_b[k] == pytest.approx(m_a[k], rel=1e-6, abs=1e-8), k
 
+    @pytest.mark.nightly  # remat bit-parity is in the default gate; this
+    # is the remat x sp composition (second big compile)
     def test_remat_composes_with_sequence_parallelism(self):
         cfg = _tf_learner_cfg("dp=2,sp=4", "sp")
         cfg.policy.tf_remat = True
@@ -284,6 +286,8 @@ class TestRemat:
 
 
 class TestUlyssesTrainStep:
+    @pytest.mark.nightly  # ring train-step parity guards the default gate;
+    # ulysses parity at op level is default too — this is the composition
     def test_ulysses_sp_matches_dp_only(self):
         """Full PPO step with all-to-all sequence parallelism == local
         attention (same batch, same init)."""
