@@ -108,8 +108,26 @@ def _probe_tpu():
     import sys
     import tempfile
 
+    # Driver-settable retry schedule (VERDICT r3 item 2): the chip's
+    # windows are rare and short, so a fixed two-probe schedule loses to
+    # them. With DOTACLIENT_TPU_PROBE_DEADLINE_S=900 the probe retries
+    # every ~60s until the deadline; unset keeps the fast 90+300 default
+    # so a plain `python bench.py` still answers in <8 min.
+    deadline_s = float(os.environ.get("DOTACLIENT_TPU_PROBE_DEADLINE_S", "0") or 0)
+    t_end = time.time() + deadline_s if deadline_s > 0 else None
+
+    def schedule():
+        """Probe timeouts: wall-clock loop until the deadline (fast-failing
+        probes retry until time runs out, not a fixed count), or the
+        default two-probe schedule when no deadline is set."""
+        if t_end is None:
+            yield from (90.0, 300.0)
+            return
+        while time.time() < t_end:
+            yield min(60.0, max(5.0, t_end - time.time()))
+
     reasons = []
-    for timeout_s in (90.0, 300.0):
+    for timeout_s in schedule():
         with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
             proc = subprocess.Popen(
                 [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
@@ -140,8 +158,13 @@ def _probe_tpu():
                 f"{'TIMEOUT inside jax.devices()' if timed_out else f'rc={rc}'} "
                 f"stderr_tail={tail}"
             )
-        if timeout_s != 300.0:  # no sleep after the final attempt
+        last_attempt = t_end is None and timeout_s == 300.0 or (
+            t_end is not None and time.time() + 10 >= t_end
+        )
+        if not last_attempt:
             time.sleep(10)
+    if len(reasons) > 2:
+        return False, f"{len(reasons)} probe attempts failed; last: {reasons[-1]}"
     return False, "; ".join(reasons)
 
 
@@ -154,18 +177,58 @@ def _init_devices():
 
     DOTACLIENT_TPU_BENCH_PLATFORM=cpu skips the ~7-minute probe schedule
     and pins the host backend — for iterating on the bench itself on
-    machines where the TPU plugin is known-hung.
+    machines where the TPU plugin is known-hung. =tpu skips the probe in
+    the OTHER direction: the caller (scripts/tpu_prober.py, inside a
+    verified chip window) asserts the backend is up, so don't spend
+    scarce window seconds re-proving it.
     """
     import os
 
-    if os.environ.get("DOTACLIENT_TPU_BENCH_PLATFORM") == "cpu":
+    forced = os.environ.get("DOTACLIENT_TPU_BENCH_PLATFORM")
+    if forced == "cpu":
         jax.config.update("jax_platforms", "cpu")
         return jax.devices("cpu"), "forced by DOTACLIENT_TPU_BENCH_PLATFORM=cpu"
+    if forced == "tpu":
+        return jax.devices(), ""
     ok, reason = _probe_tpu()
     if ok:
         return jax.devices(), ""
     jax.config.update("jax_platforms", "cpu")
     return jax.devices("cpu"), reason
+
+
+def _last_silicon():
+    """Newest committed on-silicon bench artifact (BENCH_TPU_*.json).
+
+    A CPU-fallback bench JSON must never silently read 0.5x when a real
+    49x on-silicon measurement sits one file over (VERDICT r3 item 2):
+    the fallback embeds it, clearly labeled, so the number of record
+    always carries the silicon evidence with it.
+    """
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # Newest-first; skip artifacts from runs that died mid-window (the
+    # one-JSON-line error contract prints value 0 + an "error" key) — an
+    # aborted run must never become the silicon number of record.
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_TPU_*.json")), reverse=True):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if "error" in data or not data.get("value"):
+            continue
+        return {
+            "note": "most recent committed on-silicon run of this same bench "
+            "(this process fell back to CPU; see fallback_reason)",
+            "file": os.path.basename(path),
+            "value": data.get("value"),
+            "unit": data.get("unit"),
+            "vs_baseline": data.get("vs_baseline"),
+        }
+    return None
 
 
 def _start_producers(cfg, broker_name: str, n_threads: int = 2):
@@ -289,6 +352,48 @@ def main() -> None:
     staging.stop()
 
     e2e_rate = env_steps / dt
+
+    # --- FLOPs / MFU / boundary-bytes accounting (SURVEY §6: normalize
+    # steps/s into utilization). Analytic matmul model + XLA's own count.
+    # The WHOLE block is best-effort: by this point the e2e measurement is
+    # complete, and an exception in informational accounting must degrade
+    # to missing fields, never zero out a measured (possibly on-silicon)
+    # number via the top-level error contract.
+    model_flops = xla_flops = achieved_flops = peak = h2d_bytes = d2h_bytes = None
+    try:
+        from dotaclient_tpu.ops import flops as flops_mod
+
+        model_flops = flops_mod.train_step_flops(cfg)
+        if on_cpu_fallback:
+            # lower().compile() does NOT reuse the jit dispatch cache — it
+            # is a second full XLA compile. Fine on a CPU-fallback run
+            # (informational cross-check of the analytic model;
+            # tests/test_flops.py pins it), but inside a scarce TPU window
+            # minutes of recompile could push the bench past the prober's
+            # task timeout and lose the whole artifact — so on silicon the
+            # analytic model stands alone.
+            try:
+                ca = train_step.lower(
+                    jax.eval_shape(lambda: state), jax.eval_shape(lambda: batch)
+                ).compile().cost_analysis()
+                if ca:
+                    ca0 = ca[0] if isinstance(ca, (list, tuple)) else ca
+                    xla_flops = float(ca0.get("flops", 0.0)) or None
+            except Exception:
+                pass  # cost analysis is backend-best-effort
+        steps_per_sec_e2e = e2e_rate / (cfg.batch_size * cfg.seq_len)
+        achieved_flops = model_flops * steps_per_sec_e2e
+        peak = None if on_cpu_fallback else flops_mod.peak_flops_for(str(devices[0]))
+        h2d_bytes = sum(
+            np.dtype(b.dtype).itemsize * int(np.prod(b.shape)) for b in jax.tree.leaves(batch)
+        )
+        d2h_bytes = 4 * sum(
+            int(np.prod(l.shape, dtype=np.int64)) if l.ndim else 1
+            for l in jax.tree.leaves(state.params)
+        )  # fused f32 publish buffer (ParamFlattener)
+    except Exception:
+        pass
+
     baseline = BASELINE_PER_CHIP * n_dev
     out = {
         "metric": "ppo_learner_env_steps_per_sec",
@@ -310,9 +415,25 @@ def main() -> None:
         "device_only_steps_per_sec": round(device_rate, 1),
         "packer_only_steps_per_sec": round(packer_rate, 1),
         "e2e_over_device_only": round(e2e_rate / device_rate, 3),
+        # Utilization accounting (SURVEY §6): analytic matmul FLOPs/step
+        # (ops/flops.py, fwd+bwd), XLA's compiled count when the backend
+        # reports one, achieved FLOP/s at the e2e rate, and MFU against
+        # the device's public peak (TPU only — CPU MFU is meaningless).
+        "flops_per_step_model": round(model_flops) if model_flops else None,
+        "flops_per_step_xla": round(xla_flops) if xla_flops else None,
+        "achieved_flops_per_sec": round(achieved_flops) if achieved_flops else None,
+        "mfu_pct": round(100.0 * achieved_flops / (peak * n_dev), 3)
+        if peak and achieved_flops
+        else None,
+        "h2d_bytes_per_iter": int(h2d_bytes) if h2d_bytes else None,
+        "d2h_bytes_per_iter": int(d2h_bytes) if d2h_bytes else None,
     }
     if on_cpu_fallback and fallback_reason:
         out["fallback_reason"] = fallback_reason
+    if on_cpu_fallback:
+        last = _last_silicon()
+        if last:
+            out["last_silicon"] = last
     print(json.dumps(out))
 
 

@@ -1,0 +1,92 @@
+"""Analytic FLOPs model of the PPO train step (SURVEY.md §6: perf numbers
+must be normalizable — steps/s alone can't say how much of the chip is
+used, so the bench reports achieved FLOP/s and MFU alongside).
+
+Counts matmul FLOPs only (2·M·N·K per [M,K]x[K,N]) — the architecture is
+matmul-dominated and elementwise/softmax work rides along fused, so this
+undercounts by a few percent; XLA's own `compiled.cost_analysis()['flops']`
+is reported next to it in the bench JSON as the compiler's ground truth
+(tests pin the two within a bracket so the model can't rot silently).
+
+Backward pass ≈ 2x the forward matmul FLOPs (each forward matmul spawns
+two in the backward: d/dx and d/dW) — the standard 3x-forward total for
+train steps. The optimizer update is elementwise (O(params), ~1M FLOPs vs
+~10G matmul FLOPs/step) and is ignored.
+"""
+
+from __future__ import annotations
+
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.env import featurizer as F
+
+
+def policy_forward_flops_per_frame(cfg: PolicyConfig) -> float:
+    """Matmul FLOPs for ONE batch element, ONE time frame, forward only.
+
+    Mirrors models/policy.py layer-for-layer (trunk + temporal core +
+    heads). The LSTM recurrence's per-frame cost is the [1,H]x[H,4H]
+    hidden projection; the hoisted x-projection is counted in the cell's
+    input matmul. The transformer family instead pays QKV/out/MLP
+    projections per frame plus attention scores against its (chunk-local)
+    context.
+    """
+    U, UF = F.MAX_UNITS, F.UNIT_FEATURES
+    D, M, H = cfg.unit_embed_dim, cfg.mlp_hidden, cfg.lstm_hidden
+
+    fl = 0.0
+    # obs_trunk (models/policy.py:obs_trunk)
+    fl += 2.0 * U * UF * M  # unit_mlp1
+    fl += 2.0 * U * M * D  # unit_mlp2
+    fl += 2.0 * F.HERO_FEATURES * M  # hero_mlp
+    fl += 2.0 * F.GLOBAL_FEATURES * (M // 4)  # global_mlp
+    trunk_in = M + M // 4 + 2 * D  # hero ++ glob ++ pool_max ++ pool_mean
+    fl += 2.0 * trunk_in * H  # trunk dense
+
+    # temporal core
+    if cfg.arch == "transformer":
+        Dh = H  # qkv/out are HxH each; MLP is Hx4H up + 4HxH down
+        ctx = cfg.tf_context
+        per_layer = 2.0 * (4 * H * Dh) + 2.0 * (2 * H * 4 * H)
+        per_layer += 2.0 * 2 * ctx * H  # scores QK^T + attn·V vs the chunk context
+        fl += cfg.tf_layers * per_layer
+    else:
+        fl += 2.0 * H * 4 * H  # x-projection (input is the trunk's H)
+        fl += 2.0 * H * 4 * H  # recurrence hidden projection
+
+    # heads (models/policy.py:action_heads)
+    head_out = F.N_ACTION_TYPES + 2 * cfg.n_move_bins + D + 1
+    if cfg.aux_heads:
+        head_out += 3
+    fl += 2.0 * H * head_out
+    fl += 2.0 * U * D  # target dot-product attention scores
+    return fl
+
+
+def train_step_flops(cfg: LearnerConfig) -> float:
+    """Total matmul FLOPs of one compiled PPO train step (fwd + bwd).
+
+    The teacher-forced re-eval unrolls seq_len+1 frames (bootstrap frame
+    included) for the whole batch; backward doubles the forward.
+    """
+    frames = cfg.batch_size * (cfg.seq_len + 1)
+    return 3.0 * frames * policy_forward_flops_per_frame(cfg.policy)
+
+
+# Peak dense bf16 FLOP/s for known TPU generations (public spec sheets);
+# MFU is only reported when the device maps to an entry here.
+PEAK_BF16_FLOPS = {
+    "v5 lite": 197e12,  # TPU v5e
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,  # Trillium
+    "v6e": 918e12,
+}
+
+
+def peak_flops_for(device_str: str) -> float | None:
+    s = device_str.lower()
+    for key, peak in PEAK_BF16_FLOPS.items():
+        if key in s:
+            return peak
+    return None

@@ -1,0 +1,210 @@
+"""Persistent TPU window catcher (VERDICT r3 "Next round" item 1).
+
+Round 3's lesson: the tunneled chip answers rarely (one ~45-min window in
+~13 hours) and the highest-value on-silicon runs were cut off when the
+window closed. This prober runs detached from round start:
+
+  loop:
+    probe jax.devices() in a killable subprocess (own session, group-kill)
+    on timeout: append a row to TPU_PROBE_LOG.md, sleep ~15 min, repeat
+    on success: IMMEDIATELY run the window tasks, in value order —
+      1. bench.py            (fused-pipeline e2e — the round-3 perf story)
+      2. scripts/bench_lstm.py         (kernel dispatcher re-validation)
+      3. scripts/tpu_window_parity.py  (full-step pallas parity + donation
+                                        safety — cut off at 05:22 r3)
+    each with its own timeout; artifacts + log committed to git after each
+    task (window may close mid-list; committed partial evidence beats
+    uncommitted complete evidence), then the prober EXITS 0 so the
+    driving session is notified and can restart it for a later window.
+
+Run: python scripts/tpu_prober.py [--interval 900] [--max-hours 11.5]
+
+NOTE: the own-session/tempfile/group-kill subprocess pattern and the
+bench error-contract predicate are duplicated from bench.py ON PURPOSE —
+this module must never `import bench` (it imports jax and the whole
+package; the prober's value is being a tiny pure-stdlib process that can
+outlive any jax wedge). If you fix a bug in one copy, fix bench.py's
+`_probe_tpu`/`_last_silicon` too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_PROBE_LOG.md")
+
+
+def _utc() -> str:
+    return time.strftime("%Y-%m-%d %H:%M", time.gmtime())
+
+
+def _append_log(row: str) -> None:
+    with open(LOG, "a") as f:
+        f.write(row + "\n")
+
+
+def _probe(timeout_s: float):
+    """(ok, seconds, detail) — probe in an own-session subprocess.
+
+    Group-kill on timeout: the axon plugin forks helpers that otherwise
+    outlive the probe and wedge pipe reads (bench.py:_probe_tpu notes).
+    """
+    t0 = time.time()
+    with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; print([str(d) for d in jax.devices()])"],
+            stdout=out_f,
+            stderr=err_f,
+            start_new_session=True,
+            cwd=REPO,
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            return False, time.time() - t0, "TIMEOUT"
+        out_f.seek(0)
+        out = out_f.read().decode(errors="replace").strip()
+        if rc == 0 and "TPU" in out.upper():
+            return True, time.time() - t0, out
+        return False, time.time() - t0, f"rc={rc} out={out[:120]}"
+
+
+def _run_task(cmd, env_extra, timeout_s, out_path=None):
+    """Run one window task; capture stdout to out_path if given.
+    Returns (ok, detail)."""
+    env = dict(os.environ, **env_extra)
+    with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
+        proc = subprocess.Popen(
+            cmd, stdout=out_f, stderr=err_f, start_new_session=True, cwd=REPO, env=env
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            return False, f"TIMEOUT after {timeout_s:.0f}s"
+        out_f.seek(0)
+        out = out_f.read().decode(errors="replace")
+        if out_path and rc == 0 and out.strip():
+            # bench.py prints exactly one JSON line; keep the last line.
+            # Its error contract exits 0 with {"value": 0, "error": ...} —
+            # that is a log line, not silicon evidence; don't enshrine it
+            # as a BENCH_TPU_* artifact (bench._last_silicon would embed it).
+            line = out.strip().splitlines()[-1]
+            try:
+                import json
+
+                parsed = json.loads(line)
+                is_error = "error" in parsed or not parsed.get("value")
+            except ValueError:
+                parsed, is_error = None, True
+            if is_error:
+                return False, f"bench error contract: {line[:200]}"
+            with open(os.path.join(REPO, out_path), "w") as f:
+                f.write(line + "\n")
+        if rc == 0:
+            return True, "ok"
+        err_f.seek(0)
+        tail = err_f.read().decode(errors="replace").strip().splitlines()[-3:]
+        return False, f"rc={rc} stderr_tail={' | '.join(tail)}"
+
+
+def _git_commit(paths, msg) -> None:
+    """Best-effort commit of prober artifacts; retries once on index lock
+    (the driving session commits concurrently)."""
+    for attempt in range(2):
+        try:
+            subprocess.run(["git", "add", *paths], cwd=REPO, check=True, timeout=60)
+            subprocess.run(["git", "commit", "-m", msg], cwd=REPO, check=True, timeout=60)
+            return
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
+            time.sleep(5 + 10 * attempt)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval", type=float, default=900.0, help="seconds between probes")
+    p.add_argument("--probe-timeout", type=float, default=120.0)
+    p.add_argument("--max-hours", type=float, default=11.5)
+    args = p.parse_args(argv)
+
+    deadline = time.time() + args.max_hours * 3600
+    while time.time() < deadline:
+        ok, dt, detail = _probe(args.probe_timeout)
+        load = os.getloadavg()[0]
+        if not ok:
+            _append_log(
+                f"| {_utc()} | {args.probe_timeout:.0f}s | TIMEOUT — prober "
+                f"(round 4 auto-loop, load {load:.1f}) |"
+            )
+            time.sleep(args.interval)
+            continue
+
+        ts = time.strftime("%Y%m%dT%H%M", time.gmtime())
+        _append_log(
+            f"| {_utc()} | n/a | **SUCCESS — {detail} after {dt:.1f}s** "
+            f"(round-4 prober, load {load:.1f}); launching window tasks: "
+            f"bench / lstm / full-step parity |"
+        )
+        _git_commit([LOG], f"TPU window {ts}: chip answered, window tasks starting")
+
+        bench_out = f"BENCH_TPU_{ts}.json"
+        tasks = [
+            (
+                "e2e bench (fused pipeline)",
+                [sys.executable, "bench.py"],
+                {"DOTACLIENT_TPU_BENCH_PLATFORM": "tpu"},
+                1500.0,
+                bench_out,
+                [bench_out],
+            ),
+            (
+                "lstm kernel micro-bench",
+                [sys.executable, "scripts/bench_lstm.py", "--out", "LSTM_BENCH.json"],
+                {},
+                1200.0,
+                None,
+                ["LSTM_BENCH.json"],
+            ),
+            (
+                "full-step pallas parity + donation safety",
+                [sys.executable, "scripts/tpu_window_parity.py", "--out", "PALLAS_PARITY_TPU.json"],
+                {},
+                1800.0,
+                None,
+                ["PALLAS_PARITY_TPU.json"],
+            ),
+        ]
+        for name, cmd, env_extra, timeout_s, out_path, artifacts in tasks:
+            t_ok, t_detail = _run_task(cmd, env_extra, timeout_s, out_path)
+            _append_log(f"| {_utc()} | task | {name}: {t_detail} |")
+            paths = [LOG] + [a for a in artifacts if os.path.exists(os.path.join(REPO, a))]
+            _git_commit(paths, f"TPU window {ts}: {name} {'ok' if t_ok else '- ' + t_detail[:60]}")
+            if not t_ok and "TIMEOUT" in t_detail:
+                # Window likely closed mid-task; don't burn the rest of the
+                # list against a hung backend. Exit and let the session
+                # restart the prober for a later window.
+                break
+        _append_log(f"| {_utc()} | n/a | window tasks done; prober exiting for restart |")
+        _git_commit([LOG], f"TPU window {ts}: window tasks complete")
+        return 0
+    return 1  # no window before the deadline
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,66 @@
+"""The analytic FLOPs model (ops/flops.py) vs XLA's compiled count.
+
+bench.py reports MFU computed from the analytic model — if a policy
+change (new head, trunk width, temporal core) desynchronizes the model
+from the real network, every subsequent MFU number is silently wrong.
+This pins model/XLA agreement so the rot is loud instead.
+"""
+
+import jax
+import pytest
+
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.ops import flops as flops_mod
+from dotaclient_tpu.parallel import mesh as mesh_lib
+from dotaclient_tpu.parallel.train_step import (
+    build_train_step,
+    init_train_state,
+    make_train_batch,
+)
+
+
+def _xla_flops(cfg: LearnerConfig) -> float:
+    # Single-device mesh: SPMD cost_analysis reports the PER-DEVICE
+    # partitioned module, so a 1-device mesh makes the count global.
+    mesh = mesh_lib.make_mesh(cfg.mesh_shape, devices=jax.devices()[:1])
+    train_step, state_sh, batch_sh = build_train_step(cfg, mesh)
+    state = jax.eval_shape(lambda: init_train_state(cfg, jax.random.PRNGKey(0)))
+    batch = jax.eval_shape(lambda: jax.tree.map(jax.numpy.asarray, make_train_batch(cfg, 0)))
+    ca = train_step.lower(state, batch).compile().cost_analysis()
+    ca0 = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return float(ca0["flops"])
+
+
+def test_lstm_model_tracks_xla_count():
+    # Flagship policy dims (the MFU number of record), small batch to keep
+    # the single-device compile cheap. Matmul-only model vs XLA's full
+    # count: the architecture is matmul-dominated, so the two must agree
+    # closely; the bracket is wide enough for fusion/elementwise noise and
+    # tight enough to catch any forgotten layer (each trunk matmul is >5%).
+    cfg = LearnerConfig(batch_size=32, seq_len=16, mesh_shape="dp=1")
+    model = flops_mod.train_step_flops(cfg)
+    xla = _xla_flops(cfg)
+    assert 0.75 < model / xla < 1.3, (model, xla)
+
+
+def test_transformer_model_tracks_xla_count():
+    cfg = LearnerConfig(
+        batch_size=32,
+        seq_len=15,
+        mesh_shape="dp=1",
+        policy=PolicyConfig(arch="transformer", tf_context=16),
+    )
+    model = flops_mod.train_step_flops(cfg)
+    xla = _xla_flops(cfg)
+    assert 0.6 < model / xla < 1.4, (model, xla)
+
+
+def test_scales_linearly_in_batch_and_time():
+    base = flops_mod.train_step_flops(LearnerConfig(batch_size=32, seq_len=16))
+    double_b = flops_mod.train_step_flops(LearnerConfig(batch_size=64, seq_len=16))
+    assert double_b == pytest.approx(2 * base)
+
+
+def test_peak_lookup():
+    assert flops_mod.peak_flops_for("TPU v5 lite0") == 197e12
+    assert flops_mod.peak_flops_for("TFRT_CPU_0") is None
