@@ -189,7 +189,17 @@ def _init_devices():
         jax.config.update("jax_platforms", "cpu")
         return jax.devices("cpu"), "forced by DOTACLIENT_TPU_BENCH_PLATFORM=cpu"
     if forced == "tpu":
-        return jax.devices(), ""
+        devices = jax.devices()
+        # The caller asserted a verified chip window; if this process
+        # nevertheless comes up CPU-only (env drift), fail loudly rather
+        # than measure a CPU rate that downstream tooling would enshrine
+        # as silicon evidence.
+        if devices[0].platform != "tpu":
+            raise RuntimeError(
+                f"DOTACLIENT_TPU_BENCH_PLATFORM=tpu but devices are "
+                f"{devices[0].platform!r} — refusing to mislabel a CPU run"
+            )
+        return devices, ""
     ok, reason = _probe_tpu()
     if ok:
         return jax.devices(), ""
@@ -397,6 +407,9 @@ def main() -> None:
     baseline = BASELINE_PER_CHIP * n_dev
     out = {
         "metric": "ppo_learner_env_steps_per_sec",
+        # Machine-readable backend marker: downstream tooling (the prober's
+        # BENCH_TPU_* artifact gate) must not parse the human unit string.
+        "platform": devices[0].platform,
         "value": round(e2e_rate, 1),
         "unit": (
             f"env-steps/sec end-to-end ({n_dev} "

@@ -84,6 +84,20 @@ class PPOConfig:
     # Experience older than this many learner versions is dropped on the host
     # (reference drops/weights stale experience by model version).
     max_staleness: int = 4
+    # Sample reuse (classic PPO): each consumed batch drives
+    # epochs x minibatches gradient updates inside ONE compiled step —
+    # advantages/returns computed once from the pre-update policy, then a
+    # lax.scan over per-epoch shuffles and minibatch slices. At TPU speed
+    # the learner is data-starved (device sits idle waiting for actors),
+    # so reuse converts idle FLOPs into sample efficiency. 1/1 = the
+    # single-update path (exactly the previous behavior).
+    epochs: int = 1
+    minibatches: int = 1
+    # Approximate-KL early stop: when > 0, once a minibatch update's
+    # approx_kl exceeds this, the REMAINING updates for the batch are
+    # skipped (lax.cond no-ops — semantics of the classic mid-loop
+    # `break`, with static shapes). 0 disables. Typical: 0.03.
+    kl_stop: float = 0.0
 
 
 @dataclass

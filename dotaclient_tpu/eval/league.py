@@ -78,7 +78,16 @@ class League:
     def maybe_snapshot(self, version: int, named_params: NamedParams) -> bool:
         """Admit `named_params` as snapshot v<version> if it is
         `snapshot_every` versions past the previous snapshot. The snapshot
-        inherits the agent's current rating (it IS the agent, frozen)."""
+        inherits the agent's current rating (it IS the agent, frozen).
+
+        A version REGRESSION (learner restarted without a checkpoint, or
+        a dead-boot straggler frame resynced the agent backwards —
+        runtime/actor.py apply_weight_frame) resets the cadence anchor:
+        without the reset, a stale high-version snapshot would make
+        `version - last < snapshot_every` hold for the entire new boot
+        and silently disable league snapshotting."""
+        if self._last_snap_version is not None and version < self._last_snap_version:
+            self._last_snap_version = None
         if self._last_snap_version is not None and version - self._last_snap_version < self.snapshot_every:
             return False
         name = f"v{version}"

@@ -67,9 +67,26 @@ def train_step_flops(cfg: LearnerConfig) -> float:
 
     The teacher-forced re-eval unrolls seq_len+1 frames (bootstrap frame
     included) for the whole batch; backward doubles the forward.
+
+    Sample reuse (ppo.epochs R x ppo.minibatches M > 1) changes the step
+    to 1 precompute forward (frozen GAE) + R epochs of full-data
+    fwd+bwd (each epoch's M minibatches together cover the batch once):
+    (3R + 1) x forward. With kl_stop enabled this is the no-early-stop
+    upper bound — the bench reports ppo_updates_done so a stopped run
+    is visible.
+
+    NOTE: XLA's cost_analysis() counts a lax.scan/while BODY once,
+    ignoring trip count (measured r4: the R=2,M=2 program reports FEWER
+    flops than R=1,M=1), so the model-vs-XLA pin in tests/test_flops.py
+    only holds for the scan-free single-update step; the reuse model is
+    pinned analytically against it instead.
     """
     frames = cfg.batch_size * (cfg.seq_len + 1)
-    return 3.0 * frames * policy_forward_flops_per_frame(cfg.policy)
+    fwd = frames * policy_forward_flops_per_frame(cfg.policy)
+    R, M = cfg.ppo.epochs, cfg.ppo.minibatches
+    if R * M == 1:
+        return 3.0 * fwd
+    return (3.0 * R + 1.0) * fwd
 
 
 # Peak dense bf16 FLOP/s for known TPU generations (public spec sheets);

@@ -13,7 +13,7 @@ wrapper in parallel/train_step.py owns optax and the mesh.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax.numpy as jnp
 
@@ -25,28 +25,32 @@ from dotaclient_tpu.ops.gae import gae, masked_mean, masked_std
 import jax
 
 
-def ppo_loss(
-    params,
-    apply_fn,
-    batch: TrainBatch,
+def _surrogate(
+    out,
+    actions,
+    behavior_logp,
+    behavior_value,
+    advantages,
+    returns,
+    mask,
+    aux_targets,
     cfg: PPOConfig,
-    aux_coef: float = 0.25,
+    aux_coef: float,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Returns (scalar loss, metrics dict). `apply_fn(params, state, obs,
-    unroll=True)` is PolicyNet.apply."""
-    mask = batch.mask
-    T = batch.rewards.shape[1]
-
-    _, out = apply_fn(params, batch.initial_state, batch.obs, unroll=True)
+    """Clipped surrogate + value + entropy (+aux) given a completed unroll
+    `out` and FIXED advantages/returns — shared by the one-update path
+    (which derives them from the same forward) and the sample-reuse path
+    (which precomputes them once per consumed batch). Advantages are
+    normalized over whatever slice `mask` covers — the full batch in the
+    one-update path, the minibatch in the reuse path (the PPO2
+    convention)."""
+    T = actions.type.shape[1]
     values = out.value  # [B, T+1]
     dist_t = jax.tree.map(lambda x: x[:, :T], out.dist)
 
-    new_logp = ad.log_prob(dist_t, batch.actions)
-    ratio = jnp.exp(new_logp - batch.behavior_logp)
+    new_logp = ad.log_prob(dist_t, actions)
+    ratio = jnp.exp(new_logp - behavior_logp)
 
-    advantages, returns = gae(
-        batch.rewards, jax.lax.stop_gradient(values), batch.dones, mask, cfg.gamma, cfg.gae_lambda
-    )
     norm_adv = (advantages - masked_mean(advantages, mask)) / masked_std(advantages, mask)
     norm_adv = jax.lax.stop_gradient(norm_adv * mask)
 
@@ -55,8 +59,8 @@ def ppo_loss(
     policy_loss = -masked_mean(jnp.minimum(unclipped, clipped), mask)
 
     v_pred = values[:, :T]
-    v_clipped = batch.behavior_value + jnp.clip(
-        v_pred - batch.behavior_value, -cfg.value_clip, cfg.value_clip
+    v_clipped = behavior_value + jnp.clip(
+        v_pred - behavior_value, -cfg.value_clip, cfg.value_clip
     )
     v_err = jnp.maximum((v_pred - returns) ** 2, (v_clipped - returns) ** 2)
     value_loss = 0.5 * masked_mean(v_err, mask)
@@ -74,29 +78,124 @@ def ppo_loss(
         "ratio_clip_frac": masked_mean(
             (jnp.abs(ratio - 1.0) > cfg.clip_eps).astype(jnp.float32), mask
         ),
-        "approx_kl": masked_mean(batch.behavior_logp - new_logp, mask),
+        "approx_kl": masked_mean(behavior_logp - new_logp, mask),
         "advantage_mean": masked_mean(advantages, mask),
         "return_mean": masked_mean(returns, mask),
         "value_mean": masked_mean(v_pred, mask),
     }
 
-    if batch.aux is not None and out.aux is not None:
+    if aux_targets is not None and out.aux is not None:
         aux_t = jax.tree.map(lambda x: x[:, :T], out.aux)
         win_prob_loss = masked_mean(
             # ±1 labels → BCE on the win logit; 0 labels mean "unknown yet"
             # and are masked out.
             jnp.where(
-                batch.aux.win != 0.0,
-                jnp.logaddexp(0.0, -batch.aux.win * aux_t.win_logit),
+                aux_targets.win != 0.0,
+                jnp.logaddexp(0.0, -aux_targets.win * aux_t.win_logit),
                 0.0,
             ),
             mask,
         )
-        lh_loss = masked_mean((aux_t.last_hit - batch.aux.last_hit) ** 2, mask)
-        nw_loss = masked_mean((aux_t.net_worth - batch.aux.net_worth) ** 2, mask)
+        lh_loss = masked_mean((aux_t.last_hit - aux_targets.last_hit) ** 2, mask)
+        nw_loss = masked_mean((aux_t.net_worth - aux_targets.net_worth) ** 2, mask)
         aux_loss = win_prob_loss + lh_loss + nw_loss
         loss = loss + aux_coef * aux_loss
         metrics["loss"] = loss
         metrics["aux_loss"] = aux_loss
 
     return loss, metrics
+
+
+def ppo_loss(
+    params,
+    apply_fn,
+    batch: TrainBatch,
+    cfg: PPOConfig,
+    aux_coef: float = 0.25,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Returns (scalar loss, metrics dict). `apply_fn(params, state, obs,
+    unroll=True)` is PolicyNet.apply. One forward serves both GAE (through
+    a stop_gradient) and the surrogate — the single-update train path."""
+    mask = batch.mask
+    _, out = apply_fn(params, batch.initial_state, batch.obs, unroll=True)
+    advantages, returns = gae(
+        batch.rewards,
+        jax.lax.stop_gradient(out.value),
+        batch.dones,
+        mask,
+        cfg.gamma,
+        cfg.gae_lambda,
+    )
+    return _surrogate(
+        out,
+        batch.actions,
+        batch.behavior_logp,
+        batch.behavior_value,
+        advantages,
+        returns,
+        mask,
+        batch.aux,
+        cfg,
+        aux_coef,
+    )
+
+
+class ReuseBatch(NamedTuple):
+    """A consumed batch with advantages/returns FROZEN from the pre-update
+    policy — what the epochs x minibatches reuse loop shuffles and slices.
+    (Classic PPO computes GAE once per batch, not once per update.)"""
+
+    obs: object
+    actions: object
+    behavior_logp: jnp.ndarray
+    behavior_value: jnp.ndarray
+    advantages: jnp.ndarray
+    returns: jnp.ndarray
+    mask: jnp.ndarray
+    initial_state: object
+    aux: object  # AuxTargets or None
+
+
+def precompute_reuse(params, apply_fn, batch: TrainBatch, cfg: PPOConfig) -> ReuseBatch:
+    """One forward with the CURRENT (pre-update) params → frozen
+    advantages/returns for the whole reuse loop."""
+    _, out = apply_fn(params, batch.initial_state, batch.obs, unroll=True)
+    advantages, returns = gae(
+        batch.rewards,
+        jax.lax.stop_gradient(out.value),
+        batch.dones,
+        batch.mask,
+        cfg.gamma,
+        cfg.gae_lambda,
+    )
+    return ReuseBatch(
+        obs=batch.obs,
+        actions=batch.actions,
+        behavior_logp=batch.behavior_logp,
+        behavior_value=batch.behavior_value,
+        advantages=jax.lax.stop_gradient(advantages),
+        returns=jax.lax.stop_gradient(returns),
+        mask=batch.mask,
+        initial_state=batch.initial_state,
+        aux=batch.aux,
+    )
+
+
+def ppo_minibatch_loss(
+    params, apply_fn, mb: ReuseBatch, cfg: PPOConfig, aux_coef: float = 0.25
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """The reuse loop's per-update loss: fresh forward on the minibatch,
+    surrogate against the frozen advantages/returns."""
+    _, out = apply_fn(params, mb.initial_state, mb.obs, unroll=True)
+    return _surrogate(
+        out,
+        mb.actions,
+        mb.behavior_logp,
+        mb.behavior_value,
+        mb.advantages,
+        mb.returns,
+        mb.mask,
+        mb.aux,
+        cfg,
+        aux_coef,
+    )

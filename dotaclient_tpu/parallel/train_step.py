@@ -98,14 +98,32 @@ def _build_core(cfg: LearnerConfig, mesh):
     net = PolicyNet(cfg.policy, sp_mesh=mesh if use_sp else None)
     opt = make_optimizer(cfg)
 
-    def step_fn(state: TrainState, batch: TrainBatch) -> Tuple[TrainState, Dict]:
-        (loss, metrics), grads = jax.value_and_grad(ppo_loss, has_aux=True)(
-            state.params, net.apply, batch, cfg.ppo
+    R, M = cfg.ppo.epochs, cfg.ppo.minibatches
+    if R < 1 or M < 1:
+        raise ValueError(f"ppo.epochs={R} and ppo.minibatches={M} must be >= 1")
+    if cfg.batch_size % M:
+        raise ValueError(
+            f"batch_size={cfg.batch_size} must divide by ppo.minibatches={M}"
         )
-        updates, opt_state = opt.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        return TrainState(params, opt_state, state.step + 1), metrics
+    if (cfg.batch_size // M) % max(dp, 1):
+        raise ValueError(
+            f"minibatch size {cfg.batch_size // M} (batch_size/minibatches) must "
+            f"divide by the mesh dp axis ({dp}) so each update stays dp-sharded"
+        )
+
+    if R * M == 1:
+
+        def step_fn(state: TrainState, batch: TrainBatch) -> Tuple[TrainState, Dict]:
+            (loss, metrics), grads = jax.value_and_grad(ppo_loss, has_aux=True)(
+                state.params, net.apply, batch, cfg.ppo
+            )
+            updates, opt_state = opt.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return TrainState(params, opt_state, state.step + 1), metrics
+
+    else:
+        step_fn = _build_reuse_step_fn(cfg, mesh, net, opt, use_sp, sp)
 
     # Shardings: derive from a concrete-shape template without materializing.
     state_template = jax.eval_shape(lambda: init_train_state(cfg, jax.random.PRNGKey(0)))
@@ -115,6 +133,126 @@ def _build_core(cfg: LearnerConfig, mesh):
         step=mesh_lib.replicated(mesh),
     )
     return step_fn, state_shardings, use_sp, sp
+
+
+def _build_reuse_step_fn(cfg: LearnerConfig, mesh, net, opt, use_sp: bool, sp: str):
+    """The sample-reuse train step (classic PPO: K epochs x M minibatches
+    per consumed batch, approx-KL early stop — SURVEY §3.2 disposition +
+    VERDICT r3 item 4).
+
+    TPU-first shape: ONE compiled program per consumed batch. Advantages
+    and returns are frozen from a single pre-update forward
+    (ops/ppo.py precompute_reuse); a lax.scan over epochs draws a fresh
+    batch permutation each epoch and an inner lax.scan walks the M
+    minibatch slices. The KL early stop is a carried `active` flag: once
+    a minibatch's approx_kl exceeds ppo.kl_stop, every later update body
+    runs the lax.cond no-op branch — the classic mid-loop `break` with
+    static shapes (skipped updates cost no real FLOPs; XLA executes only
+    the taken branch).
+
+    Minibatches stay dp-sharded: the [B, ...] leaves reshape to
+    [M, B/M, ...] with a sharding constraint putting 'dp' on the B/M
+    axis, so each device contributes its local share of every minibatch
+    and the gradient all-reduce stays the same ICI collective as the
+    single-update path. The per-epoch permutation is a global gather —
+    at rollout-batch sizes (a few MB) the reshuffle cost is noise.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dotaclient_tpu.ops.ppo import ppo_minibatch_loss, precompute_reuse
+
+    R, M = cfg.ppo.epochs, cfg.ppo.minibatches
+    B = cfg.batch_size
+    kl_stop = cfg.ppo.kl_stop
+    has_dp = "dp" in mesh.axis_names
+
+    metric_keys = [
+        "loss",
+        "policy_loss",
+        "value_loss",
+        "entropy",
+        "ratio_mean",
+        "ratio_clip_frac",
+        "approx_kl",
+        "advantage_mean",
+        "return_mean",
+        "value_mean",
+        "grad_norm",
+    ] + (["aux_loss"] if cfg.policy.aux_heads else [])
+
+    def constrain(mbs):
+        """Pin [M, B/M, ...] leaves to dp (and the obs time axis to sp)."""
+        if not has_dp:
+            return mbs
+        gen = NamedSharding(mesh, P(None, "dp"))
+        con = lambda sh: (lambda x: jax.lax.with_sharding_constraint(x, sh))
+        mbs = jax.tree.map(con(gen), mbs)
+        if use_sp:
+            obs_sh = NamedSharding(mesh, P(None, "dp", sp))
+            mbs = mbs._replace(obs=jax.tree.map(con(obs_sh), mbs.obs))
+        return mbs
+
+    def update(params, opt_state, mb):
+        (_, metrics), grads = jax.value_and_grad(ppo_minibatch_loss, has_aux=True)(
+            params, net.apply, mb, cfg.ppo
+        )
+        updates, new_opt = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_params, new_opt, metrics
+
+    def step_fn(state: TrainState, batch: TrainBatch) -> Tuple[TrainState, Dict]:
+        import jax.numpy as jnp
+
+        rb = precompute_reuse(state.params, net.apply, batch, cfg.ppo)
+        # Deterministic per-step shuffle stream; no rng carried in
+        # TrainState (checkpoint layout unchanged).
+        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), state.step)
+
+        def mb_body(carry, mb):
+            params, opt_state, active, n_upd, metrics = carry
+
+            def do(_):
+                new_params, new_opt, m = update(params, opt_state, mb)
+                if kl_stop > 0:
+                    # Apply-then-stop (the cleanrl/PPO2 convention, checked
+                    # per minibatch): the triggering update lands, the rest
+                    # of the reuse loop is skipped.
+                    still = jnp.logical_and(active, m["approx_kl"] <= kl_stop)
+                else:
+                    still = active
+                return (new_params, new_opt, still, n_upd + 1, m)
+
+            def skip(_):
+                return carry
+
+            return jax.lax.cond(active, do, skip, None), None
+
+        def epoch_body(carry, e_rng):
+            perm = jax.random.permutation(e_rng, B)
+            shuf = jax.tree.map(lambda x: jnp.take(x, perm, axis=0), rb)
+            mbs = constrain(
+                jax.tree.map(lambda x: x.reshape((M, B // M) + x.shape[1:]), shuf)
+            )
+            carry, _ = jax.lax.scan(mb_body, carry, mbs)
+            return carry, None
+
+        init = (
+            state.params,
+            state.opt_state,
+            jnp.asarray(True),
+            jnp.zeros((), jnp.int32),
+            {k: jnp.zeros((), jnp.float32) for k in metric_keys},
+        )
+        (params, opt_state, active, n_upd, metrics), _ = jax.lax.scan(
+            epoch_body, init, jax.random.split(rng, R)
+        )
+        metrics = dict(metrics)
+        metrics["ppo_updates_done"] = n_upd.astype(jnp.float32)
+        metrics["ppo_kl_stopped"] = 1.0 - active.astype(jnp.float32)
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    return step_fn
 
 
 def build_train_step(cfg: LearnerConfig, mesh):

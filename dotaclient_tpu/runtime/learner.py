@@ -32,6 +32,7 @@ semantics require it —
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -117,9 +118,10 @@ class WeightPublisher:
     `ParamFlattener.to_named` with a device buffer payload.
     """
 
-    def __init__(self, broker: Broker, materialize=None):
+    def __init__(self, broker: Broker, materialize=None, boot_epoch: int = 0):
         self._materialize = materialize if materialize is not None else flatten_params
         self._broker = broker
+        self._boot_epoch = boot_epoch
         self._cond = threading.Condition()
         self._slot = None  # (np_params, version) — latest pending
         self._stop = False
@@ -164,7 +166,9 @@ class WeightPublisher:
                 np_params, version = self._slot
                 self._slot = None
             try:
-                frame = serialize_weights(self._materialize(np_params), version=version)
+                frame = serialize_weights(
+                    self._materialize(np_params), version=version, boot_epoch=self._boot_epoch
+                )
                 self._broker.publish_weights(frame)
                 self.published += 1
             except Exception:
@@ -204,11 +208,19 @@ class Learner:
                 cfg, self.mesh
             )
         self.version = 0
+        # Drawn once per learner process and stamped into every weight
+        # frame: subscribers detect a restart by the epoch CHANGING, not
+        # by counting suspicious frames (runtime/actor.py
+        # apply_weight_frame). Time ^ pid so two boots in the same second
+        # still differ.
+        self.boot_epoch = (int(time.time()) << 8 ^ os.getpid()) & 0xFFFFFFFF
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         self.state: TrainState = jax.device_put(state, self.state_shardings)
         self.staging = StagingBuffer(cfg, broker, version_fn=lambda: self.version)
         self.flattener = ParamFlattener(state.params)
-        self.publisher = WeightPublisher(broker, materialize=self.flattener.to_named)
+        self.publisher = WeightPublisher(
+            broker, materialize=self.flattener.to_named, boot_epoch=self.boot_epoch
+        )
         self.metrics = MetricsLogger(cfg.log_dir)
         self.env_steps_done = 0  # total real (unmasked) env steps trained on
         if cfg.profile_port:
@@ -230,7 +242,9 @@ class Learner:
 
     def publish_weights(self) -> None:
         params = jax.device_get(self.state.params)
-        frame = serialize_weights(flatten_params(params), version=self.version)
+        frame = serialize_weights(
+            flatten_params(params), version=self.version, boot_epoch=self.boot_epoch
+        )
         self.broker.publish_weights(frame)
 
     def checkpoint(self) -> None:

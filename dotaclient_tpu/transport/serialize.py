@@ -36,7 +36,8 @@ from dotaclient_tpu.env import featurizer as F
 from dotaclient_tpu.ops.action_dist import Action
 
 _ROLLOUT_MAGIC = b"DTR1"
-_WEIGHTS_MAGIC = b"DTW1"
+_WEIGHTS_MAGIC = b"DTW1"  # legacy: no boot_epoch (read-compat only)
+_WEIGHTS_MAGIC2 = b"DTW2"
 _HDR = struct.Struct("<4sIHHBIf")
 
 _FLAG_AUX = 1
@@ -177,8 +178,20 @@ def _dtype_code(dt) -> int:
     raise ValueError(f"unsupported weight dtype {dt}")
 
 
-def serialize_weights(named_arrays: List[Tuple[str, np.ndarray]], version: int) -> bytes:
-    parts = [struct.pack("<4sII", _WEIGHTS_MAGIC, version, len(named_arrays))]
+def serialize_weights(
+    named_arrays: List[Tuple[str, np.ndarray]], version: int, boot_epoch: int = 0
+) -> bytes:
+    """Weight fanout frame. `boot_epoch` identifies the publishing
+    learner PROCESS (drawn once at learner boot): subscribers resync on
+    an epoch change — the deterministic learner-restart signal that
+    replaced the consecutive-older-frames heuristic (VERDICT r3 item 9).
+    Header is DTW2 <magic, version, boot_epoch, n>; readers also accept
+    legacy DTW1 (no epoch → 0). Compat is one-directional: NEW readers
+    accept OLD frames, but old readers reject DTW2 — so a rolling
+    upgrade must update subscribers (actors/evaluators) before the
+    learner starts emitting DTW2. Upgrading the learner first leaves old
+    actors logging 'bad weight frame' and running stale weights."""
+    parts = [struct.pack("<4sIII", _WEIGHTS_MAGIC2, version, boot_epoch & 0xFFFFFFFF, len(named_arrays))]
     for name, arr in named_arrays:
         arr = np.ascontiguousarray(arr)
         nb = name.encode()
@@ -191,11 +204,19 @@ def serialize_weights(named_arrays: List[Tuple[str, np.ndarray]], version: int) 
     return b"".join(parts)
 
 
-def deserialize_weights(data: bytes) -> Tuple[List[Tuple[str, np.ndarray]], int]:
-    magic, version, n = struct.unpack_from("<4sII", data)
-    if magic != _WEIGHTS_MAGIC:
+def deserialize_weights(data: bytes) -> Tuple[List[Tuple[str, np.ndarray]], int, int]:
+    """Returns (named_arrays, version, boot_epoch). Accepts the current
+    DTW2 frames and legacy DTW1 (which carried no epoch → 0)."""
+    magic = data[:4]
+    if magic == _WEIGHTS_MAGIC2:
+        _, version, boot_epoch, n = struct.unpack_from("<4sIII", data)
+        off = struct.calcsize("<4sIII")
+    elif magic == _WEIGHTS_MAGIC:
+        _, version, n = struct.unpack_from("<4sII", data)
+        boot_epoch = 0
+        off = struct.calcsize("<4sII")
+    else:
         raise ValueError("bad weights frame")
-    off = struct.calcsize("<4sII")
     out = []
     for _ in range(n):
         (name_len,) = struct.unpack_from("<H", data, off)
@@ -213,7 +234,7 @@ def deserialize_weights(data: bytes) -> Tuple[List[Tuple[str, np.ndarray]], int]
         arr = np.frombuffer(data, dtype, count=count, offset=off).reshape(shape)
         off += count * np.dtype(dtype).itemsize
         out.append((name, arr))
-    return out, version
+    return out, version, boot_epoch
 
 
 def named_param_leaves(params) -> List[Tuple[str, Any]]:

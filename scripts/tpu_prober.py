@@ -110,11 +110,18 @@ def _run_task(cmd, env_extra, timeout_s, out_path=None):
                 import json
 
                 parsed = json.loads(line)
-                is_error = "error" in parsed or not parsed.get("value")
+                # Silicon evidence requires: no error contract, a nonzero
+                # rate, AND the machine-readable platform marker saying
+                # the measurement actually ran on the chip.
+                is_error = (
+                    "error" in parsed
+                    or not parsed.get("value")
+                    or parsed.get("platform") != "tpu"
+                )
             except ValueError:
                 parsed, is_error = None, True
             if is_error:
-                return False, f"bench error contract: {line[:200]}"
+                return False, f"bench not silicon evidence: {line[:200]}"
             with open(os.path.join(REPO, out_path), "w") as f:
                 f.write(line + "\n")
         if rc == 0:

@@ -108,29 +108,72 @@ def test_actor_ignores_stale_weight_frame(env):
 
 
 def test_actor_resyncs_after_learner_restart_without_checkpoint(env):
-    """A learner that restarts WITHOUT a checkpoint re-publishes from
-    v0. One or two older frames are treated as stale-delivery noise, but
-    a consistent stream of them means the learner genuinely lives at a
-    lower version — the actor must resync rather than reject broadcasts
-    forever (running ancient weights while stamping high versions)."""
+    """A learner that restarts WITHOUT a checkpoint re-publishes from v0
+    under a NEW boot_epoch. The epoch change is the deterministic restart
+    signal: the very first frame of the new boot resyncs the actor, even
+    though its version is lower — no counting heuristic, no window where
+    the actor runs ancient weights while stamping high versions."""
     actor, broker, cfg = make_actor(env, "actor_restart")
     p_v500 = init_params(cfg.policy, jax.random.PRNGKey(7))
-    broker.publish_weights(serialize_weights(flatten_params(p_v500), version=500))
+    broker.publish_weights(
+        serialize_weights(flatten_params(p_v500), version=500, boot_epoch=111)
+    )
     assert actor.maybe_update_weights()
     assert actor.version == 500
-    # learner restarts at v0 and keeps training/publishing
+    # learner restarts at v1 with a fresh boot_epoch: FIRST frame resyncs
     restart_params = init_params(cfg.policy, jax.random.PRNGKey(8))
-    for v in (1, 2):
-        broker.publish_weights(serialize_weights(flatten_params(restart_params), version=v))
-        assert not actor.maybe_update_weights()  # first rejections: stale-guard
-        assert actor.version == 500
-    broker.publish_weights(serialize_weights(flatten_params(restart_params), version=3))
-    assert actor.maybe_update_weights()  # third consecutive: resync
+    broker.publish_weights(
+        serialize_weights(flatten_params(restart_params), version=1, boot_epoch=222)
+    )
+    assert actor.maybe_update_weights()
+    assert actor.version == 1
+    # a genuinely stale frame from the SAME boot is still rejected...
+    broker.publish_weights(
+        serialize_weights(flatten_params(restart_params), version=3, boot_epoch=222)
+    )
+    assert actor.maybe_update_weights()
     assert actor.version == 3
-    # a genuinely stale one-off afterwards is still rejected
-    broker.publish_weights(serialize_weights(flatten_params(p_v500), version=1))
+    broker.publish_weights(
+        serialize_weights(flatten_params(restart_params), version=1, boot_epoch=222)
+    )
     assert not actor.maybe_update_weights()
     assert actor.version == 3
+    # ...and a straggler from the DEAD boot swaps in once (epoch differs)
+    # but the next live broadcast swaps straight back — self-correcting.
+    broker.publish_weights(
+        serialize_weights(flatten_params(p_v500), version=500, boot_epoch=111)
+    )
+    assert actor.maybe_update_weights()
+    broker.publish_weights(
+        serialize_weights(flatten_params(restart_params), version=4, boot_epoch=222)
+    )
+    assert actor.maybe_update_weights()
+    assert actor.version == 4
+
+
+def test_actor_accepts_legacy_dtw1_weight_frame(env):
+    """Rolling-upgrade tolerance: a learner still publishing the old
+    DTW1 header (no boot_epoch) must keep driving actors."""
+    from dotaclient_tpu.transport import serialize as S
+
+    actor, broker, cfg = make_actor(env, "actor_legacy")
+    params = init_params(cfg.policy, jax.random.PRNGKey(9))
+    import struct
+
+    named = flatten_params(params)
+    parts = [struct.pack("<4sII", b"DTW1", 7, len(named))]
+    for name, arr in named:
+        arr = np.ascontiguousarray(arr)
+        nb = name.encode()
+        parts.append(struct.pack("<H", len(nb)))
+        parts.append(nb)
+        parts.append(struct.pack("<B", arr.ndim))
+        parts.append(struct.pack(f"<{arr.ndim}I", *arr.shape) if arr.ndim else b"")
+        parts.append(struct.pack("<B", 0))  # f32
+        parts.append(arr.tobytes())
+    broker.publish_weights(b"".join(parts))
+    assert actor.maybe_update_weights()
+    assert actor.version == 7
 
 
 def test_actor_aux_targets(env):

@@ -61,6 +61,20 @@ def test_scales_linearly_in_batch_and_time():
     assert double_b == pytest.approx(2 * base)
 
 
+def test_sample_reuse_scales_flops():
+    """(3R+1)/3 x the single-update step: R full-data fwd+bwd epochs plus
+    the GAE precompute forward. (XLA cost_analysis can't cross-check this
+    one — it counts scan bodies once, ignoring trip count; see
+    ops/flops.py note.)"""
+    from dotaclient_tpu.config import PPOConfig
+
+    base = flops_mod.train_step_flops(LearnerConfig(batch_size=32, seq_len=16))
+    reuse = flops_mod.train_step_flops(
+        LearnerConfig(batch_size=32, seq_len=16, ppo=PPOConfig(epochs=2, minibatches=2))
+    )
+    assert reuse == pytest.approx(base * 7.0 / 3.0)
+
+
 def test_peak_lookup():
     assert flops_mod.peak_flops_for("TPU v5 lite0") == 197e12
     assert flops_mod.peak_flops_for("TFRT_CPU_0") is None

@@ -21,6 +21,20 @@ def test_snapshot_cadence_and_dedup():
     assert lg.names == ["v0", "v10"]
 
 
+def test_snapshot_cadence_resets_on_version_regression():
+    """A learner restart (or a dead-boot straggler resync) moves the
+    agent's version BACKWARDS. The cadence anchor must reset, or
+    `version - last < snapshot_every` would hold for the whole new boot
+    and silently disable snapshotting (r4 review finding)."""
+    lg = League(capacity=4, snapshot_every=10)
+    assert lg.maybe_snapshot(500, params(500))
+    # restarting learner republishes from v1: cadence must restart too
+    assert lg.maybe_snapshot(1, params(1))
+    assert not lg.maybe_snapshot(5, params(5))  # normal cadence resumes
+    assert lg.maybe_snapshot(11, params(11))
+    assert lg.names == ["v500", "v1", "v11"]
+
+
 def test_snapshot_params_are_frozen_copies():
     lg = League(snapshot_every=1)
     p = params(1)

@@ -70,7 +70,7 @@ def test_coalesces_to_latest_under_slow_broker():
     assert pub.coalesced > 0, "slow broker must coalesce, not queue"
     assert len(versions) < 7
     assert versions == sorted(versions), "never deliver out of order"
-    named, _ = deserialize_weights(broker.frames[-1])
+    named, _, _ = deserialize_weights(broker.frames[-1])
     np.testing.assert_array_equal(dict(named)["dense/kernel"], np.full((4, 4), 7.0, np.float32))
 
 
@@ -160,8 +160,9 @@ def test_learner_publishes_correct_weights_via_fused_path():
     learner.run(num_steps=2, batch_timeout=60.0)
     frame = sub.poll_weights()
     assert frame is not None
-    named, version = deserialize_weights(frame)
+    named, version, boot_epoch = deserialize_weights(frame)
     assert version == learner.version == 2
+    assert boot_epoch == learner.boot_epoch != 0
     want = dict(flatten_params(jax.device_get(learner.state.params)))
     got = dict(named)
     assert set(got) == set(want)

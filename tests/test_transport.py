@@ -95,9 +95,10 @@ def test_weights_roundtrip_with_params_tree():
     cfg = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16)
     params = init_params(cfg, jax.random.PRNGKey(0))
     flat = flatten_params(params)
-    data = serialize_weights(flat, version=42)
-    named, version = deserialize_weights(data)
+    data = serialize_weights(flat, version=42, boot_epoch=9001)
+    named, version, boot_epoch = deserialize_weights(data)
     assert version == 42
+    assert boot_epoch == 9001
     rebuilt = unflatten_params(named, params)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(rebuilt)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
