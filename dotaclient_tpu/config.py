@@ -110,6 +110,12 @@ class LearnerConfig:
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     broker_url: str = "mem://"
     checkpoint_dir: str = ""
+    # Remote checkpoint mirror (reference behavior: upload finished
+    # checkpoints to object storage — SURVEY §3.4). Any epath scheme
+    # (gs://bucket/path, s3://...); each finished step is file-copied up
+    # and a fresh learner with an empty checkpoint_dir pulls the newest
+    # complete remote step back down (runtime/checkpoint.py).
+    checkpoint_remote_dir: str = ""
     checkpoint_every: int = 100  # steps between durable checkpoints
     publish_every: int = 1  # steps between weight fanout publishes
     # Steps between host↔device metric syncs. Fetching the metrics dict
@@ -189,6 +195,11 @@ class ActorConfig:
     # Kill switch: exit (for supervisor restart) if no weight broadcast
     # arrives for this many seconds. 0 disables.
     max_weight_age_s: float = 0.0
+    # Ablation: mask the CAST action out of every observation, so the
+    # policy can never use abilities. Exists to measure whether ability
+    # usage is ADVANTAGEOUS (scripts/ab_cast.py trains with and without);
+    # never set in production.
+    disable_cast: bool = False
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     seed: int = 0
     actor_id: int = 0

@@ -173,6 +173,11 @@ class LastHitLaneGame:
         self.stats_by: Dict[int, dict] = {}
         self.control: Dict[int, int] = {}
         self._xp_trickle: Dict[int, float] = {}
+        # Ground-truth action accounting (ability-usage A/B evidence —
+        # scripts/ab_cast.py): per-player action-type counts, plus casts
+        # that actually FIRED (in range, off cooldown, mana paid).
+        self.action_counts: Dict[int, Dict[int, int]] = {}
+        self.casts_landed: Dict[int, int] = {}
         for team, picks in picks_by_team.items():
             sign = -1.0 if team == TEAM_RADIANT else 1.0
             default_control = CONTROL_POLICY if team == TEAM_RADIANT else CONTROL_SCRIPTED
@@ -286,6 +291,7 @@ class LastHitLaneGame:
         if self._dist(h, target) <= _ABILITY_CAST_RANGE:
             h.mana -= _ABILITY_MANA_COST
             h.next_cast_time = self.dota_time + _ABILITY_COOLDOWN
+            self.casts_landed[pid] = self.casts_landed.get(pid, 0) + 1
             self._deal_damage(pid, target, _ABILITY_DAMAGE)
         else:
             self._move_toward(h, target.x, target.y, h.move_speed * dt)
@@ -295,6 +301,8 @@ class LastHitLaneGame:
         h = self.heroes[pid]
         if not h.alive or act is None:
             return
+        per = self.action_counts.setdefault(pid, {})
+        per[act.type] = per.get(act.type, 0) + 1
         if act.type == ds.Action.MOVE:
             self._move_toward(h, act.move_x, act.move_y, h.move_speed * dt)
         elif act.type == ds.Action.ATTACK:
@@ -512,6 +520,47 @@ class FakeDotaService(DotaServiceServicer):
         # fake server without interleaving each other's episodes (the real
         # dotaservice is one-game-per-instance; peers emulate instances).
         self._games: Dict[str, LastHitLaneGame] = {}
+        # Lifetime action telemetry, accumulated from finished/evicted
+        # games (per-player-id across all sessions) — ground truth for
+        # ability-usage evidence (scripts/ab_cast.py).
+        self.total_action_counts: Dict[int, Dict[int, int]] = {}
+        self.total_casts_landed: Dict[int, int] = {}
+
+    def _fold_counters(self, game: "LastHitLaneGame") -> None:
+        """Accumulate a retiring game's action telemetry (holding _lock).
+        The game's own lock guards its counter dicts against a stepping
+        thread (another peer's game can be evicted mid-step)."""
+        with game.lock:
+            counts = {pid: dict(per) for pid, per in game.action_counts.items()}
+            casts = dict(game.casts_landed)
+        for pid, per in counts.items():
+            tot = self.total_action_counts.setdefault(pid, {})
+            for t, n in per.items():
+                tot[t] = tot.get(t, 0) + n
+        for pid, n in casts.items():
+            self.total_casts_landed[pid] = self.total_casts_landed.get(pid, 0) + n
+
+    def action_telemetry(self):
+        """(action_counts, casts_landed) per player id, totals INCLUDING
+        live sessions — the ground-truth read for ability-usage evidence.
+        Live games are snapshotted under their own locks: a concurrent
+        _apply_hero_action inserting a key mid-iteration would otherwise
+        raise 'dictionary changed size' or tear counts."""
+        with self._lock:
+            tot_a = {p: dict(d) for p, d in self.total_action_counts.items()}
+            tot_c = dict(self.total_casts_landed)
+            games = list(self._games.values())
+        for game in games:
+            with game.lock:
+                counts = {pid: dict(per) for pid, per in game.action_counts.items()}
+                casts = dict(game.casts_landed)
+            for pid, per in counts.items():
+                t = tot_a.setdefault(pid, {})
+                for k, n in per.items():
+                    t[k] = t.get(k, 0) + n
+            for pid, n in casts.items():
+                tot_c[pid] = tot_c.get(pid, 0) + n
+        return tot_a, tot_c
 
     @staticmethod
     def _key(context) -> str:
@@ -525,14 +574,17 @@ class FakeDotaService(DotaServiceServicer):
             return
         for key, game in self._games.items():
             if game.ended:
-                self._games.pop(key)
+                self._fold_counters(self._games.pop(key))
                 return
-        self._games.pop(next(iter(self._games)))
+        self._fold_counters(self._games.pop(next(iter(self._games))))
 
     def reset(self, request: ds.GameConfig, context=None) -> ds.Observation:
         game = LastHitLaneGame(request)
         with self._lock:
             self._evict_if_full()
+            old = self._games.get(self._key(context))
+            if old is not None:
+                self._fold_counters(old)
             self._games[self._key(context)] = game
         with game.lock:
             game.seen_tick[TEAM_RADIANT] = game.tick

@@ -32,15 +32,19 @@ from dotaclient_tpu.protos import worldstate_pb2 as ws
 # FEATURE_SCHEMA_VERSION stamps checkpoints (runtime/checkpoint.py) so a
 # restore across an incompatible feature layout fails with a
 # self-explanatory message instead of a bare shape mismatch.
-# History: v1 = 24-dim HERO_FEATURES; v2 = 28 (ability features added).
-FEATURE_SCHEMA_VERSION = 2
+# History: v1 = 24-dim HERO_FEATURES; v2 = 28 (slot-0 ability features);
+# v3 = 37 (all four ability slots — a real hero has four abilities and
+# the CAST head cannot differentiate abilities it cannot see).
+FEATURE_SCHEMA_VERSION = 3
 MAX_UNITS = 16
 UNIT_FEATURES = 16
-# 16 stat features + 4 ability features (slot-0 readiness/cooldown/cost —
-# the CAST head needs to SEE why it is masked, not just that it is) + an
-# 8-dim hashed hero-identity code (env/heroes.py) so one shared LSTM can
-# condition on which hero it is playing (config 3).
-HERO_FEATURES = 28
+# 16 stat features + 4 ability slots x (readiness, cooldown, cost) — the
+# CAST head needs to SEE why it is masked, not just that it is — + 1
+# any-ability-castable summary + an 8-dim hashed hero-identity code
+# (env/heroes.py) so one shared LSTM can condition on which hero it is
+# playing (config 3).
+N_ABILITY_SLOTS = 4
+HERO_FEATURES = 16 + 3 * N_ABILITY_SLOTS + 1 + 8  # = 37
 GLOBAL_FEATURES = 8
 
 # Action-type head ordering (reference: {noop, move, attack[, ability]}).
@@ -159,13 +163,18 @@ def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     out[13] = math.log1p(max(h.xp, 0)) / 10.0
     out[14] = norm_last_hits(h.last_hits)
     out[15] = 1.0 if h.is_alive else 0.0
-    if h.abilities:  # slot-0 ability readiness (zeros = no abilities known)
-        a = min(h.abilities, key=lambda a: a.slot)
-        out[16] = 1.0 if a.level > 0 and a.is_castable else 0.0
-        out[17] = min(a.cooldown_remaining / 10.0, 1.0)
-        out[18] = a.mana_cost / max(h.mana_max, 1.0)
-        out[19] = 1.0 if castable(h) else 0.0
-    out[20:28] = hero_id_features(h.name)
+    # All four ability slots (zeros = slot empty / no abilities known):
+    # per slot (ready, cooldown, mana-cost), then an any-castable summary.
+    for a in h.abilities:
+        s = a.slot
+        if 0 <= s < N_ABILITY_SLOTS:
+            base = 16 + 3 * s
+            out[base + 0] = 1.0 if a.level > 0 and a.is_castable else 0.0
+            out[base + 1] = min(a.cooldown_remaining / 10.0, 1.0)
+            out[base + 2] = a.mana_cost / max(h.mana_max, 1.0)
+    base = 16 + 3 * N_ABILITY_SLOTS
+    out[base] = 1.0 if castable(h) else 0.0
+    out[base + 1 : base + 9] = hero_id_features(h.name)
 
 
 def featurize_with_handles(world: ws.World, player_id: int):

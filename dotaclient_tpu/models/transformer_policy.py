@@ -61,10 +61,26 @@ class KVCache(NamedTuple):
 def init_cache(cfg: PolicyConfig, batch_shape) -> KVCache:
     B = int(batch_shape[0]) if len(batch_shape) else 1
     L, C, N = cfg.tf_layers, cfg.tf_context, cfg.tf_heads
+    # Fail at config time, not as a confusing shape error deep in a later
+    # trace: a host-side init_cache with indivisible width would silently
+    # build a mis-shaped cache (ADVICE r3 item 1). RoPE additionally
+    # needs an even head dim.
+    if cfg.lstm_hidden % N:
+        raise ValueError(
+            f"transformer width lstm_hidden={cfg.lstm_hidden} must divide by "
+            f"tf_heads={N}"
+        )
     Dh = cfg.lstm_hidden // N
+    if Dh % 2:
+        raise ValueError(f"head dim {Dh} must be even (RoPE rotates half-pairs)")
+    # K/V live in the COMPUTE dtype: the values written are Dense outputs
+    # in that dtype anyway, so f32 storage was pure memory/H2D overhead
+    # (2x actor cache bytes); scores still accumulate in f32 inside
+    # attention (ADVICE r3 item 3). pos/idx stay int32.
+    dt = jnp.dtype(cfg.dtype)
     return KVCache(
-        k=jnp.zeros((B, L, C, N, Dh), jnp.float32),
-        v=jnp.zeros((B, L, C, N, Dh), jnp.float32),
+        k=jnp.zeros((B, L, C, N, Dh), dt),
+        v=jnp.zeros((B, L, C, N, Dh), dt),
         pos=jnp.full((B, C), A.EMPTY_POS, jnp.int32),
         idx=jnp.zeros((B,), jnp.int32),
     )
@@ -120,9 +136,11 @@ class Block(nn.Module):
             )
         else:
             k_cache, v_cache, cache_pos, onehot = cache
-            w = onehot[:, :, None, None].astype(jnp.float32)  # [B, C, 1, 1]
-            k_cache = k_cache * (1.0 - w) + k.astype(jnp.float32) * w
-            v_cache = v_cache * (1.0 - w) + v.astype(jnp.float32) * w
+            # Write in the cache's own dtype (compute dtype — init_cache):
+            # jnp.where avoids the f32 promotion a mask-blend would cause.
+            sel = onehot[:, :, None, None]  # [B, C, 1, 1] bool
+            k_cache = jnp.where(sel, k.astype(k_cache.dtype), k_cache)
+            v_cache = jnp.where(sel, v.astype(v_cache.dtype), v_cache)
             attn = RA.attend(q, k_cache, v_cache, positions, cache_pos)
             new_cache = (k_cache, v_cache)
         out = nn.Dense(D, dtype=dt, name="attn_out")(

@@ -390,6 +390,8 @@ class Actor:
         done = False
         # each worldstate is featurized exactly once; the pair rolls forward
         obs, handles = F.featurize_with_handles(world, self.player_id)
+        if cfg.disable_cast:
+            obs.action_mask[F.ACT_CAST] = False
 
         while not done:
             obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
@@ -412,6 +414,8 @@ class Actor:
                 return episode_return
             next_world = resp.world_state
             next_obs, next_handles = F.featurize_with_handles(next_world, self.player_id)
+            if cfg.disable_cast:
+                next_obs.action_mask[F.ACT_CAST] = False
             done = resp.status == ds.Observation.EPISODE_DONE
             r = R.reward(world, next_world, self.player_id, last_hero)
             episode_return += r

@@ -1,16 +1,28 @@
-"""Durable checkpoint/resume via orbax.
+"""Durable checkpoint/resume via orbax, with an optional remote mirror.
 
 The reference uploads `model_%09d.pt` state_dicts to GCS and resumes via
 a --pretrained flag (SURVEY.md §5 "Checkpoint / resume"). Here the full
 TrainState (params + optimizer state + step/version counter) goes
 through an orbax CheckpointManager, so a learner restart resumes
-training exactly — including Adam moments — not just the policy. The
-directory can be local or a gcs:// path (orbax handles both); actors
-never read checkpoints, they get weights over the broker fanout.
+training exactly — including Adam moments — not just the policy. Actors
+never read checkpoints; they get weights over the broker fanout.
+
+Remote durability follows the reference's upload model, as an explicit
+seam: orbax writes the local directory, then `remote_dir` (any epath
+scheme — gs://, s3://, anything fsspec mounts) receives a file-level
+mirror of the finished step, and restore pulls the newest remote step
+down when the local directory is empty (fresh pod, ephemeral disk).
+This is deliberately NOT orbax-writing-straight-to-gs://: the mirror
+copies finished files through epath only, so the remote path is
+testable in-process against fsspec's memory filesystem
+(tests/test_checkpoint_remote.py) instead of being trusted on faith —
+and a half-written step can never appear at the remote (copy starts
+after wait_until_finished, and the step marker file lands last).
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import logging
 from typing import Optional
@@ -27,9 +39,27 @@ class SchemaMismatchError(RuntimeError):
     """Checkpoint was written under a different feature schema."""
 
 
+_STEP_DONE = "MIRROR_COMPLETE"  # marker file, written LAST per mirrored step
+
+
 class Checkpointer:
-    def __init__(self, directory: str, max_to_keep: int = 5):
+    def __init__(self, directory: str, max_to_keep: int = 5, remote_dir: str = ""):
         self._dir = epath.Path(directory)
+        self._remote = epath.Path(remote_dir) if remote_dir else None
+        self._max_to_keep = max_to_keep
+        # Mirroring happens on ONE worker thread: the upload (seconds to
+        # minutes for a big TrainState) must never stall the train loop,
+        # and a single worker keeps uploads ordered so remote GC sees
+        # monotonic steps. wait_until_finished is safe off-thread (orbax's
+        # async manager is thread-safe for waits).
+        self._mirror_pool = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-mirror"
+            )
+            if self._remote is not None
+            else None
+        )
+        self._mirror_futures: list = []
         self._mngr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
@@ -49,9 +79,95 @@ class Checkpointer:
         )
         if wait:
             self._mngr.wait_until_finished()
+        if self._mirror_pool is not None:
+
+            def _wait_and_mirror():
+                self._mngr.wait_until_finished()
+                try:
+                    self._mirror_step(step)
+                except Exception:
+                    _log.exception("remote mirror of step %d failed; continuing", step)
+
+            self._mirror_futures = [f for f in self._mirror_futures if not f.done()]
+            fut = self._mirror_pool.submit(_wait_and_mirror)
+            self._mirror_futures.append(fut)
+            if wait:
+                fut.result()
+
+    # ---------------------------------------------------------- mirroring
+
+    def _copy_tree(self, src: epath.Path, dst: epath.Path) -> None:
+        dst.mkdir(parents=True, exist_ok=True)
+        for child in src.iterdir():
+            if child.is_dir():
+                self._copy_tree(child, dst / child.name)
+            else:
+                (dst / child.name).write_bytes(child.read_bytes())
+
+    def _mirror_step(self, step: int) -> None:
+        """File-level upload of the FINISHED local step dir + schema stamp
+        to remote_dir; the _STEP_DONE marker lands last so a reader never
+        trusts a partially-uploaded step. Mirrors the local max_to_keep GC."""
+        local_step = self._dir / str(step)
+        if not local_step.exists():  # orbax step layout is <dir>/<step>/
+            _log.warning("mirror: local step dir %s missing; skipping", local_step)
+            return
+        remote_step = self._remote / str(step)
+        self._copy_tree(local_step, remote_step)
+        (self._remote / "feature_schema.json").write_text(
+            json.dumps({"feature_schema_version": FEATURE_SCHEMA_VERSION})
+        )
+        (remote_step / _STEP_DONE).write_text("ok")
+        # GC: keep the newest max_to_keep COMPLETE steps; also sweep
+        # UNMARKED step dirs other than the one just written — a crash
+        # mid-upload leaves a markerless dir no future run completes
+        # (steps are monotonic, single writer), and the marker filter in
+        # _remote_steps would otherwise hide it from GC forever.
+        complete = set(self._remote_steps())
+        for child in self._remote.iterdir():
+            if child.name.isdigit() and int(child.name) != step and int(child.name) not in complete:
+                child.rmtree()
+        for old in sorted(complete)[: -self._max_to_keep]:
+            (self._remote / str(old)).rmtree()
+
+    def _remote_steps(self):
+        if self._remote is None or not self._remote.exists():
+            return []
+        out = []
+        for child in self._remote.iterdir():
+            if child.name.isdigit() and (child / _STEP_DONE).exists():
+                out.append(int(child.name))
+        return out
+
+    def pull_latest_remote(self) -> Optional[int]:
+        """Download the newest COMPLETE remote step into the local dir
+        (fresh pod, empty disk). Returns the step, or None."""
+        steps = self._remote_steps()
+        if not steps:
+            return None
+        step = max(steps)
+        src = self._remote / str(step)
+        dst = self._dir / str(step)
+        self._copy_tree(src, dst)
+        (dst / _STEP_DONE).unlink()  # marker is a mirror artifact, not orbax's
+        remote_schema = self._remote / "feature_schema.json"
+        if remote_schema.exists():
+            self._schema_path().write_text(remote_schema.read_text())
+        # CheckpointManager scanned the directory at construction; rebuild
+        # so it sees the pulled step.
+        self._mngr.close()
+        self._mngr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=self._max_to_keep, create=True),
+        )
+        _log.info("pulled remote checkpoint step %d from %s", step, self._remote)
+        return step
 
     def restore_latest(self, template) -> Optional[object]:
         step = self._mngr.latest_step()
+        if step is None and self._remote is not None:
+            if self.pull_latest_remote() is not None:
+                step = self._mngr.latest_step()
         if step is None:
             return None
         p = self._schema_path()
@@ -71,4 +187,6 @@ class Checkpointer:
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
+        if self._mirror_pool is not None:
+            self._mirror_pool.shutdown(wait=True)  # drain pending uploads
         self._mngr.close()

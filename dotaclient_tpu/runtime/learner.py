@@ -231,7 +231,9 @@ class Learner:
         if cfg.checkpoint_dir:
             from dotaclient_tpu.runtime.checkpoint import Checkpointer
 
-            self.checkpointer = Checkpointer(cfg.checkpoint_dir)
+            self.checkpointer = Checkpointer(
+                cfg.checkpoint_dir, remote_dir=cfg.checkpoint_remote_dir
+            )
             restored = self.checkpointer.restore_latest(self.state)
             if restored is not None:
                 self.state = jax.device_put(restored, self.state_shardings)
@@ -285,6 +287,7 @@ class Learner:
         num_steps: Optional[int] = None,
         batch_timeout: float = 60.0,
         max_idle: Optional[int] = None,
+        max_seconds: Optional[float] = None,
     ) -> int:
         """Train until num_steps (None = forever); returns steps done.
 
@@ -292,6 +295,10 @@ class Learner:
         batch waits (None = retry forever, the service default). Drivers
         with a finite budget set it so dead producers surface as an error
         instead of an infinite 'no batch; waiting' loop.
+
+        `max_seconds`: stop cleanly once this much wall clock has elapsed
+        (checked between steps) — for soak/bench drivers with a time
+        budget rather than a step budget.
         """
         cfg = self.cfg
         self.staging.start()
@@ -309,10 +316,23 @@ class Learner:
             # stops the staging/publisher threads (a leaked consumer
             # would silently eat broker frames for the process lifetime).
             self.publish_weights()  # version 0, synchronous, so actors align immediately
-            next_batch, next_env_steps, w, p = self._fetch_next(batch_timeout)
+            deadline = time.monotonic() + max_seconds if max_seconds is not None else None
+
+            def _bt() -> float:
+                # Fetch waits must respect the wall-clock budget, or the
+                # final batch wait overshoots the deadline by up to
+                # batch_timeout (observed: a 35s soak window returning
+                # 120s late because producers had exited).
+                if deadline is None:
+                    return batch_timeout
+                return max(0.05, min(batch_timeout, deadline - time.monotonic()))
+
+            next_batch, next_env_steps, w, p = self._fetch_next(_bt())
             win_wait += w
             win_put += p
             while num_steps is None or done_steps < num_steps:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
                 if next_batch is None:
                     idle += 1
                     if max_idle is not None and idle >= max_idle:
@@ -320,8 +340,10 @@ class Learner:
                             f"no batch for {idle} consecutive {batch_timeout:.0f}s waits "
                             f"— producers dead or stalled"
                         )
+                    if deadline is not None and time.monotonic() >= deadline:
+                        break
                     _log.warning("no batch within %.0fs; waiting", batch_timeout)
-                    next_batch, next_env_steps, w, p = self._fetch_next(batch_timeout)
+                    next_batch, next_env_steps, w, p = self._fetch_next(_bt())
                     win_wait += w
                     win_put += p
                     continue
@@ -341,7 +363,7 @@ class Learner:
                     # Skipped on the final step: a trailing prefetch would
                     # eat (and discard) one packed batch per phased-run
                     # call and could stall up to batch_timeout.
-                    next_batch, next_env_steps, w, p = self._fetch_next(batch_timeout)
+                    next_batch, next_env_steps, w, p = self._fetch_next(_bt())
                     win_wait += w
                     win_put += p
                 else:

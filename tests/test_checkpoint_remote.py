@@ -1,0 +1,149 @@
+"""Remote checkpoint mirror over a NON-LOCAL epath scheme (VERDICT r3
+item 7: the gs:// claim was matched on faith — exercise it).
+
+fsspec's in-process MemoryFileSystem is registered as the `gs` protocol,
+so every `gs://...` epath operation the mirror performs (mkdir, iterdir,
+read/write bytes, rmtree) runs through the SAME epath->fsspec backend
+real GCS uses, minus the network. What this deliberately does NOT claim
+to test: orbax/tensorstore writing arrays straight to GCS — the mirror
+design exists precisely so remote durability doesn't depend on that
+path (runtime/checkpoint.py module docstring).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.parallel.train_step import init_train_state
+from dotaclient_tpu.runtime.checkpoint import Checkpointer, SchemaMismatchError
+
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+@pytest.fixture()
+def gs_memory_fs():
+    """Route gs:// through epath's REAL fsspec backend (the one production
+    uses when tensorflow isn't installed) into an in-process memory
+    filesystem. Only the `gs` prefix is re-pointed — local paths keep
+    their normal backend so orbax's local writes are untouched."""
+    import fsspec
+    from fsspec.implementations.memory import MemoryFileSystem
+    from fsspec.registry import register_implementation
+
+    from etils.epath import backend as backend_lib
+    from etils.epath import gpath
+
+    # Fresh store per test: MemoryFileSystem is class-global.
+    MemoryFileSystem.store.clear()
+    MemoryFileSystem.pseudo_dirs = [""]
+    # epath's fsspec backend resolves gs:// via fsspec.filesystem("gcs")
+    # (note: "gcs", not "gs") and lru-caches the instance — register the
+    # memory FS under both names and clear the cache both ways.
+    prev = {n: fsspec.get_filesystem_class(n) for n in ("gs", "gcs")}
+    for n in ("gs", "gcs"):
+        register_implementation(n, MemoryFileSystem, clobber=True)
+    backend_lib.fsspec_backend._get_filesystem.cache_clear()
+    # epath hard-prefers the tf-gfile backend whenever tensorflow imports
+    # (gpath._backend); production without tf uses the fsspec backend this
+    # test exercises. _PREFIX_TO_BACKEND already maps gs -> fsspec.
+    prev_tf = gpath._is_tf_installed
+    gpath._is_tf_installed = lambda: False
+    try:
+        yield
+    finally:
+        gpath._is_tf_installed = prev_tf
+        for n, cls in prev.items():
+            register_implementation(n, cls, clobber=True)
+        backend_lib.fsspec_backend._get_filesystem.cache_clear()
+        MemoryFileSystem.store.clear()
+
+
+def _state():
+    cfg = LearnerConfig(batch_size=8, seq_len=5, policy=SMALL)
+    return cfg, init_train_state(cfg, jax.random.PRNGKey(3))
+
+
+def _trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_mirror_and_fresh_pod_restore(tmp_path, gs_memory_fs):
+    from etils import epath
+
+    cfg, state = _state()
+    remote = "gs://ckpt-bucket/run1"
+    ck = Checkpointer(str(tmp_path / "local_a"), remote_dir=remote)
+    ck.save(jax.device_get(state), step=7, wait=True)
+    ck.close()
+
+    # The mirror is complete at the remote, marker last.
+    assert (epath.Path(remote) / "7" / "MIRROR_COMPLETE").exists()
+    assert (epath.Path(remote) / "feature_schema.json").exists()
+
+    # Fresh pod: EMPTY local dir, same remote — restore pulls the step.
+    ck2 = Checkpointer(str(tmp_path / "local_b"), remote_dir=remote)
+    restored = ck2.restore_latest(jax.device_get(state))
+    assert restored is not None
+    # The manager's step LABEL (not state.step, which is 0 for a fresh
+    # init on both sides and would compare vacuously).
+    assert ck2.latest_step() == 7
+    _trees_equal(restored.params, state.params)
+    _trees_equal(restored.opt_state, state.opt_state)
+    ck2.close()
+
+
+def test_remote_gc_keeps_newest(tmp_path, gs_memory_fs):
+    from etils import epath
+
+    cfg, state = _state()
+    remote = "gs://ckpt-bucket/run2"
+    ck = Checkpointer(str(tmp_path / "l"), max_to_keep=2, remote_dir=remote)
+    host = jax.device_get(state)
+    for step in (1, 2, 3):
+        ck.save(host, step=step, wait=True)
+    ck.close()
+    steps = sorted(
+        int(c.name) for c in epath.Path(remote).iterdir() if c.name.isdigit()
+    )
+    assert steps == [2, 3]
+
+
+def test_incomplete_remote_step_is_ignored(tmp_path, gs_memory_fs):
+    """A step dir without the MIRROR_COMPLETE marker (upload died midway)
+    must never be pulled."""
+    from etils import epath
+
+    cfg, state = _state()
+    remote = "gs://ckpt-bucket/run3"
+    ck = Checkpointer(str(tmp_path / "la"), remote_dir=remote)
+    ck.save(jax.device_get(state), step=4, wait=True)
+    ck.close()
+    # Forge a NEWER but incomplete remote step.
+    bogus = epath.Path(remote) / "9"
+    bogus.mkdir(parents=True)
+    (bogus / "half_written").write_text("x")
+
+    ck2 = Checkpointer(str(tmp_path / "lb"), remote_dir=remote)
+    restored = ck2.restore_latest(jax.device_get(state))
+    assert restored is not None
+    assert ck2.latest_step() == 4  # the complete step, NOT the forged 9
+    ck2.close()
+
+
+def test_remote_schema_guard(tmp_path, gs_memory_fs):
+    from etils import epath
+
+    cfg, state = _state()
+    remote = "gs://ckpt-bucket/run4"
+    ck = Checkpointer(str(tmp_path / "x"), remote_dir=remote)
+    ck.save(jax.device_get(state), step=1, wait=True)
+    ck.close()
+    (epath.Path(remote) / "feature_schema.json").write_text(
+        '{"feature_schema_version": -1}'
+    )
+    ck2 = Checkpointer(str(tmp_path / "y"), remote_dir=remote)
+    with pytest.raises(SchemaMismatchError):
+        ck2.restore_latest(jax.device_get(state))
+    ck2.close()

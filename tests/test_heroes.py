@@ -78,8 +78,8 @@ def test_featurizer_exposes_hero_identity():
     obs_axe = F.featurize(w_axe, 0)
     w_lina = svc.reset(pick_cfg("npc_dota_hero_lina", "npc_dota_hero_lina")).world_state
     obs_lina = F.featurize(w_lina, 0)
-    # hero-id code lives at [20:28] since the ability features landed at [16:20]
-    id_axe, id_lina = obs_axe.hero_feats[20:28], obs_lina.hero_feats[20:28]
+    # hero-id code lives at [29:37] after the 4-slot ability block [16:29]
+    id_axe, id_lina = obs_axe.hero_feats[29:37], obs_lina.hero_feats[29:37]
     np.testing.assert_array_equal(id_axe, heroes.hero_id_features("npc_dota_hero_axe"))
     assert not np.array_equal(id_axe, id_lina)
 

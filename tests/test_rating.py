@@ -98,3 +98,74 @@ def test_extreme_upset_no_nan():
     w, l = rate_1v1(Rating(0.0, 0.5), Rating(50.0, 0.5))
     assert math.isfinite(w.mu) and math.isfinite(w.sigma)
     assert w.sigma > 0 and l.sigma > 0
+
+
+# ---------------------------------------------------------------- teams
+
+from dotaclient_tpu.eval import rating as R  # noqa: E402
+
+
+def test_rate_teams_1v1_reduces_to_rate_1v1():
+    """The two-team closed form at n=1 per side IS the 1v1 rule."""
+    a, b = R.Rating(27.0, 7.0), R.Rating(24.0, 6.0)
+    w1, l1 = R.rate_1v1(a, b)
+    (w2,), (l2,) = R.rate_teams([a], [b])
+    assert abs(w1.mu - w2.mu) < 1e-12 and abs(w1.sigma - w2.sigma) < 1e-12
+    assert abs(l1.mu - l2.mu) < 1e-12 and abs(l1.sigma - l2.sigma) < 1e-12
+
+
+def test_rate_teams_5v5_moves_teams_and_shrinks_sigma():
+    win = [R.Rating() for _ in range(5)]
+    lose = [R.Rating() for _ in range(5)]
+    new_w, new_l = R.rate_teams(win, lose)
+    assert all(n.mu > o.mu for n, o in zip(new_w, win))
+    assert all(n.mu < o.mu for n, o in zip(new_l, lose))
+    assert all(n.sigma < o.sigma for n, o in zip(new_w + new_l, win + lose))
+
+
+def test_rate_teams_uncertain_player_moves_most():
+    """Partial-play credit: the uncertain teammate absorbs more of the
+    team evidence than the established one."""
+    veteran = R.Rating(25.0, 2.0)
+    rookie = R.Rating(25.0, 8.0)
+    (new_vet, new_rookie), _ = R.rate_teams([veteran, rookie], [R.Rating(), R.Rating()])
+    assert (new_rookie.mu - rookie.mu) > (new_vet.mu - veteran.mu) * 2
+
+
+def test_rate_teams_upset_moves_more_than_expected_win():
+    strong = [R.Rating(30.0, 4.0) for _ in range(2)]
+    weak = [R.Rating(20.0, 4.0) for _ in range(2)]
+    up_w, _ = R.rate_teams([r for r in weak], [r for r in strong])  # upset
+    ex_w, _ = R.rate_teams([r for r in strong], [r for r in weak])  # expected
+    assert (up_w[0].mu - weak[0].mu) > (ex_w[0].mu - strong[0].mu)
+
+
+def test_rate_teams_fix_losers_and_validation():
+    import pytest
+
+    win = [R.Rating(), R.Rating()]
+    lose = [R.Rating(26.0, 3.0), R.Rating(24.0, 3.0)]
+    _, kept = R.rate_teams(win, lose, fix_losers=True)
+    assert kept[0] is lose[0] and kept[1] is lose[1]
+    with pytest.raises(ValueError):
+        R.rate_teams([], lose)
+
+
+def test_team_win_probability_reduces_and_orders():
+    a, b = R.Rating(28.0, 3.0), R.Rating(24.0, 3.0)
+    assert abs(R.team_win_probability([a], [b]) - R.win_probability(a, b)) < 1e-12
+    strong = [R.Rating(28.0, 3.0)] * 5
+    weak = [R.Rating(22.0, 3.0)] * 5
+    assert R.team_win_probability(strong, weak) > 0.7
+
+
+def test_record_teams_respects_anchors():
+    t = R.RatingTable()
+    for n in ("a1", "a2", "b1"):
+        t.add(n)
+    t.add("bot", anchored=True)
+    before_bot = t.get("bot")
+    t.record_teams(["a1", "a2"], ["b1", "bot"])
+    assert t.get("bot") is before_bot  # anchored: unchanged
+    assert t.get("a1").mu > R.MU and t.get("b1").mu < R.MU
+    assert t.games["bot"] == 1

@@ -28,8 +28,8 @@ def test_parse_mesh_spec():
         mesh_lib.parse_mesh_spec("dp=-1,tp=-1", 8)
 
 
-def run_steps(mesh_spec, n_steps=3, seed=7):
-    cfg = make_cfg()
+def run_steps(mesh_spec, n_steps=3, seed=7, cfg=None):
+    cfg = cfg if cfg is not None else make_cfg()
     mesh = mesh_lib.make_mesh(mesh_spec)
     train_step, state_sh, _ = build_train_step(cfg, mesh)
     state = init_train_state(cfg, jax.random.PRNGKey(0))
@@ -70,9 +70,47 @@ def test_dp_tp_mesh_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
 
 
-def test_loss_decreases_on_fixed_batch():
-    _, ms = run_steps("dp=-1", n_steps=12)
-    assert float(ms[-1]["loss"]) < float(ms[0]["loss"])
+def test_loss_decreases_on_fixed_objective():
+    """Optimizer-wiring check: repeated updates on a FIXED objective must
+    descend.
+
+    The full train step recomputes GAE from the updating value function
+    every call, so its per-step loss chases a moving target and descent
+    on a replayed batch is NOT an invariant (it held for 12 steps by seed
+    luck until the v3 featurizer shifted the RNG stream; the entropy
+    bonus and the PPO2 value-clip term — pinned near stale behavior
+    values — both legitimately RISE as learning proceeds). The fixed
+    objective the framework actually exposes is the sample-reuse loss:
+    advantages/returns frozen by precompute_reuse, exactly what the
+    epochs x minibatches loop optimizes. End-to-end learning itself is
+    asserted by the closed-loop smokes in test_learning.py."""
+    import optax
+
+    from dotaclient_tpu.models.policy import PolicyNet, init_params
+    from dotaclient_tpu.ops.ppo import ppo_minibatch_loss, precompute_reuse
+    from dotaclient_tpu.parallel.train_step import make_optimizer
+
+    cfg = make_cfg()
+    net = PolicyNet(cfg.policy)
+    params = init_params(cfg.policy, jax.random.PRNGKey(0))
+    batch = jax.tree.map(jnp.asarray, make_train_batch(cfg, rng_seed=7))
+    rb = precompute_reuse(params, net.apply, batch, cfg.ppo)
+    opt = make_optimizer(cfg)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        (loss, _), grads = jax.value_and_grad(ppo_minibatch_loss, has_aux=True)(
+            params, net.apply, rb, cfg.ppo
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(24):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), (losses[:3], losses[-3:])
 
 
 def test_tp_params_actually_sharded():

@@ -86,7 +86,8 @@ def test_golden_featurization_of_real_schema():
     assert abs(hf[10] - 0.5) < 1e-6  # attack range 500/1000
     assert abs(hf[12] - np.log1p(630) / 10.0) < 1e-5  # gold (reliable+unreliable)
     assert abs(hf[14] - 0.28) < 1e-6  # last hits 28/100
-    assert hf[19] == 1.0  # ability ready (is_fully_castable)
+    assert hf[28] == 1.0  # any-ability-castable summary (v3 layout)
+    assert hf[16] == 1.0  # slot-0 ready (is_fully_castable)
     # 3 enemies (sniper + 2 creeps) → all legal targets, CAST legal
     assert obs.unit_mask.sum() == 3 and obs.target_mask.sum() == 3
     assert obs.action_mask.tolist() == [True, True, True, True]
@@ -98,8 +99,8 @@ def test_golden_featurization_of_real_schema():
 def test_cooldown_masks_cast_through_adapter():
     obs = F.featurize(VA.world_from_valve(valve_world(cooldown=4.0)), player_id=0)
     assert not obs.action_mask[F.ACT_CAST]
-    assert obs.hero_feats[19] == 0.0
-    assert abs(obs.hero_feats[17] - 0.4) < 1e-6  # cooldown 4s/10
+    assert obs.hero_feats[28] == 0.0  # any-castable summary (v3 layout)
+    assert abs(obs.hero_feats[17] - 0.4) < 1e-6  # slot-0 cooldown 4s/10
 
 
 def test_rewards_run_on_adapted_worlds():
@@ -173,12 +174,14 @@ def test_world_round_trip_preserves_featurization():
     b, _ = F.featurize_with_handles(w1, 0)
     for x, y, name in zip(a, b, a._fields):
         if name == "hero_feats":
-            # hero_feats[18] (ability mana cost) is the one knowingly lossy
-            # field: Valve's worldstate carries no mana costs — the cost
-            # gate arrives folded into is_fully_castable instead
-            np.testing.assert_allclose(x[:18], y[:18], atol=1e-5, err_msg=name)
-            np.testing.assert_allclose(x[19:], y[19:], atol=1e-5, err_msg=name)
-            assert y[18] == 0.0
+            # Ability mana-cost features (slot s at 16+3s+2) are the one
+            # knowingly lossy group: Valve's worldstate carries no mana
+            # costs — the cost gate arrives folded into is_fully_castable
+            # instead.
+            cost_idx = [16 + 3 * s + 2 for s in range(F.N_ABILITY_SLOTS)]
+            keep = [i for i in range(F.HERO_FEATURES) if i not in cost_idx]
+            np.testing.assert_allclose(x[keep], y[keep], atol=1e-5, err_msg=name)
+            assert all(y[i] == 0.0 for i in cost_idx)
         else:
             np.testing.assert_allclose(x, y, atol=1e-5, err_msg=name)
 
