@@ -85,22 +85,38 @@ class FusedBatchIO:
             )
             cols[key] = cols.get(key, 0) + n
         self.group_cols = cols
+        # pack() accepts exactly this many rows; defaults to the template
+        # (global) batch. Multihost learners set it to their per-process
+        # share so a mis-sized batch still fails AT THE PACK BOUNDARY
+        # with a named count, not downstream as an opaque jit/assembly
+        # shape error.
+        self.local_rows = B
         dp = "dp" if "dp" in mesh.axis_names else None
         self.shardings = {k: NamedSharding(mesh, P(dp, None)) for k in cols}
 
     # ----------------------------------------------------------- host side
 
     def pack(self, batch) -> Dict[str, np.ndarray]:
-        """TrainBatch (numpy leaves) → {group: [B, cols] contiguous}.
+        """TrainBatch (numpy leaves) → {group: [rows, cols] contiguous}.
         One memcpy per leaf; runs on the learner fetch path, overlapped
-        with the in-flight device step."""
+        with the in-flight device step. Rows come from the INPUT, not the
+        template: in multihost mode each process packs its LOCAL share
+        (global_batch / process_count rows) and the learner stitches the
+        shares into the global array (runtime/learner.py _fetch_next)."""
         leaves = jax.tree.leaves(batch)
+        rows = np.asarray(leaves[0]).shape[0]
+        if rows != self.local_rows:
+            raise ValueError(
+                f"fused pack: got {rows} rows, expected {self.local_rows} "
+                f"(template batch {self.batch}; multihost learners set "
+                f"local_rows to their per-process share)"
+            )
         out = {}
         for key, slots in self.slots.items():
-            buf = np.empty((self.batch, self.group_cols[key]), dtype=_GROUP_DTYPES[key])
+            buf = np.empty((rows, self.group_cols[key]), dtype=_GROUP_DTYPES[key])
             for s in slots:
                 leaf = np.asarray(leaves[s.index])
-                buf[:, s.start : s.start + s.cols] = leaf.reshape(self.batch, -1).astype(
+                buf[:, s.start : s.start + s.cols] = leaf.reshape(rows, -1).astype(
                     buf.dtype, copy=False
                 )
             out[key] = buf

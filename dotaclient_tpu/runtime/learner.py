@@ -216,7 +216,49 @@ class Learner:
         self.boot_epoch = (int(time.time()) << 8 ^ os.getpid()) & 0xFFFFFFFF
         state = init_train_state(cfg, jax.random.PRNGKey(cfg.seed))
         self.state: TrainState = jax.device_put(state, self.state_shardings)
-        self.staging = StagingBuffer(cfg, broker, version_fn=lambda: self.version)
+        # Multi-process (--multihost over DCN): batch_size stays GLOBAL;
+        # each process's staging packs its share and _fetch_next stitches
+        # the shares into one global array (standard multihost DP). The
+        # broker is a SHARED cluster service (k8s: one broker every actor
+        # and every learner host connects to): experience consumption
+        # splits the shared queue across hosts, and weight publishing is
+        # gated to process 0 so the fanout carries ONE frame per version
+        # — a topology with per-host private brokers would starve
+        # non-primary hosts' actors of weights and, once the version
+        # outran max_staleness, deadlock the cluster in the collectives.
+        self._n_proc = jax.process_count()
+        self._primary = jax.process_index() == 0
+        staging_cfg = cfg
+        if self._n_proc > 1:
+            import copy
+
+            if cfg.batch_size % self._n_proc:
+                raise ValueError(
+                    f"batch_size={cfg.batch_size} must divide by the process "
+                    f"count ({self._n_proc}) — each host stages an equal share"
+                )
+            # The dp axis must span the processes: each process's
+            # addressable dp shards are where its local rows land. A
+            # tp-only / replicated-batch mesh would make the per-process
+            # shares incoherent under one 'replicated' global array.
+            dp_size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("dp", 1)
+            if dp_size % self._n_proc:
+                raise ValueError(
+                    f"multihost needs the mesh dp axis to span the processes: "
+                    f"dp={dp_size} not divisible by process count {self._n_proc} "
+                    f"(mesh {cfg.mesh_shape!r})"
+                )
+            if cfg.broker_url.startswith("mem://"):
+                _log.warning(
+                    "multihost with mem:// broker: in-process queues cannot span "
+                    "hosts — fine for tests, wrong for production (use tcp://"
+                    "or amqp:// shared by all hosts)"
+                )
+            staging_cfg = copy.deepcopy(cfg)
+            staging_cfg.batch_size = cfg.batch_size // self._n_proc
+            if self.fused_io is not None:
+                self.fused_io.local_rows = staging_cfg.batch_size
+        self.staging = StagingBuffer(staging_cfg, broker, version_fn=lambda: self.version)
         self.flattener = ParamFlattener(state.params)
         self.publisher = WeightPublisher(
             broker, materialize=self.flattener.to_named, boot_epoch=self.boot_epoch
@@ -231,18 +273,42 @@ class Learner:
         if cfg.checkpoint_dir:
             from dotaclient_tpu.runtime.checkpoint import Checkpointer
 
+            # Remote mirror from process 0 only: with replicated params
+            # process 0 holds the full state; per-host duplicate uploads
+            # would race on the same remote paths.
             self.checkpointer = Checkpointer(
-                cfg.checkpoint_dir, remote_dir=cfg.checkpoint_remote_dir
+                cfg.checkpoint_dir,
+                remote_dir=cfg.checkpoint_remote_dir if self._primary else "",
             )
             restored = self.checkpointer.restore_latest(self.state)
             if restored is not None:
                 self.state = jax.device_put(restored, self.state_shardings)
                 self.version = int(jax.device_get(restored.step))
                 _log.info("restored checkpoint at step %d", self.version)
+        if self._n_proc > 1:
+            # Restore is per-process and a partial host restart (one pod
+            # with a fresh disk) would leave processes at DIFFERENT
+            # steps/params inside one SPMD program — divergent reuse-loop
+            # permutations, garbage gradients, no error. Refuse to start
+            # unless every process agrees on the resume step.
+            from jax.experimental import multihost_utils
+
+            steps = np.asarray(
+                multihost_utils.process_allgather(np.int64(self.version))
+            ).reshape(-1)
+            if len(set(int(s) for s in steps)) != 1:
+                raise RuntimeError(
+                    f"multihost restore mismatch: per-process resume steps "
+                    f"{steps.tolist()} — restore every host from the same "
+                    f"checkpoint (shared checkpoint_dir or remote mirror) "
+                    f"before starting"
+                )
 
     # ---------------------------------------------------------------- ops
 
     def publish_weights(self) -> None:
+        if not self._primary:
+            return  # one fanout per version — process 0 publishes
         params = jax.device_get(self.state.params)
         frame = serialize_weights(
             flatten_params(params), version=self.version, boot_epoch=self.boot_epoch
@@ -277,9 +343,26 @@ class Learner:
             # folding host packing into it would poison that comparison.
             groups = self.fused_io.pack(batch)
             t2 = time.perf_counter()
-            batch_dev = jax.device_put(groups, self.fused_io.shardings)
+            if self._n_proc > 1:
+                # Each process contributes its local rows; the result is
+                # ONE global array per buffer whose dp shards live where
+                # each host put them — no cross-host data movement.
+                batch_dev = jax.tree.map(
+                    lambda arr, sh: jax.make_array_from_process_local_data(sh, arr),
+                    groups,
+                    self.fused_io.shardings,
+                )
+            else:
+                batch_dev = jax.device_put(groups, self.fused_io.shardings)
             return batch_dev, env_steps, t2 - t0, time.perf_counter() - t2
-        batch_dev = jax.device_put(batch, self.batch_sharding)
+        if self._n_proc > 1:
+            batch_dev = jax.tree.map(
+                lambda arr, sh: jax.make_array_from_process_local_data(sh, np.asarray(arr)),
+                batch,
+                self.batch_sharding,
+            )
+        else:
+            batch_dev = jax.device_put(batch, self.batch_sharding)
         return batch_dev, env_steps, t1 - t0, time.perf_counter() - t1
 
     def run(
@@ -369,12 +452,14 @@ class Learner:
                 else:
                     next_batch, next_env_steps = None, 0
 
-                if self.version % cfg.publish_every == 0:
+                if self.version % cfg.publish_every == 0 and self._primary:
                     # One async on-device flatten dispatch; the blocking
                     # host read of the single buffer happens on the
                     # publisher thread. Donation-safe because this
                     # dispatch precedes the next (state-donating) train
                     # step in stream order (ParamFlattener docstring).
+                    # Non-primary processes skip: weights are replicated
+                    # and one fanout per version is the contract.
                     self.publisher.submit(
                         self.flattener.flatten_on_device(self.state.params), self.version
                     )

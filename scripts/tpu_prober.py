@@ -196,6 +196,14 @@ def main(argv=None) -> int:
                 None,
                 ["PALLAS_PARITY_TPU.json"],
             ),
+            (
+                "transformer-family device bench",
+                [sys.executable, "scripts/bench_tf.py", "--out", "TF_BENCH.json"],
+                {},
+                1500.0,
+                None,
+                ["TF_BENCH.json"],
+            ),
         ]
         for name, cmd, env_extra, timeout_s, out_path, artifacts in tasks:
             t_ok, t_detail = _run_task(cmd, env_extra, timeout_s, out_path)
