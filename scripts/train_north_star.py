@@ -66,6 +66,12 @@ def parse_args(argv=None):
     p.add_argument("--max_minutes", type=float, default=90.0)
     p.add_argument("--n_actors", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
+    # PPO sample reuse (r4): more gradient steps per consumed env-step.
+    # The r3 artifacts (925/950 updates to PASS) ran at 1/1; the reuse
+    # A/B showed 3.6x better return per env-step at 2x2+kl_stop.
+    p.add_argument("--ppo_epochs", type=int, default=1)
+    p.add_argument("--ppo_minibatches", type=int, default=1)
+    p.add_argument("--ppo_kl_stop", type=float, default=0.0)
     return p.parse_args(argv)
 
 
@@ -84,6 +90,9 @@ def main(argv=None) -> int:
     )
     lcfg.ppo.lr = 1e-3
     lcfg.ppo.entropy_coef = 0.005
+    lcfg.ppo.epochs = args.ppo_epochs
+    lcfg.ppo.minibatches = args.ppo_minibatches
+    lcfg.ppo.kl_stop = args.ppo_kl_stop
     stop = threading.Event()
 
     def actor_thread(i: int):
@@ -191,7 +200,14 @@ def main(argv=None) -> int:
         "rate >= 0.55 over the last two evals (see module docstring for why",
         "both).",
         "",
-        f"Reproduce: `python scripts/train_north_star.py --seed {args.seed}`",
+        f"Reproduce: `python scripts/train_north_star.py --seed {args.seed}"
+        + (
+            f" --ppo_epochs {args.ppo_epochs} --ppo_minibatches {args.ppo_minibatches}"
+            f" --ppo_kl_stop {args.ppo_kl_stop}"
+            if args.ppo_epochs * args.ppo_minibatches > 1 or args.ppo_kl_stop > 0
+            else ""
+        )
+        + "`",
     ]
     with open(os.path.join(args.out_dir, "NORTH_STAR.md"), "w") as f:
         f.write("\n".join(summary) + "\n")
