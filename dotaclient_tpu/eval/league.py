@@ -129,7 +129,14 @@ class League:
     # -------------------------------------------------------------- results
 
     def record_result(self, opponent: str, win: float) -> None:
-        """win > 0: agent beat `opponent`; < 0: lost; == 0: decided draw."""
+        """win > 0: agent beat `opponent`; < 0: lost; == 0: decided draw.
+
+        Head-to-head on purpose, even for 5v5: a league match is ONE
+        policy (controlling its whole team) against ONE frozen snapshot,
+        so the entities being rated are the policies — the two-team
+        partial-play update (rating.rate_teams / record_teams) is for
+        rosters whose members carry separate ratings (mixed-snapshot
+        teams, per-hero ratings), which this league never forms."""
         if opponent not in self._snapshots:
             return  # opponent already evicted — rating signal is stale
         if win > 0:

@@ -35,7 +35,14 @@ DOCS = _docs()
 
 def test_manifests_exist():
     names = {p.name for p in MANIFESTS}
-    assert {"broker.yaml", "learner.yaml", "actors.yaml", "evaluator.yaml", "rabbitmq.yaml"} <= names
+    assert {
+        "broker.yaml",
+        "learner.yaml",
+        "learner-multihost.yaml",
+        "actors.yaml",
+        "evaluator.yaml",
+        "rabbitmq.yaml",
+    } <= names
     assert (K8S / "Dockerfile").exists()
 
 
