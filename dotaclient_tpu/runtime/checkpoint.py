@@ -43,9 +43,22 @@ _STEP_DONE = "MIRROR_COMPLETE"  # marker file, written LAST per mirrored step
 
 
 class Checkpointer:
-    def __init__(self, directory: str, max_to_keep: int = 5, remote_dir: str = ""):
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 5,
+        remote_dir: str = "",
+        remote_push: bool = True,
+    ):
+        """`remote_push=False` makes the remote READ-ONLY for this
+        process: restores still pull the newest complete step, but saves
+        never mirror up. Multihost learners set it on non-primary
+        processes — every host must be able to pull the shared mirror on
+        restart (or the resume-step consistency check trips), while only
+        process 0 uploads."""
         self._dir = epath.Path(directory)
         self._remote = epath.Path(remote_dir) if remote_dir else None
+        self._remote_push = remote_push
         self._max_to_keep = max_to_keep
         # Mirroring happens on ONE worker thread: the upload (seconds to
         # minutes for a big TrainState) must never stall the train loop,
@@ -56,7 +69,7 @@ class Checkpointer:
             concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-mirror"
             )
-            if self._remote is not None
+            if self._remote is not None and remote_push
             else None
         )
         self._mirror_futures: list = []

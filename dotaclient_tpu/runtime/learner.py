@@ -273,12 +273,14 @@ class Learner:
         if cfg.checkpoint_dir:
             from dotaclient_tpu.runtime.checkpoint import Checkpointer
 
-            # Remote mirror from process 0 only: with replicated params
-            # process 0 holds the full state; per-host duplicate uploads
-            # would race on the same remote paths.
+            # Every process can PULL the shared mirror (a restarted
+            # non-primary pod must restore the same step or the
+            # consistency check below trips); only process 0 PUSHES —
+            # per-host duplicate uploads would race on the remote paths.
             self.checkpointer = Checkpointer(
                 cfg.checkpoint_dir,
-                remote_dir=cfg.checkpoint_remote_dir if self._primary else "",
+                remote_dir=cfg.checkpoint_remote_dir,
+                remote_push=self._primary,
             )
             restored = self.checkpointer.restore_latest(self.state)
             if restored is not None:

@@ -147,3 +147,27 @@ def test_remote_schema_guard(tmp_path, gs_memory_fs):
     with pytest.raises(SchemaMismatchError):
         ck2.restore_latest(jax.device_get(state))
     ck2.close()
+
+
+def test_remote_push_false_pulls_but_never_uploads(tmp_path, gs_memory_fs):
+    """Non-primary multihost processes: read-only remote — restores pull
+    the shared mirror (so every host resumes the same step), saves never
+    upload (process 0 owns the push)."""
+    from etils import epath
+
+    cfg, state = _state()
+    remote = "gs://ckpt-bucket/run5"
+    # primary writes the mirror
+    ck = Checkpointer(str(tmp_path / "prim"), remote_dir=remote)
+    ck.save(jax.device_get(state), step=3, wait=True)
+    ck.close()
+
+    # non-primary: pulls on restore...
+    ck2 = Checkpointer(str(tmp_path / "np"), remote_dir=remote, remote_push=False)
+    restored = ck2.restore_latest(jax.device_get(state))
+    assert restored is not None and ck2.latest_step() == 3
+    # ...but its own save must NOT push a new remote step
+    ck2.save(jax.device_get(state), step=9, wait=True)
+    ck2.close()
+    steps = sorted(int(c.name) for c in epath.Path(remote).iterdir() if c.name.isdigit())
+    assert steps == [3], steps

@@ -132,6 +132,35 @@ def test_learner_requests_tpu():
     assert any("tpu" in k for k in sel), "learner must pin to the TPU node pool"
 
 
+def test_multihost_learner_slice_consistency():
+    """The multi-host manifest must form a coherent slice: one pod per
+    host (replicas > 1, Parallel start so the cluster can assemble), a
+    TPU nodeSelector, the --multihost flag, and a headless Service of
+    the same name for per-pod DNS (cluster formation)."""
+    (_, doc), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "learner-multihost" and d["kind"] == "StatefulSet"
+    ]
+    assert doc["spec"]["replicas"] > 1
+    assert doc["spec"].get("podManagementPolicy") == "Parallel"
+    pod = doc["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["resources"]["requests"].get("google.com/tpu")
+    assert any("tpu" in k for k in pod.get("nodeSelector", {}))
+    args = c.get("args", [])
+    assert "--multihost" in args and args[args.index("--multihost") + 1] == "true"
+    svc = [
+        d for f, d in DOCS
+        if d["kind"] == "Service" and d["metadata"]["name"] == doc["spec"]["serviceName"]
+    ]
+    assert svc, "multihost StatefulSet's serviceName must reference a defined Service"
+    # k8s headless convention: the literal string "None" (YAML `None` is
+    # a plain string, which is exactly what the API expects here).
+    assert svc[0]["spec"].get("clusterIP") == "None", (
+        "multihost Service must be HEADLESS (clusterIP: None) for per-pod DNS"
+    )
+
+
 def test_actor_fleet_scale_and_kill_switch():
     (_, doc), = [(f, d) for f, d in DOCS if d["metadata"]["name"] == "actors"]
     assert doc["spec"]["replicas"] >= 2
