@@ -178,9 +178,18 @@ class Checkpointer:
 
     def restore_latest(self, template) -> Optional[object]:
         step = self._mngr.latest_step()
-        if step is None and self._remote is not None:
-            if self.pull_latest_remote() is not None:
-                step = self._mngr.latest_step()
+        if self._remote is not None:
+            # Pull when the remote holds a NEWER complete step, not only
+            # when local is empty: after a mid-save crash a host whose
+            # container restarted in place (emptyDir intact) can hold a
+            # stale local step — resuming from it would trip the
+            # multihost resume-consistency guard forever while the fix
+            # sits one pull away in the mirror.
+            remote_steps = self._remote_steps()
+            newest_remote = max(remote_steps) if remote_steps else None
+            if newest_remote is not None and (step is None or newest_remote > step):
+                if self.pull_latest_remote() is not None:
+                    step = self._mngr.latest_step()
         if step is None:
             return None
         p = self._schema_path()

@@ -113,6 +113,7 @@ class SelfPlayActor:
         self.episodes_done = 0
         self.rollouts_published = 0
         self.last_win: Optional[float] = None  # radiant (live) perspective
+        self.last_heroes: list = []  # live side's pool draws, last episode
         self.last_weight_time = time.monotonic()  # kill-switch clock
         self.league: Optional[League] = None
         if cfg.opponent == "league":
@@ -213,6 +214,11 @@ class SelfPlayActor:
             ],
         )
         resp = await self.stub.reset(config)
+        # Telemetry: which pool heroes the LIVE side drew this episode
+        # (hero-pool runs attribute per-hero returns — BASELINE config 3).
+        self.last_heroes = [
+            p.hero_name for p in config.hero_picks if p.team_id == TEAM_RADIANT
+        ]
         sides: Dict[int, _Side] = {}
         for pid in rad_pids:
             sides[pid] = _Side(pid, TEAM_RADIANT, cfg)

@@ -171,3 +171,27 @@ def test_remote_push_false_pulls_but_never_uploads(tmp_path, gs_memory_fs):
     ck2.close()
     steps = sorted(int(c.name) for c in epath.Path(remote).iterdir() if c.name.isdigit())
     assert steps == [3], steps
+
+
+def test_stale_local_reconciles_with_newer_remote(tmp_path, gs_memory_fs):
+    """A host whose container restarted in place can hold a STALE local
+    step while the mirror has a newer complete one (mid-save crash on a
+    multihost slice). Restore must pull the newer remote step, or the
+    resume-consistency guard crash-loops the cluster forever."""
+    cfg, state = _state()
+    remote = "gs://ckpt-bucket/run6"
+    host = jax.device_get(state)
+    # The lagging host: saved step 2 locally BEFORE the mirror existed.
+    lag = Checkpointer(str(tmp_path / "lag"))
+    lag.save(host, step=2, wait=True)
+    lag.close()
+    # The primary meanwhile mirrored step 5.
+    prim = Checkpointer(str(tmp_path / "prim"), remote_dir=remote)
+    prim.save(host, step=5, wait=True)
+    prim.close()
+    # Lagging host restarts WITH its stale local dir and the shared remote.
+    lag2 = Checkpointer(str(tmp_path / "lag"), remote_dir=remote, remote_push=False)
+    restored = lag2.restore_latest(host)
+    assert restored is not None
+    assert lag2.latest_step() == 5, "must reconcile to the newer remote step"
+    lag2.close()
