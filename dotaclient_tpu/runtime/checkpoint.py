@@ -152,17 +152,33 @@ class Checkpointer:
                 out.append(int(child.name))
         return out
 
-    def pull_latest_remote(self) -> Optional[int]:
-        """Download the newest COMPLETE remote step into the local dir
-        (fresh pod, empty disk). Returns the step, or None."""
-        steps = self._remote_steps()
+    def pull_latest_remote(self, steps=None) -> Optional[int]:
+        """Download the newest COMPLETE remote step into the local dir.
+        Returns the step, or None. `steps` lets restore_latest pass the
+        listing it already paid for (remote LIST + per-step marker checks
+        are round trips on real object stores).
+
+        The download lands in a dot-prefixed temp dir and RENAMES into
+        place: an interrupted pull must never leave a partial dir under
+        the final step name — orbax would list it as a finalized step,
+        local latest would equal newest remote, the re-pull gate would
+        never fire again, and restore would crash-loop with the good
+        checkpoint one pull away (r4 review finding)."""
+        if steps is None:
+            steps = self._remote_steps()
         if not steps:
             return None
         step = max(steps)
         src = self._remote / str(step)
+        tmp = self._dir / f".pull_{step}"  # dot-prefixed: invisible to orbax's step scan
+        if tmp.exists():
+            tmp.rmtree()  # leftover from an interrupted pull
+        self._copy_tree(src, tmp)
+        (tmp / _STEP_DONE).unlink()  # marker is a mirror artifact, not orbax's
         dst = self._dir / str(step)
-        self._copy_tree(src, dst)
-        (dst / _STEP_DONE).unlink()  # marker is a mirror artifact, not orbax's
+        if dst.exists():
+            dst.rmtree()  # stale/partial local copy loses to the verified pull
+        tmp.rename(dst)
         remote_schema = self._remote / "feature_schema.json"
         if remote_schema.exists():
             self._schema_path().write_text(remote_schema.read_text())
@@ -188,7 +204,7 @@ class Checkpointer:
             remote_steps = self._remote_steps()
             newest_remote = max(remote_steps) if remote_steps else None
             if newest_remote is not None and (step is None or newest_remote > step):
-                if self.pull_latest_remote() is not None:
+                if self.pull_latest_remote(steps=remote_steps) is not None:
                     step = self._mngr.latest_step()
         if step is None:
             return None

@@ -1,0 +1,96 @@
+"""In-process closed-loop harness: N actor threads, each with a private
+asyncio loop, around one learner.
+
+Every local driver (scripts/train_north_star.py, train_league.py,
+train_hero_pool.py, ab_ppo_reuse.py, ab_cast.py) and the learning smokes
+(tests/test_learning.py) run the same shape: spawn N daemon threads,
+each building one actor and looping run_episode until a stop event,
+with its own event loop (actors are asyncio; threads may not share
+loops), then join with a bounded timeout so a wedged episode can't hang
+teardown. That scaffold used to be copy-pasted per driver — five
+drifting copies of the one piece where a fix MUST propagate (r4 review
+finding). This is the single copy.
+
+The parts that legitimately differ per driver — configs, which Actor
+class, what to record per episode — stay in the drivers: `make_actor(i)`
+builds the actor, `on_episode(i, actor, ret)` observes each completed
+episode (called from the actor's thread; synchronize your own state).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Callable, List, Optional
+
+_log = logging.getLogger(__name__)
+
+
+class ActorPool:
+    """N actor threads looping run_episode() until stop().
+
+    `make_actor(i) -> actor` runs INSIDE thread i (actors build jit
+    closures; building them on the owning thread keeps any thread-local
+    state sane). Actors are appended to `self.actors` as they come up.
+    A crashed actor thread logs its traceback and exits — the pool
+    never silently swallows a death (`dead` counts them for drivers
+    that want to fail loudly).
+    """
+
+    def __init__(
+        self,
+        make_actor: Callable[[int], object],
+        n_actors: int,
+        on_episode: Optional[Callable[[int, object, float], None]] = None,
+    ):
+        self._make_actor = make_actor
+        self._on_episode = on_episode
+        self._stop = threading.Event()
+        self.actors: List[object] = []
+        self.dead = 0
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True, name=f"actor-{i}")
+            for i in range(n_actors)
+        ]
+
+    def _run(self, i: int) -> None:
+        loop = asyncio.new_event_loop()
+        try:
+            actor = self._make_actor(i)
+            self.actors.append(actor)
+
+            async def go():
+                while not self._stop.is_set():
+                    ret = await actor.run_episode()
+                    if self._on_episode is not None:
+                        self._on_episode(i, actor, float(ret))
+
+            loop.run_until_complete(go())
+        except Exception:
+            self.dead += 1
+            _log.exception("actor thread %d died", i)
+        finally:
+            loop.close()
+
+    def start(self) -> "ActorPool":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, timeout: float = 30.0, raise_on_dead: bool = False) -> None:
+        """Signal and join with a bounded per-thread timeout — a wedged
+        episode must not hang driver teardown (threads are daemons).
+
+        `raise_on_dead=True`: fail loudly if any actor thread died — for
+        drivers whose RESULTS would silently degrade with fewer actors
+        (A/B arms, artifact generators). Leave False only where the
+        caller folds `pool.dead` into its own success bar."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        if raise_on_dead and self.dead:
+            raise RuntimeError(
+                f"{self.dead} actor thread(s) died during the run "
+                f"(tracebacks in the log) — results would be degraded"
+            )

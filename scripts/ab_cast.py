@@ -19,7 +19,6 @@ Run: python scripts/ab_cast.py [--updates 45] [--seeds 2]
 from __future__ import annotations
 
 import argparse
-import asyncio
 import json
 import os
 import sys
@@ -39,6 +38,7 @@ from dotaclient_tpu.env import featurizer as F
 from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
 from dotaclient_tpu.env.service import LocalDotaServiceStub
 from dotaclient_tpu.runtime.actor import Actor
+from dotaclient_tpu.runtime.harness import ActorPool
 from dotaclient_tpu.runtime.learner import Learner
 from dotaclient_tpu.transport import memory as mem
 from dotaclient_tpu.transport.base import connect as broker_connect
@@ -54,9 +54,9 @@ def run_arm(tag: str, n_updates: int, seed: int, disable_cast: bool):
     lcfg = LearnerConfig(batch_size=16, seq_len=16, policy=SMALL, publish_every=1, seed=seed)
     lcfg.ppo.lr = 1e-3
     lcfg.ppo.entropy_coef = 0.005
-    returns, lock, stop = [], threading.Lock(), threading.Event()
+    returns, lock = [], threading.Lock()
 
-    def actor_thread(i):
+    def make_actor(i):
         acfg = ActorConfig(
             env_addr="local",
             rollout_len=16,
@@ -66,30 +66,18 @@ def run_arm(tag: str, n_updates: int, seed: int, disable_cast: bool):
             opponent="scripted",
             disable_cast=disable_cast,
         )
+        return Actor(
+            acfg, broker_connect(f"mem://{broker}"), actor_id=i, stub=LocalDotaServiceStub(service)
+        )
 
-        async def go():
-            actor = Actor(
-                acfg, broker_connect(f"mem://{broker}"), actor_id=i, stub=LocalDotaServiceStub(service)
-            )
-            while not stop.is_set():
-                ret = await actor.run_episode()
-                with lock:
-                    returns.append(ret)
+    def on_episode(i, actor, ret):
+        with lock:
+            returns.append(ret)
 
-        loop = asyncio.new_event_loop()
-        try:
-            loop.run_until_complete(go())
-        finally:
-            loop.close()
-
-    threads = [threading.Thread(target=actor_thread, args=(i,), daemon=True) for i in range(3)]
-    for t in threads:
-        t.start()
+    pool = ActorPool(make_actor, 3, on_episode).start()
     learner = Learner(lcfg, broker_connect(f"mem://{broker}"))
     learner.run(num_steps=n_updates, batch_timeout=300.0)
-    stop.set()
-    for t in threads:
-        t.join(timeout=60)
+    pool.stop(timeout=60, raise_on_dead=True)
 
     counts, casts = service.action_telemetry()
     # pid 0 = the policy hero in every 1v1 session (scripted foe is pid 1

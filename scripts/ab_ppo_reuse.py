@@ -18,7 +18,6 @@ Run: python scripts/ab_ppo_reuse.py [--updates 45] [--seeds 2]
 from __future__ import annotations
 
 import argparse
-import asyncio
 import json
 import os
 import sys
@@ -37,6 +36,7 @@ from dotaclient_tpu.config import ActorConfig, LearnerConfig, PolicyConfig
 from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
 from dotaclient_tpu.env.service import LocalDotaServiceStub
 from dotaclient_tpu.runtime.actor import Actor
+from dotaclient_tpu.runtime.harness import ActorPool
 from dotaclient_tpu.runtime.learner import Learner
 from dotaclient_tpu.transport import memory as mem
 from dotaclient_tpu.transport.base import connect as broker_connect
@@ -56,36 +56,24 @@ def run_arm(tag: str, n_updates: int, seed: int, epochs: int, minibatches: int, 
     lcfg.ppo.epochs = epochs
     lcfg.ppo.minibatches = minibatches
     lcfg.ppo.kl_stop = kl_stop
-    returns, lock, stop = [], threading.Lock(), threading.Event()
+    returns, lock = [], threading.Lock()
 
-    def actor_thread(i):
+    def make_actor(i):
         acfg = ActorConfig(
             env_addr="local", rollout_len=16, max_dota_time=30.0, policy=SMALL, seed=seed * 1000 + i
         )
+        return Actor(
+            acfg, broker_connect(f"mem://{broker}"), actor_id=i, stub=LocalDotaServiceStub(service)
+        )
 
-        async def go():
-            actor = Actor(
-                acfg, broker_connect(f"mem://{broker}"), actor_id=i, stub=LocalDotaServiceStub(service)
-            )
-            while not stop.is_set():
-                ret = await actor.run_episode()
-                with lock:
-                    returns.append(ret)
+    def on_episode(i, actor, ret):
+        with lock:
+            returns.append(ret)
 
-        loop = asyncio.new_event_loop()
-        try:
-            loop.run_until_complete(go())
-        finally:
-            loop.close()
-
-    threads = [threading.Thread(target=actor_thread, args=(i,), daemon=True) for i in range(3)]
-    for t in threads:
-        t.start()
+    pool = ActorPool(make_actor, 3, on_episode).start()
     learner = Learner(lcfg, broker_connect(f"mem://{broker}"))
     learner.run(num_steps=n_updates, batch_timeout=300.0)
-    stop.set()
-    for t in threads:
-        t.join(timeout=60)
+    pool.stop(timeout=60, raise_on_dead=True)
     with lock:
         return np.asarray(returns, float)
 

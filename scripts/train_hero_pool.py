@@ -46,6 +46,7 @@ import numpy as np
 from dotaclient_tpu.config import ActorConfig, LearnerConfig, PolicyConfig
 from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
 from dotaclient_tpu.env.service import LocalDotaServiceStub
+from dotaclient_tpu.runtime.harness import ActorPool
 from dotaclient_tpu.runtime.learner import Learner
 from dotaclient_tpu.runtime.selfplay import SelfPlayActor
 from dotaclient_tpu.transport import memory as mem
@@ -115,43 +116,24 @@ def main(argv=None) -> int:
     lcfg.ppo.epochs = args.ppo_epochs
     lcfg.ppo.minibatches = args.ppo_minibatches
     lcfg.ppo.kl_stop = args.ppo_kl_stop
-    stop = threading.Event()
     records = []  # (hero_name, episode_return) in completion order
     lock = threading.Lock()
 
-    def actor_thread(i: int):
+    def make_actor(i: int):
         acfg = ActorConfig(
             env_addr="local", rollout_len=16, max_dota_time=30.0,
             opponent="self", hero=POOL, policy=policy, seed=args.seed * 733 + i,
         )
+        return SelfPlayActor(
+            acfg, broker_connect(f"mem://{BROKER}"), actor_id=i,
+            stub=LocalDotaServiceStub(service),
+        )
 
-        async def go():
-            actor = SelfPlayActor(
-                acfg, broker_connect(f"mem://{BROKER}"), actor_id=i,
-                stub=LocalDotaServiceStub(service),
-            )
-            while not stop.is_set():
-                ret = await actor.run_episode()
-                with lock:
-                    records.append((actor.last_heroes[0], float(ret)))
+    def on_episode(i, actor, ret):
+        with lock:
+            records.append((actor.last_heroes[0], float(ret)))
 
-        loop = asyncio.new_event_loop()
-        try:
-            loop.run_until_complete(go())
-        except Exception:
-            import traceback
-
-            print(f"[hero-pool] actor {i} DIED:", flush=True)
-            traceback.print_exc()
-        finally:
-            loop.close()
-
-    threads = [
-        threading.Thread(target=actor_thread, args=(i,), daemon=True)
-        for i in range(args.n_actors)
-    ]
-    for t in threads:
-        t.start()
+    pool = ActorPool(make_actor, args.n_actors, on_episode).start()
     learner = Learner(lcfg, broker_connect(f"mem://{BROKER}"))
     init_params = jax.device_get(learner.state.params)  # frozen yardstick twin
     try:
@@ -159,9 +141,7 @@ def main(argv=None) -> int:
     except TimeoutError as e:
         print(f"[hero-pool] aborted: {e}", flush=True)
     finally:
-        stop.set()
-        for t in threads:
-            t.join(timeout=30)
+        pool.stop(timeout=30)
         learner.close()
 
     final_params = jax.device_get(learner.state.params)
@@ -183,7 +163,8 @@ def main(argv=None) -> int:
 
     wall_min = (time.time() - t_start) / 60.0
     ok = (
-        learner.version >= args.updates
+        pool.dead == 0
+        and learner.version >= args.updates
         and len(heroes_seen) == 3
         and all(d > 0 for d in deltas.values())
     )

@@ -17,11 +17,9 @@ Run: python scripts/train_league.py --out_dir league_run
 from __future__ import annotations
 
 import argparse
-import asyncio
 import json
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -35,6 +33,7 @@ import numpy as np
 from dotaclient_tpu.config import ActorConfig, LearnerConfig, PolicyConfig
 from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
 from dotaclient_tpu.env.service import LocalDotaServiceStub
+from dotaclient_tpu.runtime.harness import ActorPool
 from dotaclient_tpu.runtime.learner import Learner
 from dotaclient_tpu.runtime.selfplay import SelfPlayActor
 from dotaclient_tpu.transport import memory as mem
@@ -70,52 +69,28 @@ def main(argv=None) -> int:
         log_dir=os.path.join(args.out_dir, "learner_logs"),
     )
     lcfg.ppo.lr = 1e-3
-    stop = threading.Event()
-    actors = []
 
-    def actor_thread(i: int):
+    def make_actor(i: int):
         acfg = ActorConfig(
             env_addr="local", rollout_len=16, max_dota_time=30.0,
             opponent="league", team_size=args.team_size, policy=policy,
             league_capacity=8, league_snapshot_every=10, pfsp_mode="hard",
             seed=args.seed * 577 + i,
         )
+        return SelfPlayActor(
+            acfg, broker_connect(f"mem://{BROKER}"), actor_id=i,
+            stub=LocalDotaServiceStub(service),
+        )
 
-        async def go():
-            actor = SelfPlayActor(
-                acfg, broker_connect(f"mem://{BROKER}"), actor_id=i,
-                stub=LocalDotaServiceStub(service),
-            )
-            actors.append(actor)
-            while not stop.is_set():
-                await actor.run_episode()
-
-        loop = asyncio.new_event_loop()
-        try:
-            loop.run_until_complete(go())
-        except Exception:
-            import traceback
-
-            print(f"[league] actor {i} DIED:", flush=True)
-            traceback.print_exc()
-        finally:
-            loop.close()
-
-    threads = [
-        threading.Thread(target=actor_thread, args=(i,), daemon=True)
-        for i in range(args.n_actors)
-    ]
-    for t in threads:
-        t.start()
+    pool = ActorPool(make_actor, args.n_actors).start()
+    actors = pool.actors
     learner = Learner(lcfg, broker_connect(f"mem://{BROKER}"))
     try:
         learner.run(num_steps=args.updates, batch_timeout=120.0, max_idle=3)
     except TimeoutError as e:
         print(f"[league] aborted: {e}", flush=True)
     finally:
-        stop.set()
-        for t in threads:
-            t.join(timeout=30)
+        pool.stop(timeout=30)
         learner.close()
 
     wall_min = (time.time() - t_start) / 60.0
@@ -128,7 +103,8 @@ def main(argv=None) -> int:
     league_sizes = [len(a.league) for a in actors if a.league is not None]
     episodes = sum(a.episodes_done for a in actors)
     ok = (
-        learner.version >= args.updates
+        pool.dead == 0
+        and learner.version >= args.updates
         and bool(aux_keys)
         and any(s > 0 for s in league_sizes)
         and episodes > 0

@@ -25,11 +25,9 @@ Reproduce:  python scripts/train_north_star.py --out_dir north_star
 from __future__ import annotations
 
 import argparse
-import asyncio
 import json
 import os
 import sys
-import threading
 import time
 
 # repo root on sys.path when run as `python scripts/train_north_star.py`
@@ -49,6 +47,7 @@ from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
 from dotaclient_tpu.env.service import LocalDotaServiceStub
 from dotaclient_tpu.eval.evaluator import Evaluator
 from dotaclient_tpu.runtime.actor import Actor
+from dotaclient_tpu.runtime.harness import ActorPool
 from dotaclient_tpu.runtime.learner import Learner
 from dotaclient_tpu.transport import memory as mem
 from dotaclient_tpu.transport.base import connect as broker_connect
@@ -93,39 +92,17 @@ def main(argv=None) -> int:
     lcfg.ppo.epochs = args.ppo_epochs
     lcfg.ppo.minibatches = args.ppo_minibatches
     lcfg.ppo.kl_stop = args.ppo_kl_stop
-    stop = threading.Event()
-
-    def actor_thread(i: int):
+    def make_actor(i: int):
         acfg = ActorConfig(
             env_addr="local", rollout_len=16, max_dota_time=30.0,
             opponent="scripted_hard", policy=SMALL, seed=args.seed * 1000 + 100 + i,
         )
+        return Actor(
+            acfg, broker_connect(f"mem://{BROKER}"), actor_id=i,
+            stub=LocalDotaServiceStub(service),
+        )
 
-        async def go():
-            actor = Actor(
-                acfg, broker_connect(f"mem://{BROKER}"), actor_id=i,
-                stub=LocalDotaServiceStub(service),
-            )
-            while not stop.is_set():
-                await actor.run_episode()
-
-        loop = asyncio.new_event_loop()
-        try:
-            loop.run_until_complete(go())
-        except Exception:
-            import traceback
-
-            print(f"[north-star] actor {i} DIED:", flush=True)
-            traceback.print_exc()
-        finally:
-            loop.close()
-
-    threads = [
-        threading.Thread(target=actor_thread, args=(i,), daemon=True)
-        for i in range(args.n_actors)
-    ]
-    for t in threads:
-        t.start()
+    pool = ActorPool(make_actor, args.n_actors).start()
     learner = Learner(lcfg, broker_connect(f"mem://{BROKER}"))
 
     # --- eval side: frozen params vs the same HARD bot, own env ----------
@@ -174,13 +151,14 @@ def main(argv=None) -> int:
     except TimeoutError as e:
         print(f"[north-star] aborted: {e}", flush=True)
     finally:
-        stop.set()
-        for t in threads:  # let in-flight episodes drain — a hard exit
-            t.join(timeout=30)  # mid-jax-call aborts interpreter teardown
+        # let in-flight episodes drain — a hard exit mid-jax-call aborts
+        # interpreter teardown (ActorPool.stop joins with a bounded timeout)
+        pool.stop(timeout=30)
         jsonl.close()
         learner.close()
         evaluator.close()
 
+    ok = ok and pool.dead == 0  # a degraded actor pool taints the artifact
     final = history[-1] if history else {}
     wall_min = (time.time() - t_start) / 60.0
     summary = [

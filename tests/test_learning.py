@@ -13,7 +13,6 @@ Tiers (VERDICT r2 item 7 — keep the default gate fast):
   with its own calibration note on the test.
 """
 
-import asyncio
 import threading
 
 import numpy as np
@@ -23,6 +22,7 @@ from dotaclient_tpu.config import ActorConfig, LearnerConfig, PolicyConfig
 from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
 from dotaclient_tpu.env.service import LocalDotaServiceStub
 from dotaclient_tpu.runtime.actor import Actor
+from dotaclient_tpu.runtime.harness import ActorPool
 from dotaclient_tpu.runtime.learner import Learner
 from dotaclient_tpu.transport import memory as mem
 from dotaclient_tpu.transport.base import connect as broker_connect
@@ -44,41 +44,27 @@ def _run_smoke(broker_name: str, n_updates: int, min_episodes: int, policy=SMALL
     lcfg.ppo.entropy_coef = 0.005
     returns = []  # episode returns in completion order, all actors
     lock = threading.Lock()
-    stop = threading.Event()
 
-    def actor_thread(i):
+    def make_actor(i):
         acfg = ActorConfig(
             env_addr="local", rollout_len=seq_len, max_dota_time=30.0, policy=policy, seed=100 + i
         )
+        return Actor(
+            acfg, broker_connect(f"mem://{broker_name}"), actor_id=i,
+            stub=LocalDotaServiceStub(service),
+        )
 
-        async def go():
-            actor = Actor(
-                acfg,
-                broker_connect(f"mem://{broker_name}"),
-                actor_id=i,
-                stub=LocalDotaServiceStub(service),
-            )
-            while not stop.is_set():
-                ret = await actor.run_episode()
-                with lock:
-                    returns.append(ret)
+    def on_episode(i, actor, ret):
+        with lock:
+            returns.append(ret)
 
-        loop = asyncio.new_event_loop()
-        try:
-            loop.run_until_complete(go())
-        finally:
-            loop.close()
-
-    threads = [threading.Thread(target=actor_thread, args=(i,), daemon=True) for i in range(N_ACTORS)]
-    for t in threads:
-        t.start()
+    pool = ActorPool(make_actor, N_ACTORS, on_episode).start()
     learner = Learner(lcfg, broker_connect(f"mem://{broker_name}"))
     steps = learner.run(num_steps=n_updates, batch_timeout=300.0)
-    stop.set()
-    for t in threads:
-        t.join(timeout=60)
+    pool.stop(timeout=60)
 
     assert steps == n_updates
+    assert pool.dead == 0, "an actor thread died during the smoke"
     with lock:
         rets = np.asarray(returns, float)
     assert len(rets) > min_episodes, f"too few episodes ({len(rets)}) for a stable comparison"
