@@ -1,0 +1,73 @@
+"""ActorPool unit tests (runtime/harness.py) — the shared closed-loop
+scaffold every local driver and learning smoke rides on."""
+
+import threading
+import time
+
+import pytest
+
+from dotaclient_tpu.runtime.harness import ActorPool
+
+
+class _FakeActor:
+    def __init__(self, rets):
+        self._rets = iter(rets)
+
+    async def run_episode(self):
+        try:
+            return next(self._rets)
+        except StopIteration:
+            time.sleep(0.01)  # idle once the script runs out
+            return 0.0
+
+
+def test_pool_runs_actors_and_collects_episodes():
+    seen, lock = [], threading.Lock()
+
+    def on_episode(i, actor, ret):
+        with lock:
+            seen.append((i, ret))
+
+    pool = ActorPool(lambda i: _FakeActor([1.0, 2.0]), 3, on_episode).start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with lock:
+            if len(seen) >= 6:
+                break
+        time.sleep(0.01)
+    pool.stop(timeout=5)
+    assert pool.dead == 0
+    with lock:
+        assert len(seen) >= 6
+        assert {i for i, _ in seen} == {0, 1, 2}
+        assert {r for _, r in seen} >= {1.0, 2.0}
+    assert len(pool.actors) == 3
+
+
+def test_pool_counts_deaths_and_raise_on_dead():
+    class _Dying:
+        async def run_episode(self):
+            raise RuntimeError("boom")
+
+    def make(i):
+        return _Dying() if i == 1 else _FakeActor([0.5])
+
+    pool = ActorPool(make, 2).start()
+    deadline = time.time() + 10
+    while pool.dead < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert pool.dead == 1  # logged and counted, not swallowed
+    with pytest.raises(RuntimeError, match="actor thread"):
+        pool.stop(timeout=5, raise_on_dead=True)
+
+
+def test_make_actor_failure_is_a_death_too():
+    def make(i):
+        raise ValueError("bad config")
+
+    pool = ActorPool(make, 1).start()
+    deadline = time.time() + 10
+    while pool.dead < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert pool.dead == 1
+    pool.stop(timeout=5)  # default: caller folds `dead` into its own bar
