@@ -32,7 +32,9 @@ def test_pool_runs_actors_and_collects_episodes():
     deadline = time.time() + 10
     while time.time() < deadline:
         with lock:
-            if len(seen) >= 6:
+            # Wait for BOTH conditions: a busy host can schedule two
+            # threads through 6 episodes before the third ever runs.
+            if len(seen) >= 6 and {i for i, _ in seen} == {0, 1, 2}:
                 break
         time.sleep(0.01)
     pool.stop(timeout=5)

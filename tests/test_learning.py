@@ -181,18 +181,20 @@ def test_sequence_parallel_learning_smoke_thin():
 def test_context128_full_longcontext_stack_learns():
     """The longest-context closed loop in the suite: 127-step chunks
     (8x the LSTM flagship chunk) acted through the KV cache, learned
-    with the time axis ring-sharded dp=2 x sp=4, blocks REMATERIALIZED,
-    and BLOCKWISE (flash-formulation) local attention — every
-    long-context lever composed at once, end to end, and return must
-    still rise.
+    with the time axis sharded dp=2 x sp=4 via ULYSSES all-to-all (the
+    collective pattern the 31-chunk ring nightly does NOT cover), blocks
+    REMATERIALIZED, and BLOCKWISE (flash-formulation) local attention —
+    which only the ulysses/local paths consume; under the ring it is
+    inert by construction (config.py tf_attn_block note) — end to end,
+    and return must still rise.
 
-    Calibration (this config, 2 runs r4, 227 episodes each): improvement
-    +1.15 / +0.78 — margin 0.05 is the plumbing-not-skill bar (the test
-    proves the composed stack TRAINS; the 31-chunk nightly below carries
-    the calibrated skill margin). First calibration attempt failed at
-    the default 30s episodes (improvement -0.27): ~56-obs episodes can
-    never fill a 127-step chunk, so the run was learning on padding —
-    hence the explicit max_dota_time=70 and the warning on _run_smoke."""
+    Calibration (this config, 2 runs r4): improvement +1.66 / +1.73 —
+    margin 0.05 is the plumbing-not-skill bar (the test proves the
+    composed stack TRAINS; the 31-chunk nightly below carries the
+    calibrated skill margin). First calibration attempt failed at the
+    default 30s episodes (improvement -0.27): ~56-obs episodes can never
+    fill a 127-step chunk, so the run was learning on padding — hence
+    the explicit max_dota_time=70 and the warning on _run_smoke."""
     tf_policy = PolicyConfig(
         arch="transformer",
         unit_embed_dim=16,
@@ -200,10 +202,10 @@ def test_context128_full_longcontext_stack_learns():
         mlp_hidden=16,
         dtype="float32",
         tf_layers=2,
-        tf_heads=2,
+        tf_heads=4,  # ulysses needs heads % sp == 0
         tf_context=128,
         tf_sp_axis="sp",
-        tf_sp_mode="ring",
+        tf_sp_mode="ulysses",
         tf_attn_block=32,
         tf_remat=True,
     )
