@@ -143,6 +143,65 @@ def _git_commit(paths, msg) -> None:
             time.sleep(5 + 10 * attempt)
 
 
+def window_tasks(ts: str):
+    """The on-silicon task list, in value order. Factored out so the
+    success branch — the code a scarce chip window rides on — is
+    unit-testable (tests/test_prober.py) instead of first executing for
+    real inside the window."""
+    bench_out = f"BENCH_TPU_{ts}.json"
+    return [
+        (
+            "e2e bench (fused pipeline)",
+            [sys.executable, "bench.py"],
+            {"DOTACLIENT_TPU_BENCH_PLATFORM": "tpu"},
+            1500.0,
+            bench_out,
+            [bench_out],
+        ),
+        (
+            "lstm kernel micro-bench",
+            [sys.executable, "scripts/bench_lstm.py", "--out", "LSTM_BENCH.json"],
+            {},
+            1200.0,
+            None,
+            ["LSTM_BENCH.json"],
+        ),
+        (
+            "full-step pallas parity + donation safety",
+            [sys.executable, "scripts/tpu_window_parity.py", "--out", "PALLAS_PARITY_TPU.json"],
+            {},
+            1800.0,
+            None,
+            ["PALLAS_PARITY_TPU.json"],
+        ),
+        (
+            "transformer-family device bench",
+            [sys.executable, "scripts/bench_tf.py", "--out", "TF_BENCH.json"],
+            {},
+            1500.0,
+            None,
+            ["TF_BENCH.json"],
+        ),
+    ]
+
+
+def run_window(ts: str, tasks=None) -> None:
+    """Execute the window task list, committing artifacts after EACH task
+    (the window can close mid-list; committed partial evidence beats
+    uncommitted complete evidence). Bails on the first TIMEOUT — a hung
+    backend would eat the remaining tasks' budgets for nothing."""
+    task_list = tasks if tasks is not None else window_tasks(ts)
+    for name, cmd, env_extra, timeout_s, out_path, artifacts in task_list:
+        t_ok, t_detail = _run_task(cmd, env_extra, timeout_s, out_path)
+        _append_log(f"| {_utc()} | task | {name}: {t_detail} |")
+        paths = [LOG] + [a for a in artifacts if os.path.exists(os.path.join(REPO, a))]
+        _git_commit(paths, f"TPU window {ts}: {name} {'ok' if t_ok else '- ' + t_detail[:60]}")
+        if not t_ok and "TIMEOUT" in t_detail:
+            break
+    _append_log(f"| {_utc()} | n/a | window tasks done; prober exiting for restart |")
+    _git_commit([LOG], f"TPU window {ts}: window tasks complete")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--interval", type=float, default=900.0, help="seconds between probes")
@@ -166,57 +225,10 @@ def main(argv=None) -> int:
         _append_log(
             f"| {_utc()} | n/a | **SUCCESS — {detail} after {dt:.1f}s** "
             f"(round-4 prober, load {load:.1f}); launching window tasks: "
-            f"bench / lstm / full-step parity |"
+            f"bench / lstm / full-step parity / tf bench |"
         )
         _git_commit([LOG], f"TPU window {ts}: chip answered, window tasks starting")
-
-        bench_out = f"BENCH_TPU_{ts}.json"
-        tasks = [
-            (
-                "e2e bench (fused pipeline)",
-                [sys.executable, "bench.py"],
-                {"DOTACLIENT_TPU_BENCH_PLATFORM": "tpu"},
-                1500.0,
-                bench_out,
-                [bench_out],
-            ),
-            (
-                "lstm kernel micro-bench",
-                [sys.executable, "scripts/bench_lstm.py", "--out", "LSTM_BENCH.json"],
-                {},
-                1200.0,
-                None,
-                ["LSTM_BENCH.json"],
-            ),
-            (
-                "full-step pallas parity + donation safety",
-                [sys.executable, "scripts/tpu_window_parity.py", "--out", "PALLAS_PARITY_TPU.json"],
-                {},
-                1800.0,
-                None,
-                ["PALLAS_PARITY_TPU.json"],
-            ),
-            (
-                "transformer-family device bench",
-                [sys.executable, "scripts/bench_tf.py", "--out", "TF_BENCH.json"],
-                {},
-                1500.0,
-                None,
-                ["TF_BENCH.json"],
-            ),
-        ]
-        for name, cmd, env_extra, timeout_s, out_path, artifacts in tasks:
-            t_ok, t_detail = _run_task(cmd, env_extra, timeout_s, out_path)
-            _append_log(f"| {_utc()} | task | {name}: {t_detail} |")
-            paths = [LOG] + [a for a in artifacts if os.path.exists(os.path.join(REPO, a))]
-            _git_commit(paths, f"TPU window {ts}: {name} {'ok' if t_ok else '- ' + t_detail[:60]}")
-            if not t_ok and "TIMEOUT" in t_detail:
-                # Window likely closed mid-task; don't burn the rest of the
-                # list against a hung backend. Exit and let the session
-                # restart the prober for a later window.
-                break
-        _append_log(f"| {_utc()} | n/a | window tasks done; prober exiting for restart |")
-        _git_commit([LOG], f"TPU window {ts}: window tasks complete")
+        run_window(ts)
         return 0
     return 1  # no window before the deadline
 

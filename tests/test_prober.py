@@ -1,0 +1,148 @@
+"""The prober's SUCCESS branch (scripts/tpu_prober.py run_window) — the
+code a scarce chip window rides on must not execute for the first time
+inside the window. Runs against a throwaway git repo with stubbed task
+commands; no jax, no TPU, no network."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def prober(tmp_path):
+    """Import a fresh tpu_prober module pointed at a temp git repo."""
+    spec = importlib.util.spec_from_file_location(
+        "tpu_prober_under_test", os.path.join(REPO_ROOT, "scripts", "tpu_prober.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    # Repo-LOCAL identity: the prober's own git subprocesses must commit
+    # (no global identity exists on this box; the real repo has local config).
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "config", "user.email", "t@t"],
+        ["git", "config", "user.name", "t"],
+        ["git", "commit", "-q", "--allow-empty", "-m", "root"],
+    ):
+        subprocess.run(cmd, cwd=repo, check=True)
+    mod.REPO = str(repo)
+    mod.LOG = str(repo / "TPU_PROBE_LOG.md")
+    with open(mod.LOG, "w") as f:
+        f.write("| log |\n")
+    return mod
+
+
+def _commits(repo):
+    out = subprocess.run(
+        ["git", "log", "--oneline"], cwd=repo, check=True, capture_output=True, text=True
+    )
+    return out.stdout.strip().splitlines()
+
+
+def test_run_window_commits_each_artifact(prober):
+    tasks = [
+        (
+            "taskA",
+            [sys.executable, "-c", "import json; print(json.dumps({'platform':'tpu','value':1.0}))"],
+            {},
+            60.0,
+            "A.json",  # stdout-captured artifact (the bench pattern)
+            ["A.json"],
+        ),
+        (
+            "taskB",
+            [sys.executable, "-c", "open('B.json','w').write('{}')"],
+            {},
+            60.0,
+            None,  # writes its own file (the bench_lstm pattern)
+            ["B.json"],
+        ),
+    ]
+    prober.run_window("TEST", tasks=tasks)
+    assert os.path.exists(os.path.join(prober.REPO, "A.json"))
+    assert os.path.exists(os.path.join(prober.REPO, "B.json"))
+    log = open(prober.LOG).read()
+    assert "taskA: ok" in log and "taskB: ok" in log
+    msgs = _commits(prober.REPO)
+    assert any("taskA ok" in m for m in msgs)
+    assert any("taskB ok" in m for m in msgs)
+    assert any("window tasks complete" in m for m in msgs)
+
+
+def test_run_window_rejects_non_silicon_bench(prober):
+    """A bench that fell back to CPU (or printed the error contract) must
+    NOT be enshrined as a BENCH_TPU_* artifact."""
+    tasks = [
+        (
+            "cpu-fallback bench",
+            [sys.executable, "-c",
+             "import json; print(json.dumps({'platform':'cpu','value':5.0}))"],
+            {},
+            60.0,
+            "BENCH_TPU_TEST.json",
+            ["BENCH_TPU_TEST.json"],
+        ),
+        (
+            "error-contract bench",
+            [sys.executable, "-c",
+             "import json; print(json.dumps({'platform':'tpu','value':0.0,'error':'boom'}))"],
+            {},
+            60.0,
+            "BENCH_TPU_TEST2.json",
+            ["BENCH_TPU_TEST2.json"],
+        ),
+    ]
+    prober.run_window("TEST", tasks=tasks)
+    assert not os.path.exists(os.path.join(prober.REPO, "BENCH_TPU_TEST.json"))
+    assert not os.path.exists(os.path.join(prober.REPO, "BENCH_TPU_TEST2.json"))
+    log = open(prober.LOG).read()
+    assert log.count("not silicon evidence") == 2
+
+
+def test_run_window_bails_on_timeout_but_commits_partials(prober):
+    """A mid-list hang (window closed) must not burn the remaining tasks'
+    budgets, and artifacts written BEFORE the kill must still commit."""
+    tasks = [
+        (
+            "writes-then-hangs",
+            [sys.executable, "-c",
+             "open('partial.json','w').write('{\"half\": true}')\n"
+             "import time; time.sleep(300)"],
+            {},
+            # Comfortably above interpreter startup (~2.3s on this image —
+            # sitecustomize imports jax), far below the sleep: the child
+            # RELIABLY writes the file, then reliably gets group-killed.
+            10.0,
+            None,
+            ["partial.json"],
+        ),
+        (
+            "never-runs",
+            [sys.executable, "-c", "open('after.json','w').write('{}')"],
+            {},
+            60.0,
+            None,
+            ["after.json"],
+        ),
+    ]
+    prober.run_window("TEST", tasks=tasks)
+    assert os.path.exists(os.path.join(prober.REPO, "partial.json"))
+    assert not os.path.exists(os.path.join(prober.REPO, "after.json"))
+    log = open(prober.LOG).read()
+    assert "TIMEOUT" in log and "never-runs" not in log
+    assert any("partial.json" not in m and "writes-then-hangs" in m for m in _commits(prober.REPO))
+
+
+def test_window_task_list_commands_exist(prober):
+    """Every command in the real task list must point at a real file —
+    a typo'd path would otherwise only surface inside the window."""
+    for name, cmd, _env, _t, _out, _arts in prober.window_tasks("TS"):
+        script = cmd[1]
+        assert os.path.exists(os.path.join(REPO_ROOT, script)), (name, script)
