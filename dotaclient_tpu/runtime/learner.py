@@ -248,6 +248,28 @@ class Learner:
                     f"dp={dp_size} not divisible by process count {self._n_proc} "
                     f"(mesh {cfg.mesh_shape!r})"
                 )
+            # dp must be the MAJOR mesh axis: jax.devices() orders
+            # process-major, so a dp-major mesh gives each process a
+            # contiguous block of dp shards (its local batch rows land on
+            # its own devices) and any minor axis (tp/sp) stays WITHIN a
+            # process — make_array_from_process_local_data is only
+            # assembling along dp. A mesh like "sp=4,dp=2" would
+            # interleave processes along sp and scatter each host's rows
+            # across hosts. The invariant is "no axis of size > 1 ahead
+            # of dp", not dp-literally-first: "tp=1,dp=8" is fine.
+            names = list(self.mesh.axis_names)
+            sizes = list(self.mesh.devices.shape)
+            ahead = 1
+            for n, s in zip(names, sizes):
+                if n == "dp":
+                    break
+                ahead *= s
+            if ahead != 1:
+                raise ValueError(
+                    f"multihost needs 'dp' as the MAJOR mesh axis (no axis of "
+                    f"size > 1 ahead of it); got {dict(zip(names, sizes))} — "
+                    f"write --mesh_shape dp=...,<rest>"
+                )
             if cfg.broker_url.startswith("mem://"):
                 _log.warning(
                     "multihost with mem:// broker: in-process queues cannot span "
