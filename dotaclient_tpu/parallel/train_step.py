@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from dotaclient_tpu.config import LearnerConfig
@@ -43,8 +44,6 @@ def make_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:
 def init_train_state(cfg: LearnerConfig, rng: jax.Array) -> TrainState:
     params = init_params(cfg.policy, rng)
     opt_state = make_optimizer(cfg).init(params)
-    import jax.numpy as jnp
-
     return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
 
 
@@ -202,8 +201,6 @@ def _build_reuse_step_fn(cfg: LearnerConfig, mesh, net, opt, use_sp: bool, sp: s
         return new_params, new_opt, metrics
 
     def step_fn(state: TrainState, batch: TrainBatch) -> Tuple[TrainState, Dict]:
-        import jax.numpy as jnp
-
         rb = precompute_reuse(state.params, net.apply, batch, cfg.ppo)
         # Deterministic per-step shuffle stream; no rng carried in
         # TrainState (checkpoint layout unchanged).

@@ -361,6 +361,16 @@ class Actor:
             self._stub = connect_env_async(self.cfg)
         return self._stub
 
+    def _featurize(self, world):
+        """The ONE featurization choke point for this actor: worldstate →
+        (Observation, handles), with per-actor observation policy (the
+        disable_cast ablation mask) applied here so every consumer of an
+        observation — step, chunk, bootstrap frame — sees the same view."""
+        obs, handles = F.featurize_with_handles(world, self.player_id)
+        if self.cfg.disable_cast:
+            obs.action_mask[F.ACT_CAST] = False
+        return obs, handles
+
     async def run_episode(self) -> float:
         cfg = self.cfg
         self.last_win = None
@@ -389,9 +399,7 @@ class Actor:
         episode_return = 0.0
         done = False
         # each worldstate is featurized exactly once; the pair rolls forward
-        obs, handles = F.featurize_with_handles(world, self.player_id)
-        if cfg.disable_cast:
-            obs.action_mask[F.ACT_CAST] = False
+        obs, handles = self._featurize(world)
 
         while not done:
             obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
@@ -413,9 +421,7 @@ class Actor:
                 self.episodes_done += 1
                 return episode_return
             next_world = resp.world_state
-            next_obs, next_handles = F.featurize_with_handles(next_world, self.player_id)
-            if cfg.disable_cast:
-                next_obs.action_mask[F.ACT_CAST] = False
+            next_obs, next_handles = self._featurize(next_world)
             done = resp.status == ds.Observation.EPISODE_DONE
             r = R.reward(world, next_world, self.player_id, last_hero)
             episode_return += r

@@ -30,6 +30,7 @@ outlive any jax wedge). If you fix a bug in one copy, fix bench.py's
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import subprocess
@@ -107,8 +108,6 @@ def _run_task(cmd, env_extra, timeout_s, out_path=None):
             # as a BENCH_TPU_* artifact (bench._last_silicon would embed it).
             line = out.strip().splitlines()[-1]
             try:
-                import json
-
                 parsed = json.loads(line)
                 # Silicon evidence requires: no error contract, a nonzero
                 # rate, AND the machine-readable platform marker saying

@@ -28,7 +28,6 @@ Run: python scripts/train_hero_pool.py --out_dir hero_pool_run
 from __future__ import annotations
 
 import argparse
-import asyncio
 import json
 import os
 import sys
@@ -41,7 +40,6 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")  # sitecustomize overrides the env var
 
-import numpy as np
 
 from dotaclient_tpu.config import ActorConfig, LearnerConfig, PolicyConfig
 from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
@@ -71,8 +69,11 @@ def parse_args(argv=None):
 
 def eval_per_hero(params, policy_cfg, heroes_list, episodes, seed):
     """Frozen-policy eval: `episodes` per hero vs the SCRIPTED bot (the
-    fixed yardstick), fresh env per hero. Returns {hero: mean_return}."""
-    from dotaclient_tpu.runtime.actor import Actor
+    fixed yardstick), fresh env per hero. Returns {hero: mean_return}.
+    Rides the standard Evaluator (eval/evaluator.py) — same frozen-param
+    episode loop the north-star artifact uses — and reads its
+    mean_return, ignoring the rating side."""
+    from dotaclient_tpu.eval.evaluator import Evaluator
 
     out = {}
     for hero in heroes_list:
@@ -80,21 +81,9 @@ def eval_per_hero(params, policy_cfg, heroes_list, episodes, seed):
             env_addr="local", rollout_len=16, max_dota_time=30.0,
             opponent="scripted_hard", hero=hero, policy=policy_cfg, seed=seed,
         )
-        actor = Actor(
-            acfg,
-            broker_connect("mem://hero_pool_eval"),
-            actor_id=0,
-            stub=LocalDotaServiceStub(FakeDotaService()),
-        )
-        actor.params = params
-        rets = []
-
-        async def go():
-            for _ in range(episodes):
-                rets.append(float(await actor.run_episode()))
-
-        asyncio.run(go())
-        out[hero] = float(np.mean(rets))
+        ev = Evaluator(acfg, stub=LocalDotaServiceStub(FakeDotaService()))
+        out[hero] = float(ev.evaluate(params, n_episodes=episodes).mean_return)
+        ev.close()
     return out
 
 
