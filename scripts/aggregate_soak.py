@@ -275,6 +275,38 @@ def main(argv=None) -> int:
         else:
             raise RuntimeError("broker server never listened")
 
+        # ---------------- PHASE 0: measured transport calibration --------
+        # One in-process publisher + one consumer through the real broker
+        # for a few seconds: the transport-headroom number in the artifact
+        # is MEASURED in the same run, not asserted from a notebook.
+        cal_pub = connect(broker_url)
+        cal_sub = connect(broker_url)
+        cal_frame = frames[0]
+        cal_recv = [0]
+        cal_stop = threading.Event()
+
+        def cal_consumer():
+            while not cal_stop.is_set():
+                cal_recv[0] += len(cal_sub.consume_experience(64, timeout=0.2))
+
+        t_cal = threading.Thread(target=cal_consumer, daemon=True)
+        t_cal.start()
+        sent = 0
+        t0 = time.time()
+        while time.time() - t0 < 5.0:
+            cal_pub.publish_experience(cal_frame)
+            sent += 1
+        cal_dt = time.time() - t0
+        cal_stop.set()
+        t_cal.join(timeout=2)
+        artifact["phase_0_transport_calibration"] = {
+            "topology": "1 publisher + 1 consumer through the tcp broker, this host, this run",
+            "frames_per_sec": round(sent / cal_dt, 1),
+            "env_steps_per_sec_equiv": round(sent / cal_dt * lcfg.seq_len, 1),
+            "headroom_over_50k_bar": round(sent / cal_dt * lcfg.seq_len / 50_000.0, 2),
+        }
+        print(json.dumps(artifact["phase_0_transport_calibration"]), flush=True)
+
         # ---------------- PHASE A: 64-process fan-in at the 50k bar ------
         go_a = f"/tmp/soak_goA_{os.getpid()}"
         procs = _spawn_children(
