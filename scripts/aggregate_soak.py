@@ -299,13 +299,23 @@ def main(argv=None) -> int:
         cal_dt = time.time() - t0
         cal_stop.set()
         t_cal.join(timeout=2)
+        # The CONSUMED rate is the deliverable-throughput claim (the
+        # publish side alone would overstate it exactly when transport is
+        # the bottleneck and the drop-oldest queue eats the difference).
+        consumed_rate = cal_recv[0] / cal_dt
         artifact["phase_0_transport_calibration"] = {
             "topology": "1 publisher + 1 consumer through the tcp broker, this host, this run",
-            "frames_per_sec": round(sent / cal_dt, 1),
-            "env_steps_per_sec_equiv": round(sent / cal_dt * lcfg.seq_len, 1),
-            "headroom_over_50k_bar": round(sent / cal_dt * lcfg.seq_len / 50_000.0, 2),
+            "published_frames_per_sec": round(sent / cal_dt, 1),
+            "consumed_frames_per_sec": round(consumed_rate, 1),
+            "env_steps_per_sec_equiv_consumed": round(consumed_rate * lcfg.seq_len, 1),
+            "headroom_over_50k_bar": round(consumed_rate * lcfg.seq_len / 50_000.0, 2),
         }
         print(json.dumps(artifact["phase_0_transport_calibration"]), flush=True)
+        # Drain any calibration backlog so phase A starts from an EMPTY
+        # queue — residual frames would inflate phase A's staged counts
+        # and register a phantom heartbeat from the unpatched cal frame.
+        while cal_sub.consume_experience(256, timeout=0.2):
+            pass
 
         # ---------------- PHASE A: 64-process fan-in at the 50k bar ------
         go_a = f"/tmp/soak_goA_{os.getpid()}"
