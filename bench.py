@@ -391,8 +391,13 @@ def main() -> None:
                     xla_flops = float(ca0.get("flops", 0.0)) or None
             except Exception:
                 pass  # cost analysis is backend-best-effort
-        steps_per_sec_e2e = e2e_rate / (cfg.batch_size * cfg.seq_len)
-        achieved_flops = model_flops * steps_per_sec_e2e
+        # ADVICE r4: derive achieved FLOP/s from the CONSUMED learner-step
+        # rate (n_iters / dt), not by back-dividing the masked env-step
+        # rate — the device computes all B*(T+1) frames regardless of
+        # mask, so padding in the replayed rollouts would systematically
+        # underreport MFU.
+        updates_per_sec = n_iters / dt
+        achieved_flops = model_flops * updates_per_sec
         peak = None if on_cpu_fallback else flops_mod.peak_flops_for(str(devices[0]))
         h2d_bytes = sum(
             np.dtype(b.dtype).itemsize * int(np.prod(b.shape)) for b in jax.tree.leaves(batch)

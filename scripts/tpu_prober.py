@@ -8,10 +8,15 @@ window closed. This prober runs detached from round start:
     probe jax.devices() in a killable subprocess (own session, group-kill)
     on timeout: append a row to TPU_PROBE_LOG.md, sleep ~15 min, repeat
     on success: IMMEDIATELY run the window tasks, in value order —
-      1. bench.py            (fused-pipeline e2e — the round-3 perf story)
-      2. scripts/bench_lstm.py         (kernel dispatcher re-validation)
+      1. bench.py           (fused-pipeline e2e + real mfu_pct — r4 item 1)
+      2. scripts/aggregate_soak.py --phase b --platform tpu
+                            (closed-loop 50k-consumed soak, learner on
+                             silicon — the north-star topology, r4 item 1)
       3. scripts/tpu_window_parity.py  (full-step pallas parity + donation
                                         safety — cut off at 05:22 r3)
+      4. scripts/bench_tf.py (context ladder — the flash-attention go/no-go
+                              data, r4 item 7)
+      5. scripts/bench_lstm.py         (kernel dispatcher re-validation)
     each with its own timeout; artifacts + log committed to git after each
     task (window may close mid-list; committed partial evidence beats
     uncommitted complete evidence), then the prober EXITS 0 so the
@@ -158,12 +163,22 @@ def window_tasks(ts: str):
             [bench_out],
         ),
         (
-            "lstm kernel micro-bench",
-            [sys.executable, "scripts/bench_lstm.py", "--out", "LSTM_BENCH.json"],
+            # VERDICT r4 item 1: the north-star topology — producers
+            # saturating a learner that trains ON THE CHIP, chasing the
+            # 50k CONSUMED bar the lone host core can't reach with the
+            # step on CPU. Timeout covers ~64 serialized interpreter
+            # startups (~130s) + TPU compile + the 150s measured window.
+            "closed-loop soak, learner on silicon",
+            [
+                sys.executable, "scripts/aggregate_soak.py",
+                "--phase", "b", "--platform", "tpu", "--policy", "flagship",
+                "--replayers-b", "64", "--real-actors", "2",
+                "--duration", "150", "--out", "SOAK_TPU.json",
+            ],
             {},
-            1200.0,
+            1500.0,
             None,
-            ["LSTM_BENCH.json"],
+            ["SOAK_TPU.json"],
         ),
         (
             "full-step pallas parity + donation safety",
@@ -180,6 +195,14 @@ def window_tasks(ts: str):
             1500.0,
             None,
             ["TF_BENCH.json"],
+        ),
+        (
+            "lstm kernel micro-bench",
+            [sys.executable, "scripts/bench_lstm.py", "--out", "LSTM_BENCH.json"],
+            {},
+            1200.0,
+            None,
+            ["LSTM_BENCH.json"],
         ),
     ]
 
@@ -215,7 +238,7 @@ def main(argv=None) -> int:
         if not ok:
             _append_log(
                 f"| {_utc()} | {args.probe_timeout:.0f}s | TIMEOUT — prober "
-                f"(round 4 auto-loop, load {load:.1f}) |"
+                f"(round 5 auto-loop, load {load:.1f}) |"
             )
             time.sleep(args.interval)
             continue
@@ -223,8 +246,8 @@ def main(argv=None) -> int:
         ts = time.strftime("%Y%m%dT%H%M", time.gmtime())
         _append_log(
             f"| {_utc()} | n/a | **SUCCESS — {detail} after {dt:.1f}s** "
-            f"(round-4 prober, load {load:.1f}); launching window tasks: "
-            f"bench / lstm / full-step parity / tf bench |"
+            f"(round-5 prober, load {load:.1f}); launching window tasks: "
+            f"bench / silicon soak / full-step parity / tf bench / lstm |"
         )
         _git_commit([LOG], f"TPU window {ts}: chip answered, window tasks starting")
         run_window(ts)
