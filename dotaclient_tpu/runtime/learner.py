@@ -118,10 +118,13 @@ class WeightPublisher:
     `ParamFlattener.to_named` with a device buffer payload.
     """
 
-    def __init__(self, broker: Broker, materialize=None, boot_epoch: int = 0):
+    def __init__(
+        self, broker: Broker, materialize=None, boot_epoch: int = 0, legacy_dtw1: bool = False
+    ):
         self._materialize = materialize if materialize is not None else flatten_params
         self._broker = broker
         self._boot_epoch = boot_epoch
+        self._legacy_dtw1 = legacy_dtw1
         self._cond = threading.Condition()
         self._slot = None  # (np_params, version) — latest pending
         self._stop = False
@@ -167,7 +170,10 @@ class WeightPublisher:
                 self._slot = None
             try:
                 frame = serialize_weights(
-                    self._materialize(np_params), version=version, boot_epoch=self._boot_epoch
+                    self._materialize(np_params),
+                    version=version,
+                    boot_epoch=self._boot_epoch,
+                    legacy_dtw1=self._legacy_dtw1,
                 )
                 self._broker.publish_weights(frame)
                 self.published += 1
@@ -283,7 +289,10 @@ class Learner:
         self.staging = StagingBuffer(staging_cfg, broker, version_fn=lambda: self.version)
         self.flattener = ParamFlattener(state.params)
         self.publisher = WeightPublisher(
-            broker, materialize=self.flattener.to_named, boot_epoch=self.boot_epoch
+            broker,
+            materialize=self.flattener.to_named,
+            boot_epoch=self.boot_epoch,
+            legacy_dtw1=cfg.publish_legacy_dtw1,
         )
         self.metrics = MetricsLogger(cfg.log_dir)
         self.env_steps_done = 0  # total real (unmasked) env steps trained on
@@ -335,7 +344,10 @@ class Learner:
             return  # one fanout per version — process 0 publishes
         params = jax.device_get(self.state.params)
         frame = serialize_weights(
-            flatten_params(params), version=self.version, boot_epoch=self.boot_epoch
+            flatten_params(params),
+            version=self.version,
+            boot_epoch=self.boot_epoch,
+            legacy_dtw1=self.cfg.publish_legacy_dtw1,
         )
         self.broker.publish_weights(frame)
 
@@ -510,6 +522,13 @@ class Learner:
                     scalars["episodes"] = stats["episodes"]
                     scalars["weights_published"] = self.publisher.published
                     scalars["weights_coalesced"] = self.publisher.coalesced
+                    if self.checkpointer is not None:
+                        # Remote-mirror health (ADVICE r4): a growing lag
+                        # means uploads can't keep the checkpoint cadence
+                        # and durability is silently behind.
+                        for k, v in self.checkpointer.mirror_stats().items():
+                            if isinstance(v, (int, float)):
+                                scalars[f"ckpt_mirror_{k}"] = v
                     if stats["episodes"] > 0:
                         scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
                     self.metrics.log(self.version, scalars)
