@@ -118,6 +118,13 @@ class LearnerConfig:
     checkpoint_remote_dir: str = ""
     checkpoint_every: int = 100  # steps between durable checkpoints
     publish_every: int = 1  # steps between weight fanout publishes
+    # Rolling-upgrade transition flag (ADVICE r4): emit legacy DTW1
+    # weight frames (no boot_epoch) so not-yet-upgraded subscribers keep
+    # parsing while the fleet rolls. Compat is one-directional — new
+    # readers accept DTW1 — so the safe order is: (1) learner with this
+    # flag ON, (2) upgrade all actors/evaluators, (3) flag OFF to get
+    # boot-epoch resync back. Costs restart-resync determinism while ON.
+    publish_legacy_dtw1: bool = False
     # Steps between host↔device metric syncs. Fetching the metrics dict
     # forces a device sync; doing it every step serializes the host onto
     # the step's critical path (the round-2 e2e-vs-device gap). Scalars
@@ -193,8 +200,15 @@ class ActorConfig:
     league_snapshot_every: int = 20  # learner versions between snapshots
     pfsp_mode: str = "hard"  # "hard" | "even" | "uniform"
     # Kill switch: exit (for supervisor restart) if no weight broadcast
-    # arrives for this many seconds. 0 disables.
-    max_weight_age_s: float = 0.0
+    # arrives for this many seconds. 0 disables. Default ON (ADVICE r4):
+    # with the switch disabled, a mixed-version deploy whose learner
+    # emits frames this build can't parse (e.g. a future wire bump)
+    # would silently freeze policy propagation cluster-wide — per-frame
+    # warnings and an ever-staler policy. 900s is ~3 orders of magnitude
+    # above the normal broadcast cadence and comfortably above learner
+    # restart + checkpoint-restore time, so it only fires when
+    # propagation is genuinely dead.
+    max_weight_age_s: float = 900.0
     # Ablation: mask the CAST action out of every observation, so the
     # policy can never use abilities. Exists to measure whether ability
     # usage is ADVANTAGEOUS (scripts/ab_cast.py trains with and without);

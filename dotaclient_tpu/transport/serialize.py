@@ -17,12 +17,23 @@ Rollout frame layout (little-endian):
   f32    episode_return (metrics only)
   then the arrays, in fixed order, raw bytes (shapes derivable from L/H).
 
-Weight frame layout:
-  magic  b'DTW1'
+Weight frame layout (current, DTW2 — the authoritative spec any native
+or non-Python reader is written from; golden bytes frozen in
+tests/test_transport.py):
+  magic  b'DTW2'
   u32    version
+  u32    boot_epoch — identifies the publishing learner PROCESS (drawn
+         once at learner boot); subscribers resync on epoch change
   u32    n_leaves
   per leaf: u16 name_len, name bytes, u8 ndim, u32 dims…, u8 dtype_code,
             raw data.
+
+Legacy weight frame (DTW1, read-compat only; emitted only under the
+LearnerConfig.publish_legacy_dtw1 rolling-upgrade flag):
+  magic  b'DTW1'
+  u32    version
+  u32    n_leaves
+  per leaf: same as DTW2. Readers treat boot_epoch as 0.
 """
 
 from __future__ import annotations
@@ -179,7 +190,10 @@ def _dtype_code(dt) -> int:
 
 
 def serialize_weights(
-    named_arrays: List[Tuple[str, np.ndarray]], version: int, boot_epoch: int = 0
+    named_arrays: List[Tuple[str, np.ndarray]],
+    version: int,
+    boot_epoch: int = 0,
+    legacy_dtw1: bool = False,
 ) -> bytes:
     """Weight fanout frame. `boot_epoch` identifies the publishing
     learner PROCESS (drawn once at learner boot): subscribers resync on
@@ -188,10 +202,20 @@ def serialize_weights(
     Header is DTW2 <magic, version, boot_epoch, n>; readers also accept
     legacy DTW1 (no epoch → 0). Compat is one-directional: NEW readers
     accept OLD frames, but old readers reject DTW2 — so a rolling
-    upgrade must update subscribers (actors/evaluators) before the
-    learner starts emitting DTW2. Upgrading the learner first leaves old
-    actors logging 'bad weight frame' and running stale weights."""
-    parts = [struct.pack("<4sIII", _WEIGHTS_MAGIC2, version, boot_epoch & 0xFFFFFFFF, len(named_arrays))]
+    upgrade either updates subscribers (actors/evaluators) before the
+    learner starts emitting DTW2, or runs the learner with
+    LearnerConfig.publish_legacy_dtw1 (→ `legacy_dtw1=True` here) until
+    the fleet has rolled (ADVICE r4). Either way the actors' default-on
+    stale-weights kill switch turns a botched ordering into loud pod
+    restarts instead of a silent cluster-wide policy freeze."""
+    if legacy_dtw1:
+        parts = [struct.pack("<4sII", _WEIGHTS_MAGIC, version, len(named_arrays))]
+    else:
+        parts = [
+            struct.pack(
+                "<4sIII", _WEIGHTS_MAGIC2, version, boot_epoch & 0xFFFFFFFF, len(named_arrays)
+            )
+        ]
     for name, arr in named_arrays:
         arr = np.ascontiguousarray(arr)
         nb = name.encode()

@@ -153,10 +153,24 @@ def test_stale_weights_kill_switch():
     server.stop(0)
 
 
-def test_kill_switch_disabled_by_default():
+def test_kill_switch_default_on_and_zero_disables():
+    """ADVICE r4: the kill switch defaults ON (900s) so a deploy whose
+    weight propagation silently dies fails loudly; 0 still disables it
+    explicitly for drivers that run without a learner."""
+    assert ActorConfig().max_weight_age_s == 900.0
     server, port = serve(FakeDotaService(), max_workers=2)
     cfg = ActorConfig(env_addr=f"127.0.0.1:{port}", rollout_len=4, max_dota_time=2.0, policy=SMALL)
+    # Default config: weights 11.5 days stale trips the switch.
     actor = Actor(cfg, NullBroker())
+    actor.last_weight_time = time.monotonic() - 1e6
+    with pytest.raises(StaleWeightsError):
+        asyncio.new_event_loop().run_until_complete(actor.run(num_episodes=1))
+    # Explicit 0: disabled, the same staleness is ignored.
+    cfg_off = ActorConfig(
+        env_addr=f"127.0.0.1:{port}", rollout_len=4, max_dota_time=2.0, policy=SMALL,
+        max_weight_age_s=0.0,
+    )
+    actor = Actor(cfg_off, NullBroker())
     actor.last_weight_time = time.monotonic() - 1e6
     asyncio.new_event_loop().run_until_complete(actor.run(num_episodes=1))
     assert actor.episodes_done == 1

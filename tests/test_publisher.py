@@ -168,3 +168,20 @@ def test_learner_publishes_correct_weights_via_fused_path():
     assert set(got) == set(want)
     for n in want:
         np.testing.assert_array_equal(got[n], want[n], err_msg=n)
+
+
+def test_legacy_dtw1_transition_flag():
+    """ADVICE r4: LearnerConfig.publish_legacy_dtw1 routes through the
+    publisher so a rolling upgrade can keep old subscribers parsing —
+    frames go out as DTW1 (no boot_epoch) and still round-trip."""
+    broker = _RecordingBroker()
+    pub = WeightPublisher(broker, boot_epoch=1234, legacy_dtw1=True).start()
+    pub.submit(_params(2.5), version=6)
+    deadline = time.monotonic() + 10.0
+    while pub.published < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    pub.stop()
+    assert broker.frames and broker.frames[-1][:4] == b"DTW1"
+    named, version, boot_epoch = deserialize_weights(broker.frames[-1])
+    assert version == 6 and boot_epoch == 0  # DTW1 carries no epoch
+    np.testing.assert_array_equal(named[0][1], np.full((4, 4), 2.5, np.float32))

@@ -86,6 +86,56 @@ def test_rollout_rejects_garbage():
         deserialize_rollout(good + b"x")
 
 
+# --- weight-frame golden bytes (VERDICT r4 item 5) ----------------------
+#
+# serialize.py's module docstring is the wire SPEC a native (non-Python)
+# reader is written from; these bytes freeze it. Layout, annotated:
+#
+# DTW2 header: 44545732       magic b'DTW2'
+#              07000000       u32 version=7
+#              efbeadde       u32 boot_epoch=0xDEADBEEF
+#              02000000       u32 n_leaves=2
+# leaf "w":    0100 77        u16 name_len=1, name=b'w'
+#              01 02000000    u8 ndim=1, u32 dim0=2
+#              00             u8 dtype_code=0 (f32)
+#              0000803f 000000c0    [1.0, -2.0]
+# leaf "b":    0100 62        u16 name_len=1, name=b'b'
+#              01 01000000    u8 ndim=1, u32 dim0=1 (0-d input lands 1-d:
+#                             ascontiguousarray promotes scalars)
+#              02 05          u8 dtype_code=2 (u8), value 5
+WEIGHTS_DTW2_GOLDEN_HEX = (
+    "4454573207000000efbeadde020000000100770102000000000000803f000000c0"
+    "01006201010000000205"
+)
+# Legacy DTW1 (rolling-upgrade emission, LearnerConfig.publish_legacy_dtw1):
+# same layout minus the boot_epoch word.
+WEIGHTS_DTW1_GOLDEN_HEX = (
+    "4454573107000000020000000100770102000000000000803f000000c0"
+    "01006201010000000205"
+)
+
+
+def test_weight_frame_golden_bytes():
+    leaves = [("w", np.array([1.0, -2.0], np.float32)), ("b", np.array(5, np.uint8))]
+    data = serialize_weights(leaves, version=7, boot_epoch=0xDEADBEEF)
+    assert data.hex() == WEIGHTS_DTW2_GOLDEN_HEX
+    named, version, boot_epoch = deserialize_weights(data)
+    assert version == 7 and boot_epoch == 0xDEADBEEF
+    np.testing.assert_array_equal(named[0][1], [1.0, -2.0])
+    np.testing.assert_array_equal(named[1][1], [5])
+
+
+def test_weight_frame_legacy_dtw1_golden_bytes():
+    leaves = [("w", np.array([1.0, -2.0], np.float32)), ("b", np.array(5, np.uint8))]
+    data = serialize_weights(leaves, version=7, boot_epoch=0xDEADBEEF, legacy_dtw1=True)
+    assert data.hex() == WEIGHTS_DTW1_GOLDEN_HEX
+    named, version, boot_epoch = deserialize_weights(data)
+    # DTW1 carries no epoch: readers must see 0, and the boot-epoch
+    # resync feature is deliberately inert while the transition flag is on.
+    assert version == 7 and boot_epoch == 0
+    np.testing.assert_array_equal(named[0][1], [1.0, -2.0])
+
+
 def test_weights_roundtrip_with_params_tree():
     import jax
 
