@@ -218,7 +218,12 @@ def _build_reuse_step_fn(cfg: LearnerConfig, mesh, net, opt, use_sp: bool, sp: s
                     still = jnp.logical_and(active, m["approx_kl"] <= kl_stop)
                 else:
                     still = active
-                return (new_params, new_opt, still, n_upd + 1, m)
+                # Carry a running SUM over executed updates (mean taken at
+                # the end): last-minibatch metrics would be a different
+                # statistic than the single-update path's batch mean,
+                # skewing dashboards and reuse-vs-single A/Bs (ADVICE r4).
+                summed = {k: metrics[k] + m[k] for k in metrics}
+                return (new_params, new_opt, still, n_upd + 1, summed)
 
             def skip(_):
                 return carry
@@ -244,7 +249,10 @@ def _build_reuse_step_fn(cfg: LearnerConfig, mesh, net, opt, use_sp: bool, sp: s
         (params, opt_state, active, n_upd, metrics), _ = jax.lax.scan(
             epoch_body, init, jax.random.split(rng, R)
         )
-        metrics = dict(metrics)
+        # Mean over the updates that actually executed (KL stop can make
+        # that fewer than R*M) — comparable to the single-update path.
+        denom = jnp.maximum(n_upd.astype(jnp.float32), 1.0)
+        metrics = {k: v / denom for k, v in metrics.items()}
         metrics["ppo_updates_done"] = n_upd.astype(jnp.float32)
         metrics["ppo_kl_stopped"] = 1.0 - active.astype(jnp.float32)
         return TrainState(params, opt_state, state.step + 1), metrics
