@@ -65,7 +65,7 @@ async def _team_episode(cfg, step_fn, params, stub, rng, np_rng):
     """One 5v5 eval episode: our five externally-controlled radiant
     heroes (ONE shared policy, B=5 batched jit step per tick — the same
     compiled shape SelfPlayActor uses) vs five env-scripted HARD dire
-    bots. Returns (mean team return, win∈{+1,0,-1}, rng)."""
+    bots. Returns (mean team return, win∈{+1,0,-1}, net-worth gap, rng)."""
     config = ds.GameConfig(
         host_timescale=cfg.host_timescale,
         ticks_per_observation=cfg.ticks_per_observation,
@@ -115,7 +115,18 @@ async def _team_episode(cfg, step_fn, params, stub, rng, np_rng):
         per = [F.featurize_with_handles(world, pid) for pid in range(N)]
     winning = world.winning_team
     win = 0 if not winning else (1 if winning == TEAM_RADIANT else -1)
-    return float(np.mean(returns)), win, rng
+    # Net-worth margin from the FINAL worldstate (heroes carry gold+xp on
+    # the wire; summing them per team is exactly the env's time-up
+    # decider, fake_dotaservice._team_net_worth): the distance-to-win
+    # telemetry that explains the W/L column. Probe measured a RANDOM
+    # policy only ~100-300 behind 5 hard bots (~3600 each side), i.e. a
+    # handful of team last-hits decide these games.
+    nw = {TEAM_RADIANT: 0, TEAM_DIRE: 0}
+    for u in world.units:
+        if u.unit_type == ws.Unit.HERO and u.team_id in nw:
+            nw[u.team_id] += int(u.gold) + int(u.xp)
+    nw_gap = nw[TEAM_RADIANT] - nw[TEAM_DIRE]
+    return float(np.mean(returns)), win, nw_gap, rng
 
 
 def eval_team(policy_cfg, params, episodes, seed, table, slot_prefix):
@@ -139,13 +150,15 @@ def eval_team(policy_cfg, params, episodes, seed, table, slot_prefix):
     bots = [f"hard_bot_{i}" for i in range(N)]
     rets, wins, losses, draws = [], 0, 0, 0
     loop = asyncio.new_event_loop()  # one loop for the whole eval (Evaluator pattern)
+    nw_gaps = []
     try:
         for _ in range(episodes):
             stub = LocalDotaServiceStub(FakeDotaService())
-            ret, win, rng = loop.run_until_complete(
+            ret, win, nw_gap, rng = loop.run_until_complete(
                 _team_episode(cfg, step_fn, params, stub, rng, np_rng)
             )
             rets.append(ret)
+            nw_gaps.append(nw_gap)
             if win > 0:
                 table.record_teams(ours, bots)
                 wins += 1
@@ -159,6 +172,7 @@ def eval_team(policy_cfg, params, episodes, seed, table, slot_prefix):
         loop.close()
     return {
         "mean_return": float(np.mean(rets)),
+        "mean_net_worth_gap": float(np.mean(nw_gaps)),
         "wins": wins,
         "losses": losses,
         "draws": draws,
@@ -206,8 +220,10 @@ def main(argv=None) -> int:
             "train": {k: res[k] for k in
                       ("episodes", "league_sizes", "aux_keys", "version", "env_steps", "ppo")},
             "pool_dead": res["pool_dead"],
-            "init": {k: init_ev[k] for k in ("mean_return", "wins", "losses", "draws")},
-            "final": {k: final_ev[k] for k in ("mean_return", "wins", "losses", "draws")},
+            "init": {k: init_ev[k]
+                     for k in ("mean_return", "mean_net_worth_gap", "wins", "losses", "draws")},
+            "final": {k: final_ev[k]
+                      for k in ("mean_return", "mean_net_worth_gap", "wins", "losses", "draws")},
             "init_team_conservative": init_skill,
             "final_team_conservative": final_skill,
             "p_final_beats_init": wp,
@@ -252,6 +268,9 @@ def main(argv=None) -> int:
             f"- episodes W/L/D vs hard bots: init {s['init']['wins']}/"
             f"{s['init']['losses']}/{s['init']['draws']}, final {s['final']['wins']}/"
             f"{s['final']['losses']}/{s['final']['draws']}",
+            f"- mean team net-worth margin at episode end (the time-up decider): "
+            f"init {s['init']['mean_net_worth_gap']:+.0f} -> "
+            f"final {s['final']['mean_net_worth_gap']:+.0f}",
             f"- team TrueSkill (sum of conservative, bots anchored at default): "
             f"init {s['init_team_conservative']:+.2f} -> final "
             f"{s['final_team_conservative']:+.2f} "
