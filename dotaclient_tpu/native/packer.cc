@@ -28,6 +28,23 @@ namespace {
 constexpr int64_t kHeaderBytes = 21;
 constexpr uint8_t kFlagAux = 1;
 
+// f32 -> bf16 with round-to-nearest-even, the exact semantics of
+// numpy.astype(ml_dtypes.bfloat16) (and of the policy's own first-op
+// cast on device) — so converting DURING the pack memcpy is bitwise
+// identical to the python path's separate cast pass, just free.
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  if ((x & 0x7fffffffu) > 0x7f800000u) {
+    // NaN: canonicalize to sign | 0x7fc0, exactly what ml_dtypes (Eigen)
+    // does — payload bits are DROPPED, not preserved (pinned empirically:
+    // 0x7fa00000 -> 0x7fc0, 0xffa00000 -> 0xffc0; r5 review finding).
+    return static_cast<uint16_t>(((x >> 16) & 0x8000u) | 0x7fc0u);
+  }
+  const uint32_t rounding_bias = 0x7fffu + ((x >> 16) & 1u);
+  return static_cast<uint16_t>((x + rounding_bias) >> 16);
+}
+
 struct Reader {
   const uint8_t* p;
   const uint8_t* end;
@@ -40,6 +57,29 @@ struct Reader {
     }
     std::memcpy(dst, p, n);
     p += n;
+  }
+  // Read n_floats f32 from the frame, write bf16 (obs compute-dtype
+  // staging fused into the pack copy).
+  void copy_f32_to_bf16(uint16_t* dst, int64_t n_floats) {
+    if (!ok || p + n_floats * 4 > end) {
+      ok = false;
+      return;
+    }
+    for (int64_t i = 0; i < n_floats; ++i) {
+      float f;
+      std::memcpy(&f, p + i * 4, 4);
+      dst[i] = f32_to_bf16(f);
+    }
+    p += n_floats * 4;
+  }
+  // Dispatch for float OBS fields: dst_f32 points at f32 storage when
+  // obs_bf16 == 0, at bf16 (u16) storage when 1; `off` is in ELEMENTS.
+  void copy_obs(float* dst_f32, int64_t off, int64_t n_floats, int64_t obs_bf16) {
+    if (obs_bf16) {
+      copy_f32_to_bf16(reinterpret_cast<uint16_t*>(dst_f32) + off, n_floats);
+    } else {
+      copy(dst_f32 + off, n_floats * 4);
+    }
   }
   // Masks land in numpy bool arrays: normalize every byte to 0/1 (the
   // python path's astype(bool) does the same; raw !=1 bytes from an
@@ -61,6 +101,49 @@ struct Reader {
   }
 };
 
+// Parsed frame header + derived fields. ONE implementation of the
+// header layout and total-size formula, shared by all three entry
+// points — the formula in three hand-copies was an r5 review finding
+// (a format change missed in one copy silently drops every frame).
+struct Header {
+  uint32_t version;
+  uint32_t actor_id;
+  int64_t L;
+  int64_t H;
+  int64_t flags;
+  float ep_ret;
+  float last_done;
+};
+
+bool parse_header(const uint8_t* p, int64_t len,
+                  int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
+                  Header* h) {
+  if (len < kHeaderBytes || std::memcmp(p, "DTR1", 4) != 0) return false;
+  uint16_t L16, H16;
+  std::memcpy(&h->version, p + 4, 4);
+  std::memcpy(&L16, p + 8, 2);
+  std::memcpy(&H16, p + 10, 2);
+  h->flags = p[12];
+  std::memcpy(&h->actor_id, p + 13, 4);
+  std::memcpy(&h->ep_ret, p + 17, 4);
+  h->L = L16;
+  h->H = H16;
+  const int64_t T1 = h->L + 1;
+  const bool aux = (h->flags & kFlagAux) != 0;
+  const int64_t expect = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
+                         T1 * (2 * U + A) + h->L * 8 * 4 + h->H * 2 * 4 +
+                         (aux ? h->L * 3 * 4 : 0);
+  if (len != expect) return false;
+  // last element of the dones array (episode-end marker for stats)
+  h->last_done = 0.0f;
+  if (h->L > 0) {
+    const int64_t dones_off = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
+                              T1 * (2 * U + A) + h->L * 7 * 4;
+    std::memcpy(&h->last_done, p + dones_off + (h->L - 1) * 4, 4);
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -71,12 +154,16 @@ extern "C" {
 int64_t dt_pack_batch(
     const uint8_t** frames, const int64_t* frame_lens, int64_t n,
     int64_t T, int64_t H, int64_t want_aux,
+    // When 1, the three float obs outputs are bf16 (uint16) storage and
+    // the pack converts f32->bf16 in the copy loop (RNE, bitwise equal
+    // to the python cast pass). Non-obs floats are always f32.
+    int64_t obs_bf16,
     // schema dims: global, hero, units, unit-features, action-types
     int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
     // batch outputs (C-contiguous, leading dim n):
-    float* global_f,   // [n, T+1, G]
-    float* hero_f,     // [n, T+1, HF]
-    float* unit_f,     // [n, T+1, U, UF]
+    float* global_f,   // [n, T+1, G] (f32 or bf16, see obs_bf16)
+    float* hero_f,     // [n, T+1, HF] (f32 or bf16)
+    float* unit_f,     // [n, T+1, U, UF] (f32 or bf16)
     uint8_t* unit_m,   // [n, T+1, U]
     uint8_t* target_m, // [n, T+1, U]
     uint8_t* action_m, // [n, T+1, A]
@@ -90,33 +177,17 @@ int64_t dt_pack_batch(
   for (int64_t b = 0; b < n; ++b) {
     const uint8_t* p = frames[b];
     const int64_t len = frame_lens[b];
-    if (len < kHeaderBytes || std::memcmp(p, "DTR1", 4) != 0) return -(b + 1);
-
-    uint32_t version, actor_id;
-    uint16_t L16, H16;
-    uint8_t flags;
-    float ep_ret;
-    std::memcpy(&version, p + 4, 4);
-    std::memcpy(&L16, p + 8, 2);
-    std::memcpy(&H16, p + 10, 2);
-    flags = p[12];
-    std::memcpy(&actor_id, p + 13, 4);
-    std::memcpy(&ep_ret, p + 17, 4);
-
-    const int64_t L = L16;
-    if (L > T || L < 0 || H16 != H) return -(b + 1);
-    const bool frame_aux = (flags & kFlagAux) != 0;
+    Header hdr;
+    if (!parse_header(p, len, G, HF, U, UF, A, &hdr)) return -(b + 1);
+    const int64_t L = hdr.L;
+    if (L > T || hdr.H != H) return -(b + 1);
+    const bool frame_aux = (hdr.flags & kFlagAux) != 0;
     const int64_t T1 = L + 1;
 
-    const int64_t expect = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
-                           T1 * (2 * U + A) + L * 8 * 4 + H * 2 * 4 +
-                           (frame_aux ? L * 3 * 4 : 0);
-    if (len != expect) return -(b + 1);
-
     Reader r{p + kHeaderBytes, p + len, true};
-    r.copy(global_f + b * T1o * G, T1 * G * 4);
-    r.copy(hero_f + b * T1o * HF, T1 * HF * 4);
-    r.copy(unit_f + b * T1o * U * UF, T1 * U * UF * 4);
+    r.copy_obs(global_f, b * T1o * G, T1 * G, obs_bf16);
+    r.copy_obs(hero_f, b * T1o * HF, T1 * HF, obs_bf16);
+    r.copy_obs(unit_f, b * T1o * U * UF, T1 * U * UF, obs_bf16);
     r.copy_bool(unit_m + b * T1o * U, T1 * U);
     r.copy_bool(target_m + b * T1o * U, T1 * U);
     r.copy_bool(action_m + b * T1o * A, T1 * A);
@@ -143,11 +214,41 @@ int64_t dt_pack_batch(
 
     float* m = mask + b * T;
     for (int64_t t = 0; t < L; ++t) m[t] = 1.0f;
-    versions[b] = version;
-    actor_ids[b] = actor_id;
-    ep_returns[b] = ep_ret;
+    versions[b] = hdr.version;
+    actor_ids[b] = hdr.actor_id;
+    ep_returns[b] = hdr.ep_ret;
   }
   return 0;
+}
+
+// Batched header peek: one call validates and parses ALL frames of an
+// ingest drain, writing parallel arrays (ok[b]=0 marks a malformed
+// frame; its other outputs are unspecified). Exists because the ctypes
+// boundary costs ~5us per call — at 256 frames/batch the per-frame
+// dt_frame_header loop was 1.3ms of pure FFI overhead on the staging
+// thread (r5 profile), a third of the whole host packing budget.
+// Returns the number of well-formed frames.
+int64_t dt_frame_headers(
+    const uint8_t** frames, const int64_t* frame_lens, int64_t n,
+    int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
+    int64_t* versions, int64_t* Ls, int64_t* Hs, int64_t* flags_out,
+    int64_t* actor_ids, float* ep_rets, float* last_dones, uint8_t* ok) {
+  int64_t n_ok = 0;
+  for (int64_t b = 0; b < n; ++b) {
+    ok[b] = 0;
+    Header hdr;
+    if (!parse_header(frames[b], frame_lens[b], G, HF, U, UF, A, &hdr)) continue;
+    versions[b] = hdr.version;
+    Ls[b] = hdr.L;
+    Hs[b] = hdr.H;
+    flags_out[b] = hdr.flags;
+    actor_ids[b] = hdr.actor_id;
+    ep_rets[b] = hdr.ep_ret;
+    last_dones[b] = hdr.last_done;
+    ok[b] = 1;
+    ++n_ok;
+  }
+  return n_ok;
 }
 
 // Header peek for the ingest filter: writes {version, L, H, flags,
@@ -158,33 +259,15 @@ int64_t dt_frame_header(
     int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
     int64_t* version, int64_t* L_out, int64_t* H_out, int64_t* flags_out,
     int64_t* actor_id, float* ep_ret, float* last_done) {
-  if (len < kHeaderBytes || std::memcmp(p, "DTR1", 4) != 0) return -1;
-  uint32_t v, aid;
-  uint16_t L16, H16;
-  std::memcpy(&v, p + 4, 4);
-  std::memcpy(&L16, p + 8, 2);
-  std::memcpy(&H16, p + 10, 2);
-  const uint8_t flags = p[12];
-  std::memcpy(&aid, p + 13, 4);
-  std::memcpy(ep_ret, p + 17, 4);
-  const int64_t L = L16, H = H16, T1 = L + 1;
-  const bool aux = (flags & kFlagAux) != 0;
-  const int64_t expect = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
-                         T1 * (2 * U + A) + L * 8 * 4 + H * 2 * 4 +
-                         (aux ? L * 3 * 4 : 0);
-  if (len != expect) return -1;
-  // last element of the dones array (episode-end marker for stats)
-  *last_done = 0.0f;
-  if (L > 0) {
-    const int64_t dones_off = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
-                              T1 * (2 * U + A) + L * 7 * 4;
-    std::memcpy(last_done, p + dones_off + (L - 1) * 4, 4);
-  }
-  *version = v;
-  *L_out = L;
-  *H_out = H;
-  *flags_out = flags;
-  *actor_id = aid;
+  Header hdr;
+  if (!parse_header(p, len, G, HF, U, UF, A, &hdr)) return -1;
+  *version = hdr.version;
+  *L_out = hdr.L;
+  *H_out = hdr.H;
+  *flags_out = hdr.flags;
+  *actor_id = hdr.actor_id;
+  *ep_ret = hdr.ep_ret;
+  *last_done = hdr.last_done;
   return 0;
 }
 

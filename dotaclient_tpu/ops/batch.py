@@ -45,7 +45,7 @@ class TrainBatch(NamedTuple):
     aux: Optional[AuxTargets] = None  # present iff cfg.policy.aux_heads
 
 
-def zeros_train_batch(B: int, T: int, lstm_hidden: int, with_aux: bool) -> TrainBatch:
+def zeros_train_batch(B: int, T: int, lstm_hidden: int, with_aux: bool, obs_dtype=None) -> TrainBatch:
     """The one canonical all-zeros numpy TrainBatch skeleton.
 
     Single source of truth for the batch layout: the staging packer fills
@@ -58,10 +58,14 @@ def zeros_train_batch(B: int, T: int, lstm_hidden: int, with_aux: bool) -> Train
 
     from dotaclient_tpu.env import featurizer as F
 
+    # obs_dtype overrides the FLOAT obs leaves only (staging's native
+    # bf16 path allocates the compute dtype so the C packer converts
+    # during the copy); masks and every non-obs leaf keep their types.
+    odt = obs_dtype if obs_dtype is not None else np.float32
     obs = Observation(
-        global_feats=np.zeros((B, T + 1, F.GLOBAL_FEATURES), np.float32),
-        hero_feats=np.zeros((B, T + 1, F.HERO_FEATURES), np.float32),
-        unit_feats=np.zeros((B, T + 1, F.MAX_UNITS, F.UNIT_FEATURES), np.float32),
+        global_feats=np.zeros((B, T + 1, F.GLOBAL_FEATURES), odt),
+        hero_feats=np.zeros((B, T + 1, F.HERO_FEATURES), odt),
+        unit_feats=np.zeros((B, T + 1, F.MAX_UNITS, F.UNIT_FEATURES), odt),
         unit_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
         target_mask=np.zeros((B, T + 1, F.MAX_UNITS), bool),
         action_mask=np.tile(F.zeros_observation().action_mask, (B, T + 1, 1)),

@@ -203,29 +203,32 @@ class StagingBuffer:
         if self._lib is not None:
             from dotaclient_tpu import native
 
+            # Fuse the compute-dtype obs cast into the C copy loop when
+            # staging targets bf16 (bitwise equal to the separate numpy
+            # astype pass it replaces; ~1.1ms/batch at flagship shapes).
+            obs_bf16 = (
+                self.cfg.stage_obs_compute_dtype and self.cfg.policy.dtype == "bfloat16"
+            )
             batch = native.pack_frames(
                 self._lib,
                 items,
                 self.cfg.seq_len,
                 self.cfg.policy.lstm_hidden,
                 self.cfg.policy.aux_heads,
+                obs_bf16=obs_bf16,
             )
-        else:
-            batch = pack_rollouts(items, self.cfg.seq_len, self.cfg.policy.aux_heads)
+            if obs_bf16:
+                return batch  # cast already applied in-copy
+            return cast_obs_to_compute_dtype(self.cfg, batch)
+        batch = pack_rollouts(items, self.cfg.seq_len, self.cfg.policy.aux_heads)
         return cast_obs_to_compute_dtype(self.cfg, batch)
 
     def _parse(self, frame: bytes):
-        """One frame → (pending_item, version, L, H, actor_id, ep_return,
-        last_done) or None if malformed. Native keeps raw bytes (the C
-        packer reads them later); python keeps the deserialized Rollout."""
-        if self._lib is not None:
-            from dotaclient_tpu import native
-
-            hdr = native.frame_header(self._lib, frame)
-            if hdr is None:
-                return None
-            version, L, frame_h, _flags, actor_id, ep_ret, last_done = hdr
-            return frame, version, L, frame_h, actor_id, ep_ret, last_done
+        """PYTHON-fallback frame parse → (Rollout, version, L, H,
+        actor_id, ep_return, last_done) or None if malformed. The native
+        path never comes through here — _ingest parses a whole drain in
+        one `native.frame_headers` call and keeps raw frame bytes for
+        the C packer."""
         try:
             r = deserialize_rollout(frame)
         except (ValueError, KeyError):
@@ -244,12 +247,29 @@ class StagingBuffer:
     def _ingest(self, frames: List[bytes]) -> None:
         min_version = self.version_fn() - self.cfg.ppo.max_staleness
         H = self.cfg.policy.lstm_hidden
-        consumed = dropped_stale = dropped_bad = episodes = 0
+        consumed = len(frames)
+        dropped_stale = dropped_bad = episodes = 0
         ep_ret = 0.0
         now = time.monotonic()
-        for frame in frames:
-            consumed += 1
-            parsed = self._parse(frame)
+        if self._lib is not None:
+            # ONE ctypes call parses/validates every frame of the drain
+            # (the per-frame FFI loop cost 1.3ms/batch at 256 frames —
+            # r5 profile); the python loop below then touches only plain
+            # ints/floats.
+            from dotaclient_tpu import native
+
+            ok, versions, Ls, Hs, _flags, actor_ids, ep_rets, last_dones = (
+                native.frame_headers(self._lib, frames)
+            )
+            parsed_iter = (
+                (frames[i], versions[i], Ls[i], Hs[i], actor_ids[i], ep_rets[i], last_dones[i])
+                if ok[i]
+                else None
+                for i in range(consumed)
+            )
+        else:
+            parsed_iter = (self._parse(f) for f in frames)
+        for parsed in parsed_iter:
             if parsed is None:
                 dropped_bad += 1
                 continue

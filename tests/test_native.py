@@ -136,3 +136,97 @@ def test_staging_reports_native_flag():
     cfg = LearnerConfig(batch_size=4, seq_len=8, policy=SMALL)
     st = StagingBuffer(cfg, connect("mem://natflag"))
     assert st.native is True
+
+
+def test_bf16_in_copy_cast_bitwise_matches_numpy():
+    """r5 host-packing: obs_bf16=True fuses the f32->bf16 cast into the C
+    copy loop. Must be BITWISE equal to the python path (pack then
+    numpy astype via cast_obs_to_compute_dtype), including NaN/inf and
+    round-to-nearest-even ties."""
+    import ml_dtypes
+
+    from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
+
+    rollouts = [make_rollout(L=L, H=8, version=i, seed=i, aux=False) for i, L in enumerate([4, 8, 3])]
+    # Salt the obs with cast edge cases: specials, a tie that RNE rounds
+    # down (0x1.01p0 -> low bits 0x8000 with even target), denormals.
+    specials = np.array(
+        [np.nan, np.inf, -np.inf, 0.0, -0.0, 1.0 + 2 ** -8, 1.0 + 2 ** -9, 3.0 + 2 ** -8, 1e-40, -1e-40],
+        np.float32,
+    )
+    # Non-canonical NaNs (payload bits set): ml_dtypes canonicalizes to
+    # sign|0x7fc0, dropping the payload — the C path must match (r5
+    # review finding), not preserve bits.
+    payload_nans = np.array([0x7FA00000, 0xFFA00001, 0x7F800001], np.uint32).view(np.float32)
+    specials = np.concatenate([specials, payload_nans])
+    g = rollouts[0].obs.global_feats
+    g.flat[: specials.size] = specials
+    frames = [serialize_rollout(r) for r in rollouts]
+
+    cfg = LearnerConfig(
+        batch_size=3, seq_len=8,
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="bfloat16"),
+    )
+    py = cast_obs_to_compute_dtype(cfg, pack_rollouts(rollouts, seq_len=8, with_aux=False))
+    nat = native.pack_frames(lib, frames, seq_len=8, lstm_hidden=8, with_aux=False, obs_bf16=True)
+    for field in ("global_feats", "hero_feats", "unit_feats"):
+        a, b = getattr(py.obs, field), getattr(nat.obs, field)
+        assert a.dtype == ml_dtypes.bfloat16 and b.dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(a.view(np.uint16), b.view(np.uint16))
+    # non-obs floats stay f32 and identical
+    np.testing.assert_array_equal(py.rewards, nat.rewards)
+    assert nat.rewards.dtype == np.float32
+
+
+def test_frame_headers_batched_matches_per_frame():
+    """The one-call header parse must agree with dt_frame_header on every
+    field and flag malformed frames without poisoning neighbors."""
+    rollouts = [make_rollout(L=L, H=8, version=10 + i, actor_id=100 + i, seed=i, aux=(i % 2 == 0))
+                for i, L in enumerate([4, 8, 1])]
+    frames = [serialize_rollout(r) for r in rollouts]
+    frames.insert(1, b"DTR1 corrupt")      # malformed in the middle
+    frames.append(frames[0][: len(frames[0]) // 2])  # truncated at the end
+
+    ok, versions, Ls, Hs, flags, actor_ids, ep_rets, last_dones = native.frame_headers(lib, frames)
+    assert ok == [1, 0, 1, 1, 0]
+    for i, f in enumerate(frames):
+        single = native.frame_header(lib, f)
+        if not ok[i]:
+            assert single is None
+            continue
+        assert single == (versions[i], Ls[i], Hs[i], flags[i], actor_ids[i],
+                          pytest.approx(ep_rets[i]), last_dones[i])
+
+
+def test_staging_native_bf16_path_matches_python_fallback():
+    """End-to-end through StagingBuffer with a bf16 policy: the native
+    in-copy cast path and the python fallback (deserialize + numpy pack +
+    astype) must produce bitwise-identical batches."""
+    policy = PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="bfloat16")
+    cfg = LearnerConfig(batch_size=4, seq_len=8, policy=policy)
+    rollouts = [make_rollout(L=8, H=8, version=0, actor_id=i, seed=i) for i in range(4)]
+    frames = [serialize_rollout(r) for r in rollouts]
+
+    batches = {}
+    for name in ("native", "python"):
+        mem.reset(f"bf16_{name}")
+        broker = connect(f"mem://bf16_{name}")
+        st = StagingBuffer(cfg, broker, version_fn=lambda: 0)
+        if name == "python":
+            st._lib = None
+        assert st.native == (name == "native")
+        for f in frames:
+            broker.publish_experience(f)
+        st.start()
+        batches[name] = st.get_batch(timeout=30)
+        st.stop()
+    nat, py = batches["native"], batches["python"]
+    import ml_dtypes
+
+    assert nat.obs.global_feats.dtype == ml_dtypes.bfloat16
+    for field in ("global_feats", "hero_feats", "unit_feats"):
+        np.testing.assert_array_equal(
+            getattr(nat.obs, field).view(np.uint16), getattr(py.obs, field).view(np.uint16)
+        )
+    leaves_equal(nat.actions, py.actions)
+    np.testing.assert_array_equal(nat.mask, py.mask)
