@@ -135,6 +135,16 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    if args.cpu_smoke:
+        # Pin the host backend BEFORE any backend init: sitecustomize
+        # forces jax_platforms="axon,cpu", and when the tunneled chip is
+        # in its indefinite-hang mode, jax.default_backend() below would
+        # hang forever — the smoke must not depend on the plugin failing
+        # FAST (it did in r4; it hangs in r5). Env vars don't work here
+        # (sitecustomize overrides them); only this in-process update
+        # wins (bench.py:_probe_tpu notes).
+        jax.config.update("jax_platforms", "cpu")
+
     backend = jax.default_backend()
     artifact = {
         "backend": backend,
