@@ -1,0 +1,42 @@
+"""The round-open reference check (scripts/refcheck.py) is a judge-
+directed standing step (VERDICT r4 item 8); this pins its artifact
+contract so a refactor can't silently break the round-open ritual."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_refcheck_writes_artifact(tmp_path):
+    ref_populated = any(
+        files for _, _, files in os.walk("/root/reference")
+    ) if os.path.isdir("/root/reference") else False
+    if ref_populated:
+        # With a populated mount, refcheck runs the full grep checklist
+        # PLUS a nested pytest of the Valve wire diff — minutes of work
+        # that belongs to the round-open step (which runs it for real),
+        # not the fast default gate.
+        pytest.skip("reference mount populated — refcheck exercised by the round-open step")
+    out = os.path.join(REPO, "REFCHECK_r99.json")
+    try:
+        # Timeout must exceed refcheck's own inner wire-test budget
+        # (600s) so a populated-mount future never turns this into an
+        # uncaught TimeoutExpired instead of a contract check.
+        proc = subprocess.run(
+            [sys.executable, "scripts/refcheck.py", "--round", "99"],
+            cwd=REPO, capture_output=True, timeout=900,
+        )
+        assert proc.returncode == 0, proc.stderr.decode()
+        data = json.load(open(out))
+        assert data["round"] == 99
+        assert "reference_file_count" in data and "status" in data
+        assert data["status"] == "mount_empty"
+        assert "[MED]" in data["note"]
+    finally:
+        if os.path.exists(out):
+            os.unlink(out)
