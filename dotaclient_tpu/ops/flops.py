@@ -77,9 +77,10 @@ def train_step_flops(cfg: LearnerConfig) -> float:
 
     NOTE: XLA's cost_analysis() counts a lax.scan/while BODY once,
     ignoring trip count (measured r4: the R=2,M=2 program reports FEWER
-    flops than R=1,M=1), so the model-vs-XLA pin in tests/test_flops.py
-    only holds for the scan-free single-update step; the reuse model is
-    pinned analytically against it instead.
+    flops than R=1,M=1), so the PRODUCTION reuse step can't be pinned
+    directly. tests/test_flops.py instead pins the reuse model against a
+    Python-UNROLLED compile of the same math (every update counted), so
+    the (3R+1) trip-count structure is compiler-verified after all.
     """
     frames = cfg.batch_size * (cfg.seq_len + 1)
     fwd = frames * policy_forward_flops_per_frame(cfg.policy)
