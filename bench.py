@@ -126,11 +126,23 @@ def _probe_tpu():
         while time.time() < t_end:
             yield min(60.0, max(5.0, t_end - time.time()))
 
+    # The probe must prove an op EXECUTES, not just that the plugin lists
+    # the chip: the 20260731T0346 window answered jax.devices() in 2.6s,
+    # then every device op hung — a list-only probe would green-light the
+    # parent into initializing the wedged backend in-process. (Same fix
+    # as scripts/tpu_prober.py:_probe — duplication is deliberate there.)
+    probe_src = (
+        "import jax, jax.numpy as jnp\n"
+        "n = len(jax.devices())\n"
+        "x = jnp.ones((512, 512))\n"
+        "jax.block_until_ready(jax.jit(lambda a: a @ a)(x))\n"
+        "print(n)\n"
+    )
     reasons = []
     for timeout_s in schedule():
         with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
             proc = subprocess.Popen(
-                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                [sys.executable, "-c", probe_src],
                 stdout=out_f,
                 stderr=err_f,
                 start_new_session=True,
@@ -155,7 +167,7 @@ def _probe_tpu():
             tail = " | ".join(err_lines[-3:]) if err_lines else "<empty>"
             reasons.append(
                 f"probe({timeout_s:.0f}s): "
-                f"{'TIMEOUT inside jax.devices()' if timed_out else f'rc={rc}'} "
+                f"{'TIMEOUT inside devices+matmul probe' if timed_out else f'rc={rc}'} "
                 f"stderr_tail={tail}"
             )
         last_attempt = t_end is None and timeout_s == 300.0 or (

@@ -21,6 +21,9 @@ window closed. This prober runs detached from round start:
     task (window may close mid-list; committed partial evidence beats
     uncommitted complete evidence), then the prober EXITS 0 so the
     driving session is notified and can restart it for a later window.
+    EXCEPTION: a false window (a task timed out before ANY task produced
+    evidence — the probe passed but the tunnel wedged) resumes the probe
+    loop instead of exiting; see run_window.
 
 Run: python scripts/tpu_prober.py [--interval 900] [--max-hours 11.5]
 
@@ -63,9 +66,22 @@ def _probe(timeout_s: float):
     outlive the probe and wedge pipe reads (bench.py:_probe_tpu notes).
     """
     t0 = time.time()
+    # The probe must prove an op EXECUTES, not just that the plugin lists
+    # the chip: the 20260731T0346 window answered jax.devices() in 2.6s,
+    # then every device op hung — bench burned its whole 1500s budget on
+    # a wedge and the prober bailed out of the remaining task list. A
+    # blocked 512x512 matmul is the cheapest "the tunnel actually moves
+    # data and compiles" witness.
+    probe_src = (
+        "import jax, jax.numpy as jnp\n"
+        "ds = [str(d) for d in jax.devices()]\n"
+        "x = jnp.ones((512, 512))\n"
+        "jax.block_until_ready(jax.jit(lambda a: a @ a)(x))\n"
+        "print(ds)\n"
+    )
     with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
         proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax; print([str(d) for d in jax.devices()])"],
+            [sys.executable, "-c", probe_src],
             stdout=out_f,
             stderr=err_f,
             start_new_session=True,
@@ -207,21 +223,38 @@ def window_tasks(ts: str):
     ]
 
 
-def run_window(ts: str, tasks=None) -> None:
+def run_window(ts: str, tasks=None) -> bool:
     """Execute the window task list, committing artifacts after EACH task
     (the window can close mid-list; committed partial evidence beats
     uncommitted complete evidence). Bails on the first TIMEOUT — a hung
-    backend would eat the remaining tasks' budgets for nothing."""
+    backend would eat the remaining tasks' budgets for nothing.
+
+    Returns False ONLY for the false-window signature — a task TIMED OUT
+    (tunnel wedged mid-task) and no task before it produced evidence — so
+    main() resumes the probe loop. Every other outcome returns True and
+    the prober exits 0: deterministic fast failures (rc!=0, error
+    contract) are code problems the driving session must see once, not
+    re-run every interval until the deadline."""
     task_list = tasks if tasks is not None else window_tasks(ts)
+    any_ok = False
+    timed_out = False
     for name, cmd, env_extra, timeout_s, out_path, artifacts in task_list:
         t_ok, t_detail = _run_task(cmd, env_extra, timeout_s, out_path)
+        any_ok = any_ok or t_ok
         _append_log(f"| {_utc()} | task | {name}: {t_detail} |")
         paths = [LOG] + [a for a in artifacts if os.path.exists(os.path.join(REPO, a))]
         _git_commit(paths, f"TPU window {ts}: {name} {'ok' if t_ok else '- ' + t_detail[:60]}")
         if not t_ok and "TIMEOUT" in t_detail:
+            timed_out = True
             break
-    _append_log(f"| {_utc()} | n/a | window tasks done; prober exiting for restart |")
+    false_window = timed_out and not any_ok
+    _append_log(
+        f"| {_utc()} | n/a | window tasks done "
+        f"({'with evidence' if any_ok else 'WITHOUT evidence'}); "
+        f"{'false window - resuming probe loop' if false_window else 'prober exiting for restart'} |"
+    )
     _git_commit([LOG], f"TPU window {ts}: window tasks complete")
+    return not false_window
 
 
 def main(argv=None) -> int:
@@ -250,8 +283,9 @@ def main(argv=None) -> int:
             f"bench / silicon soak / full-step parity / tf bench / lstm |"
         )
         _git_commit([LOG], f"TPU window {ts}: chip answered, window tasks starting")
-        run_window(ts)
-        return 0
+        if run_window(ts):
+            return 0
+        time.sleep(args.interval)
     return 1  # no window before the deadline
 
 

@@ -230,3 +230,32 @@ def test_staging_native_bf16_path_matches_python_fallback():
         )
     leaves_equal(nat.actions, py.actions)
     np.testing.assert_array_equal(nat.mask, py.mask)
+
+
+def test_isa_fingerprint_invalidates_foreign_so(tmp_path, monkeypatch):
+    """A cached -march=native .so from a DIFFERENT host must be rebuilt,
+    not loaded (mtime alone would reuse it and risk SIGILL mid-pack)."""
+    import shutil
+
+    src = tmp_path / "packer.cc"
+    so = tmp_path / "_packer.so"
+    shutil.copy(native._SRC, src)
+    monkeypatch.setattr(native, "_SRC", str(src))
+    monkeypatch.setattr(native, "_LIB", str(so))
+    monkeypatch.setattr(native, "_LIB_HOST", str(so) + ".host")
+    monkeypatch.setattr(native, "_DIR", str(tmp_path))
+
+    assert native._build() and so.exists()
+    assert (tmp_path / "_packer.so.host").read_text() == native._host_isa()
+    first_build = so.stat().st_mtime_ns
+
+    # Same host, valid fingerprint: cache hit, no rebuild.
+    assert native._build()
+    assert so.stat().st_mtime_ns == first_build
+
+    # Forge a foreign host's fingerprint: must rebuild even though the
+    # .so is newer than the source.
+    (tmp_path / "_packer.so.host").write_text("deadbeefdeadbeef")
+    assert native._build()
+    assert so.stat().st_mtime_ns != first_build
+    assert (tmp_path / "_packer.so.host").read_text() == native._host_isa()

@@ -65,7 +65,7 @@ def test_run_window_commits_each_artifact(prober):
             ["B.json"],
         ),
     ]
-    prober.run_window("TEST", tasks=tasks)
+    assert prober.run_window("TEST", tasks=tasks) is True  # real window: exit for restart
     assert os.path.exists(os.path.join(prober.REPO, "A.json"))
     assert os.path.exists(os.path.join(prober.REPO, "B.json"))
     log = open(prober.LOG).read()
@@ -132,11 +132,15 @@ def test_run_window_bails_on_timeout_but_commits_partials(prober):
             ["after.json"],
         ),
     ]
-    prober.run_window("TEST", tasks=tasks)
+    # A first-task hang with nothing produced is a FALSE window (probe
+    # passed, tunnel wedged — the 20260731T0346 mode): run_window must
+    # return False so main() resumes the probe loop instead of exiting.
+    assert prober.run_window("TEST", tasks=tasks) is False
     assert os.path.exists(os.path.join(prober.REPO, "partial.json"))
     assert not os.path.exists(os.path.join(prober.REPO, "after.json"))
     log = open(prober.LOG).read()
     assert "TIMEOUT" in log and "never-runs" not in log
+    assert "false window" in log
     assert any("partial.json" not in m and "writes-then-hangs" in m for m in _commits(prober.REPO))
 
 
