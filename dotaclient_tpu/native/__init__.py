@@ -245,6 +245,7 @@ def pack_frames(
     lstm_hidden: int,
     with_aux: bool,
     obs_bf16: bool = False,
+    out=None,
 ):
     """Pack B wire frames into one padded TrainBatch (numpy leaves).
 
@@ -255,16 +256,75 @@ def pack_frames(
     f32→bf16 (RNE) inside the C copy loop — fusing staging's
     cast_obs_to_compute_dtype pass (1.1ms/batch of numpy astype at
     flagship shapes, r5 profile) into the pack for free, bitwise equal.
+
+    `out`: a pre-allocated, pre-zeroed TrainBatch to fill instead of
+    allocating one. Leaves may be row-strided views (the fused-H2D
+    group-buffer layout, FusedBatchIO.alloc_views) as long as each row's
+    data is contiguous — per-leaf row strides are passed to C. The
+    caller owns initialization (zeros + NOOP-legal action-mask padding,
+    exactly zeros_train_batch's contract).
     """
     from dotaclient_tpu.ops.batch import zeros_train_batch
 
     n = len(frames)
-    obs_dtype = None
-    if obs_bf16:
-        import ml_dtypes
+    if out is None:
+        obs_dtype = None
+        if obs_bf16:
+            import ml_dtypes
 
-        obs_dtype = ml_dtypes.bfloat16
-    batch = zeros_train_batch(n, seq_len, lstm_hidden, with_aux, obs_dtype=obs_dtype)
+            obs_dtype = ml_dtypes.bfloat16
+        batch = zeros_train_batch(n, seq_len, lstm_hidden, with_aux, obs_dtype=obs_dtype)
+        strides_arg = None
+    else:
+        batch = out
+        # Row stride in ELEMENTS per output, C-ABI order. Rows must be
+        # internally contiguous; only the row-to-row distance may differ
+        # from dense (the group-buffer column-block case).
+        aux_leaves = (
+            (batch.aux.win, batch.aux.last_hit, batch.aux.net_worth)
+            if batch.aux is not None
+            else (None, None, None)
+        )
+        ordered = (
+            batch.obs.global_feats, batch.obs.hero_feats, batch.obs.unit_feats,
+            batch.obs.unit_mask, batch.obs.target_mask, batch.obs.action_mask,
+            batch.actions.type, batch.actions.move_x, batch.actions.move_y,
+            batch.actions.target,
+            batch.behavior_logp, batch.behavior_value, batch.rewards,
+            batch.dones, batch.mask,
+            batch.initial_state[0], batch.initial_state[1],
+        ) + aux_leaves
+        # Expected dtype per output, same order as `ordered` — the C
+        # writer's widths are fixed, so a template/flag mismatch (e.g. an
+        # uncast f32 template with obs_bf16=True) must fail HERE, not
+        # silently reinterpret the storage and ship garbage obs.
+        obs_dt = "bfloat16" if obs_bf16 else "float32"
+        expect_dtypes = (
+            [obs_dt] * 3 + ["bool"] * 3 + ["int32"] * 4 + ["float32"] * 7 + ["float32"] * 3
+        )
+        stride_vals = []
+        for arr, want in zip(ordered, expect_dtypes):
+            if arr is None:
+                stride_vals.append(0)
+                continue
+            if np.dtype(arr.dtype).name != want:
+                raise ValueError(
+                    f"out leaf dtype {np.dtype(arr.dtype).name} != {want} "
+                    f"(obs_bf16={obs_bf16}; template/flag mismatch)"
+                )
+            if arr.shape[0] != n:
+                raise ValueError(f"out batch rows {arr.shape[0]} != {n} frames")
+            stride_elems, rem = divmod(arr.strides[0], arr.itemsize)
+            if rem:
+                raise ValueError("out leaf row stride not a multiple of itemsize")
+            # within-row contiguity: trailing dims must be C-contiguous
+            expect = arr.itemsize
+            for dim, st_b in zip(arr.shape[:0:-1], arr.strides[:0:-1]):
+                if st_b != expect:
+                    raise ValueError("out leaf rows must be internally contiguous")
+                expect *= dim
+            stride_vals.append(stride_elems)
+        strides_arg = (ctypes.c_int64 * 20)(*stride_vals)
     G, HF, U, UF, A = _schema_dims()
 
     frame_ptrs = (ctypes.c_char_p * n)(*frames)
@@ -295,6 +355,7 @@ def pack_frames(
         ctypes.c_int64(1 if with_aux else 0),
         ctypes.c_int64(1 if obs_bf16 else 0),
         *(ctypes.c_int64(d) for d in (G, HF, U, UF, A)),
+        strides_arg,
         fp(obs.global_feats),
         fp(obs.hero_feats),
         fp(obs.unit_feats),

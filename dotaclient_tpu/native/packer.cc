@@ -151,6 +151,16 @@ extern "C" {
 // Returns 0 on success, -(b+1) if frame b is malformed or inconsistent
 // with (T, H, schema dims). On error the outputs may be partially
 // written; the caller discards the batch.
+//
+// `row_strides` (nullable): per-output distance IN ELEMENTS between
+// consecutive batch rows, in the exact order of the 20 array outputs
+// below (global_f..aux_nw). NULL means every output is a dense
+// C-contiguous [n, ...] array (stride = the row's own element count).
+// Non-NULL is the fused-H2D path: each output is a column block of a
+// dtype-grouped [n, group_cols] buffer (parallel/fused_io.py), so the
+// pack writes the device-transfer layout directly and the python-side
+// regroup copy disappears. Within a row a block is contiguous either
+// way — only the row-to-row stride differs.
 int64_t dt_pack_batch(
     const uint8_t** frames, const int64_t* frame_lens, int64_t n,
     int64_t T, int64_t H, int64_t want_aux,
@@ -160,7 +170,8 @@ int64_t dt_pack_batch(
     int64_t obs_bf16,
     // schema dims: global, hero, units, unit-features, action-types
     int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
-    // batch outputs (C-contiguous, leading dim n):
+    const int64_t* row_strides,
+    // batch outputs (leading dim n; see row_strides):
     float* global_f,   // [n, T+1, G] (f32 or bf16, see obs_bf16)
     float* hero_f,     // [n, T+1, HF] (f32 or bf16)
     float* unit_f,     // [n, T+1, U, UF] (f32 or bf16)
@@ -174,6 +185,14 @@ int64_t dt_pack_batch(
     // per-frame metadata:
     uint32_t* versions, uint32_t* actor_ids, float* ep_returns) {
   const int64_t T1o = T + 1;  // output time rows per sequence
+  const int64_t dense[20] = {
+      T1o * G, T1o * HF, T1o * U * UF,       // global_f, hero_f, unit_f
+      T1o * U, T1o * U, T1o * A,             // unit_m, target_m, action_m
+      T, T, T, T,                            // act_type, act_mx, act_my, act_tg
+      T, T, T, T, T,                         // logp, value, rewards, dones, mask
+      H, H,                                  // init_c, init_h
+      T, T, T};                              // aux_win, aux_lh, aux_nw
+  const int64_t* st = row_strides != nullptr ? row_strides : dense;
   for (int64_t b = 0; b < n; ++b) {
     const uint8_t* p = frames[b];
     const int64_t len = frame_lens[b];
@@ -185,34 +204,34 @@ int64_t dt_pack_batch(
     const int64_t T1 = L + 1;
 
     Reader r{p + kHeaderBytes, p + len, true};
-    r.copy_obs(global_f, b * T1o * G, T1 * G, obs_bf16);
-    r.copy_obs(hero_f, b * T1o * HF, T1 * HF, obs_bf16);
-    r.copy_obs(unit_f, b * T1o * U * UF, T1 * U * UF, obs_bf16);
-    r.copy_bool(unit_m + b * T1o * U, T1 * U);
-    r.copy_bool(target_m + b * T1o * U, T1 * U);
-    r.copy_bool(action_m + b * T1o * A, T1 * A);
-    r.copy(act_type + b * T, L * 4);
-    r.copy(act_mx + b * T, L * 4);
-    r.copy(act_my + b * T, L * 4);
-    r.copy(act_tg + b * T, L * 4);
-    r.copy(logp + b * T, L * 4);
-    r.copy(value + b * T, L * 4);
-    r.copy(rewards + b * T, L * 4);
-    r.copy(dones + b * T, L * 4);
-    r.copy(init_c + b * H, H * 4);
-    r.copy(init_h + b * H, H * 4);
+    r.copy_obs(global_f, b * st[0], T1 * G, obs_bf16);
+    r.copy_obs(hero_f, b * st[1], T1 * HF, obs_bf16);
+    r.copy_obs(unit_f, b * st[2], T1 * U * UF, obs_bf16);
+    r.copy_bool(unit_m + b * st[3], T1 * U);
+    r.copy_bool(target_m + b * st[4], T1 * U);
+    r.copy_bool(action_m + b * st[5], T1 * A);
+    r.copy(act_type + b * st[6], L * 4);
+    r.copy(act_mx + b * st[7], L * 4);
+    r.copy(act_my + b * st[8], L * 4);
+    r.copy(act_tg + b * st[9], L * 4);
+    r.copy(logp + b * st[10], L * 4);
+    r.copy(value + b * st[11], L * 4);
+    r.copy(rewards + b * st[12], L * 4);
+    r.copy(dones + b * st[13], L * 4);
+    r.copy(init_c + b * st[15], H * 4);
+    r.copy(init_h + b * st[16], H * 4);
     if (frame_aux) {
       if (want_aux && aux_win != nullptr) {
-        r.copy(aux_win + b * T, L * 4);
-        r.copy(aux_lh + b * T, L * 4);
-        r.copy(aux_nw + b * T, L * 4);
+        r.copy(aux_win + b * st[17], L * 4);
+        r.copy(aux_lh + b * st[18], L * 4);
+        r.copy(aux_nw + b * st[19], L * 4);
       } else {
         r.skip(L * 3 * 4);
       }
     }
     if (!r.ok) return -(b + 1);
 
-    float* m = mask + b * T;
+    float* m = mask + b * st[14];
     for (int64_t t = 0; t < L; ++t) m[t] = 1.0f;
     versions[b] = hdr.version;
     actor_ids[b] = hdr.actor_id;

@@ -96,6 +96,42 @@ class FusedBatchIO:
 
     # ----------------------------------------------------------- host side
 
+    def alloc_views(self):
+        """(groups, batch): zeroed group buffers + a TrainBatch whose
+        leaves are row-strided VIEWS into them.
+
+        The staging packer fills the views (numpy fallback transparently;
+        the C packer via per-leaf row strides), after which `groups` is
+        already the device-transfer layout — pack() and its full-batch
+        memcpy (~0.7 ms at flagship shapes, on the 1-core host's critical
+        path) never run. Initialization contract matches
+        zeros_train_batch: all-zero leaves, NOOP-legal action-mask
+        padding rows."""
+        from dotaclient_tpu.env import featurizer as F
+
+        rows = self.local_rows
+        groups = {
+            key: np.zeros((rows, self.group_cols[key]), dtype=_GROUP_DTYPES[key])
+            for key in self.group_cols
+        }
+        leaves: List[Any] = [None] * sum(len(s) for s in self.slots.values())
+        for key, slots in self.slots.items():
+            buf = groups[key]
+            for s in slots:
+                v = buf[:, s.start : s.start + s.cols].reshape((rows,) + s.shape[1:])
+                if np.dtype(s.dtype) == np.bool_:
+                    v = v.view(np.bool_)
+                # Splitting the trailing axis of a row-strided column
+                # block is always expressible as a view; a silent copy
+                # here would disconnect the batch from the transfer
+                # buffers and ship zeros to the device.
+                if not np.may_share_memory(v, buf):
+                    raise AssertionError("fused_io.alloc_views: leaf view detached")
+                leaves[s.index] = v
+        batch = jax.tree.unflatten(self.treedef, leaves)
+        batch.obs.action_mask[:] = F.zeros_observation().action_mask
+        return groups, batch
+
     def pack(self, batch) -> Dict[str, np.ndarray]:
         """TrainBatch (numpy leaves) → {group: [rows, cols] contiguous}.
         One memcpy per leaf; runs on the learner fetch path, overlapped

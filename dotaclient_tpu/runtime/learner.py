@@ -286,7 +286,13 @@ class Learner:
             staging_cfg.batch_size = cfg.batch_size // self._n_proc
             if self.fused_io is not None:
                 self.fused_io.local_rows = staging_cfg.batch_size
-        self.staging = StagingBuffer(staging_cfg, broker, version_fn=lambda: self.version)
+        # fused mode: staging packs straight into the dtype-grouped
+        # transfer buffers (leaf views), so _fetch_next ships `groups`
+        # without the io.pack regroup copy (~0.7 ms/batch of host memcpy
+        # at flagship shapes — critical-path time on a 1-core host).
+        self.staging = StagingBuffer(
+            staging_cfg, broker, version_fn=lambda: self.version, fused_io=self.fused_io
+        )
         self.flattener = ParamFlattener(state.params)
         self.publisher = WeightPublisher(
             broker,
@@ -361,23 +367,28 @@ class Learner:
         """Pull one batch off staging and device_put it (dp-sharded).
 
         Called AFTER the current step has been dispatched, so the host
-        wait, the fused pack, and the transfer all overlap the running
-        device step. Returns (batch_dev, env_steps, wait_s, put_s) or
-        (None, 0, w, 0); wait_s includes the fused pack's host memcpy,
-        put_s is the device transfer alone.
+        wait and the transfer overlap the running device step. Returns
+        (batch_dev, env_steps, wait_s, put_s) or (None, 0, w, 0). In
+        fused mode the pack happened on the STAGING thread (straight
+        into the transfer buffers), so wait_s is queue wait; only the
+        dense-staging fallback pays io.pack here (still charged to
+        wait_s, never to put_s — that bucket is the pure H2D transfer).
         """
         t0 = time.perf_counter()
-        batch = self.staging.get_batch(timeout=batch_timeout)
+        batch, groups = self.staging.get_batch_groups(timeout=batch_timeout)
         t1 = time.perf_counter()
         if batch is None:
             return None, 0, t1 - t0, 0.0
         env_steps = int(np.sum(batch.mask))
         if self.fused_io is not None:
-            # pack (host memcpy) is charged to the WAIT bucket, not the
-            # put bucket: time_device_put_s exists to attribute the H2D
-            # transfer specifically (the on-silicon bottleneck), and
-            # folding host packing into it would poison that comparison.
-            groups = self.fused_io.pack(batch)
+            # Staging packed straight into the transfer buffers (groups
+            # non-None); the io.pack fallback only runs if a caller wired
+            # a dense staging buffer to a fused learner. Host memcpy is
+            # charged to the WAIT bucket, not the put bucket:
+            # time_device_put_s exists to attribute the H2D transfer
+            # specifically (the on-silicon bottleneck).
+            if groups is None:
+                groups = self.fused_io.pack(batch)
             t2 = time.perf_counter()
             if self._n_proc > 1:
                 # Each process contributes its local rows; the result is

@@ -259,3 +259,58 @@ def test_isa_fingerprint_invalidates_foreign_so(tmp_path, monkeypatch):
     assert native._build()
     assert so.stat().st_mtime_ns != first_build
     assert (tmp_path / "_packer.so.host").read_text() == native._host_isa()
+
+
+def _template_from(batch):
+    """FusedBatchIO needs a mesh; 1-device CPU mesh suffices for layout."""
+    from dotaclient_tpu.parallel import mesh as mesh_lib
+    from dotaclient_tpu.parallel.fused_io import FusedBatchIO
+
+    import jax
+
+    mesh = mesh_lib.make_mesh("dp=1", devices=jax.devices()[:1])
+    return FusedBatchIO(batch, mesh)
+
+
+@pytest.mark.parametrize("aux", [False, True])
+@pytest.mark.parametrize("obs_bf16", [False, True])
+def test_grouped_pack_bitwise_matches_dense(aux, obs_bf16):
+    """dt_pack_batch with row strides (writing into the fused-H2D group
+    buffers through leaf views) must produce BITWISE the batch the dense
+    path does, and the group buffers must equal io.pack(dense) — i.e.
+    eliminating the regroup copy changes no byte of what ships. Frames
+    salted with NaNs and RNE ties so the bf16 in-copy cast is exercised
+    on its hard cases through the strided path too."""
+    rollouts = [make_rollout(L=3 + (i % 4), H=8, seed=i, aux=aux, actor_id=i) for i in range(6)]
+    for i, r in enumerate(rollouts):
+        r.obs.global_feats[0, :3] = [np.nan, 1.00390625, -1.00390625]  # NaN + tie cases
+        r.obs.hero_feats[0, 0] = np.float32.__call__(2.0) ** -130  # denormal-ish
+    frames = [serialize_rollout(r) for r in rollouts]
+
+    dense = native.pack_frames(lib, frames, 8, 8, aux, obs_bf16=obs_bf16)
+    io = _template_from(dense)
+    groups, out = io.alloc_views()
+    native.pack_frames(lib, frames, 8, 8, aux, obs_bf16=obs_bf16, out=out)
+    # bitwise: view raw bytes so canonicalized NaNs compare EQUAL (the
+    # point of the salt) instead of tripping float NaN != NaN.
+    import jax
+
+    for a, b in zip(jax.tree.leaves(dense), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(
+            np.ascontiguousarray(a).view(np.uint8), np.ascontiguousarray(b).view(np.uint8)
+        )
+    ref_groups = io.pack(dense)
+    assert set(groups) == set(ref_groups)
+    for k in groups:
+        np.testing.assert_array_equal(
+            np.asarray(groups[k]).view(np.uint8), np.asarray(ref_groups[k]).view(np.uint8)
+        )
+
+
+def test_grouped_pack_rejects_wrong_rows():
+    frames = [serialize_rollout(make_rollout(L=3, H=8, seed=i)) for i in range(4)]
+    dense = native.pack_frames(lib, frames, 8, 8, False)
+    io = _template_from(dense)
+    groups, out = io.alloc_views()
+    with pytest.raises(ValueError, match="rows"):
+        native.pack_frames(lib, frames[:3], 8, 8, False, out=out)
