@@ -196,6 +196,12 @@ def _spawn_children(n_replayers, n_real, rate, duration, frames_file, go_file, f
                     policy="tiny"):
     broker_url = f"tcp://127.0.0.1:{PORT}"
     common = ["--broker", broker_url, "--go-file", go_file, "--duration", str(duration)]
+    # Children are CPU-pinned (real actors jax.config-force cpu) — they
+    # must NOT inherit a JAX compilation cache aimed at the TPU parent:
+    # CPU-fallback entries in a shared dir wedge later TPU loaders with
+    # "machine features don't match" (tests/conftest.py lore; prober
+    # window-cache review finding).
+    child_env = {k: v for k, v in os.environ.items() if k != "JAX_COMPILATION_CACHE_DIR"}
     procs = []
     for i in range(n_replayers):
         procs.append(
@@ -204,6 +210,7 @@ def _spawn_children(n_replayers, n_real, rate, duration, frames_file, go_file, f
                  "--frames-file", frames_file, "--rate", str(rate)] + common,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL,
+                env=child_env,
             )
         )
     for i in range(n_real):
@@ -213,6 +220,7 @@ def _spawn_children(n_replayers, n_real, rate, duration, frames_file, go_file, f
                  "--policy", policy] + common,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL,
+                env=child_env,
             )
         )
     return procs

@@ -163,17 +163,34 @@ def _git_commit(paths, msg) -> None:
             time.sleep(5 + 10 * attempt)
 
 
-def window_tasks(ts: str):
+def _make_window_cache() -> str:
+    """A private, this-process-owned cache dir (exclusive mkdtemp 0700 —
+    the conftest threat model: JAX cache entries are serialized native
+    executables, so a world-guessable pre-creatable path would hand
+    another local user code execution in our processes)."""
+    return tempfile.mkdtemp(prefix="dotaclient_tpu_window_cache_")
+
+
+def window_tasks(ts: str, cache_dir: str | None = None):
     """The on-silicon task list, in value order. Factored out so the
     success branch — the code a scarce chip window rides on — is
     unit-testable (tests/test_prober.py) instead of first executing for
     real inside the window."""
     bench_out = f"BENCH_TPU_{ts}.json"
+    # One compilation cache shared by bench and the soak — the ONLY two
+    # tasks that compile the same flagship train step, and the only two
+    # that hard-refuse to run on a CPU fallback (so no CPU entries can
+    # land in it; the soak additionally strips the var from its
+    # CPU-pinned children). parity/tf/lstm compile disjoint programs AND
+    # can legitimately fall back to CPU — a shared cache would buy them
+    # nothing and risk the "machine features don't match" wedge
+    # (tests/conftest.py lore). run_window owns the dir's lifetime.
+    cache = {"JAX_COMPILATION_CACHE_DIR": cache_dir} if cache_dir else {}
     return [
         (
             "e2e bench (fused pipeline)",
             [sys.executable, "bench.py"],
-            {"DOTACLIENT_TPU_BENCH_PLATFORM": "tpu"},
+            {"DOTACLIENT_TPU_BENCH_PLATFORM": "tpu", **cache},
             1500.0,
             bench_out,
             [bench_out],
@@ -191,7 +208,7 @@ def window_tasks(ts: str):
                 "--replayers-b", "64", "--real-actors", "2",
                 "--duration", "150", "--out", "SOAK_TPU.json",
             ],
-            {},
+            cache,
             1500.0,
             None,
             ["SOAK_TPU.json"],
@@ -235,18 +252,27 @@ def run_window(ts: str, tasks=None) -> bool:
     the prober exits 0: deterministic fast failures (rc!=0, error
     contract) are code problems the driving session must see once, not
     re-run every interval until the deadline."""
-    task_list = tasks if tasks is not None else window_tasks(ts)
+    cache_dir = _make_window_cache() if tasks is None else None
+    task_list = tasks if tasks is not None else window_tasks(ts, cache_dir)
     any_ok = False
     timed_out = False
-    for name, cmd, env_extra, timeout_s, out_path, artifacts in task_list:
-        t_ok, t_detail = _run_task(cmd, env_extra, timeout_s, out_path)
-        any_ok = any_ok or t_ok
-        _append_log(f"| {_utc()} | task | {name}: {t_detail} |")
-        paths = [LOG] + [a for a in artifacts if os.path.exists(os.path.join(REPO, a))]
-        _git_commit(paths, f"TPU window {ts}: {name} {'ok' if t_ok else '- ' + t_detail[:60]}")
-        if not t_ok and "TIMEOUT" in t_detail:
-            timed_out = True
-            break
+    try:
+        for name, cmd, env_extra, timeout_s, out_path, artifacts in task_list:
+            t_ok, t_detail = _run_task(cmd, env_extra, timeout_s, out_path)
+            any_ok = any_ok or t_ok
+            _append_log(f"| {_utc()} | task | {name}: {t_detail} |")
+            paths = [LOG] + [a for a in artifacts if os.path.exists(os.path.join(REPO, a))]
+            _git_commit(paths, f"TPU window {ts}: {name} {'ok' if t_ok else '- ' + t_detail[:60]}")
+            if not t_ok and "TIMEOUT" in t_detail:
+                timed_out = True
+                break
+    finally:
+        if cache_dir is not None:
+            # a window cache must not outlive its window (stale compiled
+            # executables in /tmp are both clutter and attack surface)
+            import shutil
+
+            shutil.rmtree(cache_dir, ignore_errors=True)
     false_window = timed_out and not any_ok
     _append_log(
         f"| {_utc()} | n/a | window tasks done "
