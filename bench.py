@@ -302,8 +302,8 @@ def main() -> None:
     # the ONE executable production runs (and the e2e section below hits
     # the already-compiled program instead of a second multi-minute
     # compile inside a scarce TPU window).
-    batch = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, make_train_batch(cfg, 0)))
-    batch = jax.device_put(io.pack(batch), io.shardings)
+    host_batch = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, make_train_batch(cfg, 0)))
+    batch = jax.device_put(io.pack(host_batch), io.shardings)
     state, metrics = train_step(state, batch)
     jax.block_until_ready(metrics["loss"])
     t0 = time.perf_counter()
@@ -381,6 +381,50 @@ def main() -> None:
     staging.stop()
 
     e2e_rate = env_steps / dt
+
+    # --- transfer-layout A/B (informational, best-effort): the same
+    # batch bytes H2D as 17 pytree leaves vs 4 dtype groups vs ONE
+    # concatenated byte buffer. On the tunneled chip the per-transfer RPC
+    # overhead dominated (~0.28 ms/leaf, r3 — the reason fused_io
+    # exists); this records whether collapsing 4 -> 1 is the next e2e
+    # lever (decide-with-data, like the flash-attention question) without
+    # committing the production path to it blind.
+    transfer_ab = None
+    try:
+        host_groups = io.pack(host_batch)  # the host batch from the device-only section
+        sh = io.shardings[next(iter(host_groups))]
+
+        def _time_put(payload, shardings, reps=8):
+            jax.block_until_ready(jax.device_put(payload, shardings))  # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                jax.block_until_ready(jax.device_put(payload, shardings))
+            return (time.perf_counter() - t0) / reps
+
+        transfer_ab = {
+            "tree_17_leaves_ms": round(
+                _time_put(host_batch, jax.tree.map(lambda _: sh, host_batch)) * 1e3, 3
+            ),
+            "groups_4_buffers_ms": round(_time_put(host_groups, io.shardings) * 1e3, 3),
+            "note": "blocked device_put of the same batch bytes (per-transfer RPC "
+            "overhead is the tunneled-chip bottleneck fused_io exists for)",
+        }
+        if n_dev == 1:
+            # Replicated 1-D put only compares fairly on one chip — on a
+            # dp>1 mesh it would ship n_dev x the bytes of the sharded
+            # legs and falsely conclude 4->1 is a loss. A multi-chip
+            # variant would row-split the buffer first.
+            one_buf = np.concatenate(
+                [np.ascontiguousarray(g).view(np.uint8).reshape(-1) for g in host_groups.values()]
+            )
+            transfer_ab["bytes"] = int(one_buf.nbytes)
+            transfer_ab["single_buffer_ms"] = round(
+                _time_put(one_buf, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+                * 1e3,
+                3,
+            )
+    except Exception:
+        pass
 
     # --- FLOPs / MFU / boundary-bytes accounting (SURVEY §6: normalize
     # steps/s into utilization). Analytic matmul model + XLA's own count.
@@ -464,6 +508,7 @@ def main() -> None:
         else None,
         "h2d_bytes_per_iter": int(h2d_bytes) if h2d_bytes else None,
         "d2h_bytes_per_iter": int(d2h_bytes) if d2h_bytes else None,
+        "transfer_layout_ab": transfer_ab,
     }
     if on_cpu_fallback and fallback_reason:
         out["fallback_reason"] = fallback_reason
