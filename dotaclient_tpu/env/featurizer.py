@@ -101,6 +101,23 @@ def _sorted_others(world: ws.World, hero: ws.Unit):
     return others[:MAX_UNITS]
 
 
+def finite_or_zero(x: float) -> float:
+    """0.0 for nan/±inf — the wire can carry any float bits, and the two
+    places that feed scalars into math.sin/cos would RAISE on inf (math
+    domain error), killing the actor loop on one corrupt worldstate
+    (found by tests/test_fuzz_wire.py). Array-valued features are
+    sanitized wholesale in _sanitize instead."""
+    return x if math.isfinite(x) else 0.0
+
+
+def _sanitize(arr: np.ndarray, clamp: float) -> None:
+    """In place: nan→0, ±inf→±clamp, then clip to ±clamp. np.clip alone
+    PASSES NaN through — a hostile worldstate float would otherwise ride
+    a unit row straight into the policy's activations."""
+    np.nan_to_num(arr, copy=False, nan=0.0, posinf=clamp, neginf=-clamp)
+    np.clip(arr, -clamp, clamp, out=arr)
+
+
 def _unit_row(u: ws.Unit, hero: ws.Unit, out: np.ndarray) -> None:
     dx = u.x - hero.x
     dy = u.y - hero.y
@@ -121,7 +138,7 @@ def _unit_row(u: ws.Unit, hero: ws.Unit, out: np.ndarray) -> None:
     out[11] = 1.0 if dist <= hero.attack_range else 0.0
     out[12] = u.attack_damage / 200.0
     out[13] = u.speed / 500.0
-    out[14] = math.cos(u.facing)
+    out[14] = math.cos(finite_or_zero(u.facing))
     out[15] = 1.0 if u.is_alive else 0.0
 
 
@@ -154,8 +171,8 @@ def _hero_row(h: ws.Unit, out: np.ndarray) -> None:
     out[4] = h.mana / mana_max
     out[5] = np.clip(h.x / _MAP_SCALE, -1.0, 1.0)
     out[6] = np.clip(h.y / _MAP_SCALE, -1.0, 1.0)
-    out[7] = math.sin(h.facing)
-    out[8] = math.cos(h.facing)
+    out[7] = math.sin(finite_or_zero(h.facing))
+    out[8] = math.cos(finite_or_zero(h.facing))
     out[9] = h.attack_damage / 200.0
     out[10] = h.attack_range / 1000.0
     out[11] = h.speed / 500.0
@@ -192,13 +209,14 @@ def featurize_with_handles(world: ws.World, player_id: int):
     hero = find_hero(world, player_id)
     obs = zeros_observation()
     gf = obs.global_feats
-    gf[0] = world.dota_time / 600.0
-    gf[1] = math.sin(2.0 * math.pi * world.dota_time / _CREEP_WAVE_PERIOD)
-    gf[2] = math.cos(2.0 * math.pi * world.dota_time / _CREEP_WAVE_PERIOD)
+    t = finite_or_zero(world.dota_time)
+    gf[0] = t / 600.0
+    gf[1] = math.sin(2.0 * math.pi * t / _CREEP_WAVE_PERIOD)
+    gf[2] = math.cos(2.0 * math.pi * t / _CREEP_WAVE_PERIOD)
     gf[3] = world.game_state / 10.0
     gf[4] = 1.0 if world.team_id == 2 else -1.0  # radiant/dire indicator
     gf[5] = world.tick / 1e5
-    np.clip(gf, -_CLAMP, _CLAMP, out=gf)
+    _sanitize(gf, _CLAMP)
     handles = np.zeros(MAX_UNITS, np.uint32)
     if hero is None or not hero.is_alive:
         return obs, handles
@@ -215,8 +233,8 @@ def featurize_with_handles(world: ws.World, player_id: int):
             and u.unit_type in (ws.Unit.HERO, ws.Unit.LANE_CREEP, ws.Unit.JUNGLE_CREEP, ws.Unit.TOWER, ws.Unit.BARRACKS, ws.Unit.FORT, ws.Unit.ROSHAN)
         )
 
-    np.clip(obs.hero_feats, -_CLAMP, _CLAMP, out=obs.hero_feats)
-    np.clip(obs.unit_feats, -_CLAMP, _CLAMP, out=obs.unit_feats)
+    _sanitize(obs.hero_feats, _CLAMP)
+    _sanitize(obs.unit_feats, _CLAMP)
 
     obs.action_mask[ACT_NOOP] = True
     obs.action_mask[ACT_MOVE] = True

@@ -17,7 +17,7 @@ import math
 from typing import Dict, Optional
 
 from dotaclient_tpu.protos import worldstate_pb2 as ws
-from dotaclient_tpu.env.featurizer import find_hero
+from dotaclient_tpu.env.featurizer import finite_or_zero, find_hero
 
 REWARD_WEIGHTS: Dict[str, float] = {
     "xp": 0.002,  # per xp point
@@ -83,7 +83,11 @@ def component_rewards(
     if prev is not None:
         enemy_team = 3 if hero.team_id == 2 else 2
         out["tower_hp"] = _tower_hp_frac(prev, enemy_team) - _tower_hp_frac(world, enemy_team)
-    return out
+    # health/mana/health_max are FLOAT wire fields — a corrupt frame can
+    # carry nan/inf and every arithmetic path above propagates it into
+    # the return, poisoning GAE downstream (tests/test_fuzz_wire.py).
+    # One choke point: a non-finite component contributes zero.
+    return {k: finite_or_zero(v) for k, v in out.items()}
 
 
 def total_reward(components: Dict[str, float]) -> float:

@@ -127,3 +127,79 @@ class TestNativeFuzz:
     @FUZZ
     def test_native_random_bytes_rejected(self, data):
         assert native.frame_header(_lib, data) is None or len(data) >= 21
+
+
+# ---------------------------------------------------------------------------
+# The OTHER trust boundary: gRPC worldstate protos from the env server.
+# Contract: featurize() over ANY wire-decodable World must return finite,
+# schema-shaped observations with consistent masks — extreme stats, zero
+# maxima, huge unit counts, hostile float values included.
+
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.protos import worldstate_pb2 as ws
+
+_HOSTILE_FLOATS = [0.0, -1.0, 1e30, -1e30, float("inf"), float("nan"), 1e-30]
+
+
+@st.composite
+def _worlds(draw):
+    w = ws.World(
+        dota_time=draw(st.sampled_from(_HOSTILE_FLOATS + [42.0])),
+        game_state=draw(st.integers(0, 10)),
+        tick=draw(st.integers(0, 2**31 - 1)),
+        team_id=draw(st.sampled_from([2, 3])),
+    )
+    n_units = draw(st.integers(0, F.MAX_UNITS + 8))  # overflow MAX_UNITS too
+    for i in range(n_units):
+        w.units.add(
+            handle=draw(st.integers(0, 2**31 - 1)),
+            unit_type=draw(st.sampled_from([ws.Unit.HERO, ws.Unit.LANE_CREEP, ws.Unit.TOWER])),
+            team_id=draw(st.sampled_from([2, 3])),
+            player_id=draw(st.integers(0, 9)),
+            x=draw(st.sampled_from(_HOSTILE_FLOATS)),
+            y=draw(st.sampled_from(_HOSTILE_FLOATS)),
+            facing=draw(st.sampled_from(_HOSTILE_FLOATS)),  # inf raised in math.sin pre-fix
+            level=draw(st.integers(0, 30)),
+            # health/mana and their maxima are FLOAT wire fields: nan/inf
+            # are legal on the wire and must sanitize, and 0 maxima divide
+            health=draw(st.sampled_from(_HOSTILE_FLOATS + [1.0, 1e9])),
+            health_max=draw(st.sampled_from(_HOSTILE_FLOATS + [1.0, 550.0])),
+            mana=draw(st.sampled_from(_HOSTILE_FLOATS + [1e9])),
+            mana_max=draw(st.sampled_from(_HOSTILE_FLOATS + [300.0])),
+            attack_damage=draw(st.sampled_from(_HOSTILE_FLOATS + [1e9])),
+            attack_range=draw(st.sampled_from(_HOSTILE_FLOATS + [1e9])),
+            speed=draw(st.sampled_from(_HOSTILE_FLOATS + [1e9])),
+            is_alive=draw(st.booleans()),
+            gold=draw(st.integers(0, 10**6)),
+            xp=draw(st.integers(0, 10**6)),
+        )
+    return w
+
+
+@given(world=_worlds(), player_id=st.integers(0, 9))
+@FUZZ
+def test_featurizer_any_wire_world_finite_and_consistent(world, player_id):
+    # through the REAL wire, as the gRPC client would receive it
+    decoded = ws.World.FromString(world.SerializeToString())
+    obs = F.featurize(decoded, player_id)
+    for name, arr in obs._asdict().items():
+        assert np.all(np.isfinite(np.asarray(arr, np.float32))), name
+    # mask consistency: targets are a subset of present units; the action
+    # mask never strands the policy with zero legal actions
+    assert not np.any(obs.target_mask & ~obs.unit_mask)
+    assert obs.action_mask.any()
+
+
+@given(w0=_worlds(), w1=_worlds(), player_id=st.integers(0, 9))
+@FUZZ
+def test_reward_any_wire_world_pair_finite(w0, w1, player_id):
+    """Shaped rewards over ANY worldstate pair must be finite — a corrupt
+    health/position float must not inject inf/nan into the return."""
+    from dotaclient_tpu.env import rewards as R
+
+    a = ws.World.FromString(w0.SerializeToString())
+    b = ws.World.FromString(w1.SerializeToString())
+    comps = R.component_rewards(a, b, player_id)
+    for name, v in comps.items():
+        assert np.isfinite(v), (name, v)
+    assert np.isfinite(R.total_reward(comps))
