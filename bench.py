@@ -382,6 +382,61 @@ def main() -> None:
 
     e2e_rate = env_steps / dt
 
+    # --- optional: full e2e with the SINGLE-buffer H2D mode (opt-in via
+    # env because it costs a second full XLA compile — the prober sets it
+    # inside chip windows, where the per-window compilation cache and the
+    # transfer_layout_ab data give the 4-vs-1 decision real numbers on
+    # the real link). Best-effort: failure degrades to an error field,
+    # never touches the primary (already measured) rate.
+    import os
+
+    e2e_single = e2e_single_err = None
+    if os.environ.get("DOTACLIENT_TPU_BENCH_SINGLE") == "1":
+        stop_s = s_staging = None
+        try:
+            from dotaclient_tpu.parallel.train_step import build_single_train_step
+
+            scfg = LearnerConfig(batch_size=256, seq_len=16, mesh_shape="dp=-1",
+                                 fused_single_h2d=True)
+            single_step, s_state_sh, s_io = build_single_train_step(scfg, mesh)
+            s_state = jax.device_put(
+                init_train_state(scfg, jax.random.PRNGKey(0)), s_state_sh
+            )
+            stop_s = _start_producers(scfg, "bench_single")
+            s_staging = StagingBuffer(
+                scfg, connect("mem://bench_single"), version_fn=lambda: 0, fused_io=s_io
+            ).start()
+
+            def fetch_single():
+                b, payload = s_staging.get_batch_groups(timeout=120.0)
+                if b is None:
+                    raise RuntimeError("single-buffer staging starved (timeout)")
+                steps = int(np.sum(b.mask))
+                return jax.device_put(payload, s_io.single_sharding), steps
+
+            warm_s, _ = fetch_single()
+            s_state, s_metrics = single_step(s_state, warm_s)
+            jax.block_until_ready(s_metrics["loss"])
+            nxt_s, nxt_steps_s = fetch_single()
+            steps_done = 0
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                dev_s, n_s = nxt_s, nxt_steps_s
+                s_state, s_metrics = single_step(s_state, dev_s)
+                steps_done += n_s
+                nxt_s, nxt_steps_s = fetch_single()
+            jax.block_until_ready(s_metrics["loss"])
+            e2e_single = steps_done / (time.perf_counter() - t0)
+        except Exception as e:
+            e2e_single_err = f"{type(e).__name__}: {e}"[:300]
+        finally:
+            # Leaked producers/consumer would burn the 1-core host for the
+            # rest of main() and skew the transfer A/B measured next.
+            if stop_s is not None:
+                stop_s.set()
+            if s_staging is not None:
+                s_staging.stop()
+
     # --- transfer-layout A/B (informational, best-effort): the same
     # batch bytes H2D as 17 pytree leaves vs 4 dtype groups vs ONE
     # concatenated byte buffer. On the tunneled chip the per-transfer RPC
@@ -510,6 +565,10 @@ def main() -> None:
         "d2h_bytes_per_iter": int(d2h_bytes) if d2h_bytes else None,
         "transfer_layout_ab": transfer_ab,
     }
+    if e2e_single is not None:
+        out["e2e_single_buffer_steps_per_sec"] = round(e2e_single, 1)
+    if e2e_single_err is not None:
+        out["e2e_single_buffer_error"] = e2e_single_err
     if on_cpu_fallback and fallback_reason:
         out["fallback_reason"] = fallback_reason
     if on_cpu_fallback:

@@ -146,6 +146,13 @@ class LearnerConfig:
     # dominated the on-silicon e2e bench. Auto-falls back to the per-leaf
     # tree path in sequence-parallel mode.
     fused_h2d: bool = True
+    # With fused_h2d: collapse the 4 dtype-grouped buffers further into
+    # ONE [B, row_bytes] u8 buffer per batch (free in-jit bitcasts
+    # unpack it). Saves the remaining 3 per-transfer RPC overheads on
+    # tunneled/remote chips; a wash on directly-attached hardware.
+    # Default off until bench's transfer_layout_ab justifies it on the
+    # target link (decide-with-data).
+    fused_single_h2d: bool = False
     # jax.profiler server port (0 = off); connect with TensorBoard's
     # profile plugin or jax.profiler.trace to capture device traces
     profile_port: int = 0

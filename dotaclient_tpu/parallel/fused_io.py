@@ -93,6 +93,63 @@ class FusedBatchIO:
         self.local_rows = B
         dp = "dp" if "dp" in mesh.axis_names else None
         self.shardings = {k: NamedSharding(mesh, P(dp, None)) for k in cols}
+        # --- single-buffer layout (opt-in transfer mode): each batch row
+        # is the byte-concatenation of its dtype-group segments in a
+        # fixed order, every segment padded to 4 bytes so each start is
+        # aligned for its dtype. The whole batch then crosses H2D as ONE
+        # [B, row_bytes] u8 array — on the tunneled chip the per-transfer
+        # RPC overhead (~0.28 ms each, r3) makes transfer COUNT matter;
+        # rows stay intact so dp sharding is identical to the group mode.
+        self.seg_off: Dict[str, int] = {}
+        off = 0
+        for key in ("f32", "i32", "bf16", "u8"):
+            if key not in cols:
+                continue
+            self.seg_off[key] = off
+            nbytes = cols[key] * np.dtype(_GROUP_DTYPES[key]).itemsize
+            off += (nbytes + 3) & ~3
+        self.row_bytes = off
+        self.single_sharding = NamedSharding(mesh, P(dp, None))
+        # When True (set by build_single_train_step), alloc_transfer /
+        # pack_transfer / transfer_shardings produce the one-buffer
+        # layout; the staging buffer and learner dispatch through those
+        # so they never need to know which mode the step was built for.
+        self.single_mode = False
+
+    # -------------------------------------------------- mode-dispatch API
+
+    def alloc_transfer(self):
+        """(payload, batch-of-views) in whichever layout the train step
+        was built for — groups dict (default) or single u8 buffer."""
+        return self.alloc_views_single() if self.single_mode else self.alloc_views()
+
+    def pack_transfer(self, batch):
+        """batch → transfer payload (dense-staging fallback path)."""
+        if not self.single_mode:
+            return self.pack(batch)
+        # Same pack-boundary validation contract as pack(): a mis-sized
+        # or structurally different batch must fail HERE with a named
+        # error, not silently truncate the leaf zip or broadcast one row
+        # across the buffer.
+        leaves, treedef = jax.tree.flatten(batch)
+        if treedef != self.treedef:
+            raise ValueError(
+                f"single pack: batch structure {treedef} != template {self.treedef}"
+            )
+        rows = np.asarray(leaves[0]).shape[0]
+        if rows != self.local_rows:
+            raise ValueError(
+                f"single pack: got {rows} rows, expected {self.local_rows} "
+                f"(template batch {self.batch}; multihost learners set "
+                f"local_rows to their per-process share)"
+            )
+        buf, views = self.alloc_views_single()
+        for v, ref in zip(jax.tree.leaves(views), leaves):
+            v[...] = ref
+        return buf
+
+    def transfer_shardings(self):
+        return self.single_sharding if self.single_mode else self.shardings
 
     # ----------------------------------------------------------- host side
 
@@ -132,6 +189,44 @@ class FusedBatchIO:
         batch.obs.action_mask[:] = F.zeros_observation().action_mask
         return groups, batch
 
+    def alloc_views_single(self):
+        """(buf, batch): ONE zeroed [rows, row_bytes] u8 transfer buffer +
+        a TrainBatch of leaf views into it (same contract as alloc_views;
+        the packer — C via row strides, or numpy — fills the views and
+        `buf` ships as a single device_put). Leaf views sit at their
+        group segment's byte offset; within a row every leaf block is
+        contiguous, so only the row-to-row stride differs from dense."""
+        from dotaclient_tpu.env import featurizer as F
+
+        rows = self.local_rows
+        buf = np.zeros((rows, self.row_bytes), np.uint8)
+        leaves: List[Any] = [None] * sum(len(s) for s in self.slots.values())
+        for key, slots in self.slots.items():
+            gdt = np.dtype(_GROUP_DTYPES[key])
+            for s in slots:
+                dt = np.dtype(np.bool_) if np.dtype(s.dtype) == np.bool_ else gdt
+                # C-contiguous strides for the per-row block; the leading
+                # (batch) stride is the full row width.
+                rev = []
+                acc = dt.itemsize
+                for d in reversed(s.shape[1:]):
+                    rev.append(acc)
+                    acc *= d
+                strides = (self.row_bytes,) + tuple(reversed(rev))
+                v = np.ndarray(
+                    shape=(rows,) + s.shape[1:],
+                    dtype=dt,
+                    buffer=buf,
+                    offset=self.seg_off[key] + s.start * gdt.itemsize,
+                    strides=strides,
+                )
+                if not np.may_share_memory(v, buf):
+                    raise AssertionError("fused_io.alloc_views_single: leaf view detached")
+                leaves[s.index] = v
+        batch = jax.tree.unflatten(self.treedef, leaves)
+        batch.obs.action_mask[:] = F.zeros_observation().action_mask
+        return buf, batch
+
     def pack(self, batch) -> Dict[str, np.ndarray]:
         """TrainBatch (numpy leaves) → {group: [rows, cols] contiguous}.
         One memcpy per leaf; runs on the learner fetch path, overlapped
@@ -168,6 +263,30 @@ class FusedBatchIO:
             buf = groups[key]
             for s in slots:
                 x = jax.lax.slice_in_dim(buf, s.start, s.start + s.cols, axis=1)
+                x = x.reshape(s.shape)
+                if np.dtype(s.dtype) == np.bool_:
+                    x = x != 0
+                leaves[s.index] = x
+        return jax.tree.unflatten(self.treedef, leaves)
+
+    def unpack_single(self, buf: jnp.ndarray):
+        """[B, row_bytes] u8 → TrainBatch, inside jit: slice each group's
+        byte segment, bitcast u8[..., k] to the group dtype, then the
+        same per-leaf slicing as unpack. Bitcasts are free on device
+        (layout reinterpretation; both sides little-endian)."""
+        B = buf.shape[0]
+        leaves: List[Any] = [None] * sum(len(s) for s in self.slots.values())
+        for key, slots in self.slots.items():
+            gdt = np.dtype(_GROUP_DTYPES[key])
+            k = gdt.itemsize
+            cols = self.group_cols[key]
+            seg = jax.lax.slice_in_dim(
+                buf, self.seg_off[key], self.seg_off[key] + cols * k, axis=1
+            )
+            if k > 1:
+                seg = jax.lax.bitcast_convert_type(seg.reshape(B, cols, k), gdt)
+            for s in slots:
+                x = jax.lax.slice_in_dim(seg, s.start, s.start + s.cols, axis=1)
                 x = x.reshape(s.shape)
                 if np.dtype(s.dtype) == np.bool_:
                     x = x != 0

@@ -298,6 +298,41 @@ def build_train_step(cfg: LearnerConfig, mesh):
     return train_step, state_shardings, batch_shardings
 
 
+def _build_fused(cfg: LearnerConfig, mesh, single: bool):
+    """Shared body of the two fused-transfer builders: validated core,
+    staging-matching template, one FusedBatchIO, one jit — only the
+    transfer layout (groups dict vs single u8 buffer) differs."""
+    step_fn, state_shardings, use_sp, _ = _build_core(cfg, mesh)
+    if use_sp:
+        raise ValueError(
+            f"{'single-buffer' if single else 'fused'} H2D transfer is "
+            f"incompatible with sequence parallelism (tf_sp_axis set); "
+            f"use build_train_step"
+        )
+    from dotaclient_tpu.parallel.fused_io import FusedBatchIO
+    from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
+
+    import numpy as np
+
+    # Template must match what staging actually emits — obs already in
+    # the compute dtype when stage_obs_compute_dtype is on.
+    template = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, _batch_template(cfg)))
+    io = FusedBatchIO(template, mesh)
+    io.single_mode = single
+    unpack = io.unpack_single if single else io.unpack
+
+    def fused_fn(state: TrainState, payload):
+        return step_fn(state, unpack(payload))
+
+    step = jax.jit(
+        fused_fn,
+        in_shardings=(state_shardings, io.transfer_shardings()),
+        out_shardings=(state_shardings, mesh_lib.replicated(mesh)),
+        donate_argnums=(0,),
+    )
+    return step, state_shardings, io
+
+
 def build_fused_train_step(cfg: LearnerConfig, mesh):
     """Returns (fused_step, state_shardings, io: FusedBatchIO).
 
@@ -311,32 +346,18 @@ def build_fused_train_step(cfg: LearnerConfig, mesh):
     (column-flattening would destroy the sp time-axis sharding) — use
     the tree path there.
     """
-    step_fn, state_shardings, use_sp, _ = _build_core(cfg, mesh)
-    if use_sp:
-        raise ValueError(
-            "fused H2D transfer is incompatible with sequence parallelism "
-            "(tf_sp_axis set); use build_train_step"
-        )
-    from dotaclient_tpu.parallel.fused_io import FusedBatchIO
-    from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
+    return _build_fused(cfg, mesh, single=False)
 
-    import numpy as np
 
-    # Template must match what staging actually emits — obs already in
-    # the compute dtype when stage_obs_compute_dtype is on.
-    template = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, _batch_template(cfg)))
-    io = FusedBatchIO(template, mesh)
-
-    def fused_fn(state: TrainState, groups):
-        return step_fn(state, io.unpack(groups))
-
-    fused_step = jax.jit(
-        fused_fn,
-        in_shardings=(state_shardings, io.shardings),
-        out_shardings=(state_shardings, mesh_lib.replicated(mesh)),
-        donate_argnums=(0,),
-    )
-    return fused_step, state_shardings, io
+def build_single_train_step(cfg: LearnerConfig, mesh):
+    """Returns (single_step, state_shardings, io: FusedBatchIO) — the
+    fused train step with the batch crossing H2D as ONE [B, row_bytes]
+    u8 buffer (FusedBatchIO.unpack_single: byte-segment slices + free
+    bitcasts inside the jit). Collapses the transfer COUNT from 4 to 1 —
+    on the tunneled chip each transfer costs ~0.28 ms of RPC overhead
+    (r3 measurement; see bench.py's transfer_layout_ab for the standing
+    A/B). Same refusal under sequence parallelism as the grouped mode."""
+    return _build_fused(cfg, mesh, single=True)
 
 
 def _batch_template(cfg: LearnerConfig):

@@ -203,11 +203,13 @@ class Learner:
         from dotaclient_tpu.parallel.train_step import is_sequence_parallel
 
         if cfg.fused_h2d and not is_sequence_parallel(cfg, self.mesh):
-            from dotaclient_tpu.parallel.train_step import build_fused_train_step
-
-            self.train_step, self.state_shardings, self.fused_io = build_fused_train_step(
-                cfg, self.mesh
+            from dotaclient_tpu.parallel.train_step import (
+                build_fused_train_step,
+                build_single_train_step,
             )
+
+            build = build_single_train_step if cfg.fused_single_h2d else build_fused_train_step
+            self.train_step, self.state_shardings, self.fused_io = build(cfg, self.mesh)
             self.batch_sharding = None
         else:
             self.train_step, self.state_shardings, self.batch_sharding = build_train_step(
@@ -388,8 +390,9 @@ class Learner:
             # time_device_put_s exists to attribute the H2D transfer
             # specifically (the on-silicon bottleneck).
             if groups is None:
-                groups = self.fused_io.pack(batch)
+                groups = self.fused_io.pack_transfer(batch)
             t2 = time.perf_counter()
+            shardings = self.fused_io.transfer_shardings()
             if self._n_proc > 1:
                 # Each process contributes its local rows; the result is
                 # ONE global array per buffer whose dp shards live where
@@ -397,10 +400,10 @@ class Learner:
                 batch_dev = jax.tree.map(
                     lambda arr, sh: jax.make_array_from_process_local_data(sh, arr),
                     groups,
-                    self.fused_io.shardings,
+                    shardings,
                 )
             else:
-                batch_dev = jax.device_put(groups, self.fused_io.shardings)
+                batch_dev = jax.device_put(groups, shardings)
             return batch_dev, env_steps, t2 - t0, time.perf_counter() - t2
         if self._n_proc > 1:
             batch_dev = jax.tree.map(

@@ -226,7 +226,9 @@ class StagingBuffer:
             self.cfg.stage_obs_compute_dtype and self.cfg.policy.dtype == "bfloat16"
         )
         if self._fused_io is not None:
-            groups, out = self._fused_io.alloc_views()
+            # payload: groups dict, or ONE u8 buffer in single mode —
+            # opaque here; the learner ships it with io.transfer_shardings()
+            groups, out = self._fused_io.alloc_transfer()
             if self._lib is not None:
                 from dotaclient_tpu import native
 

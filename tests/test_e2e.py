@@ -200,3 +200,36 @@ def test_checkpoint_resume_transformer_family(tmp_path):
         jax.tree.leaves(params_before), jax.tree.leaves(jax.device_get(learner2.state.params))
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_e2e_single_buffer_h2d(env_addr):
+    """The opt-in ONE-u8-buffer H2D mode end-to-end: actors → broker →
+    single-layout staging → bitcast-unpack train step. Three steps with
+    finite losses prove the learner glue (transfer shardings, staged
+    payload dispatch, step input) — the layout itself is bitwise-pinned
+    in test_fused_io/test_native/test_staging."""
+    broker_name = "e2e_single"
+    mem.reset(broker_name)
+    lcfg = LearnerConfig(
+        batch_size=8, seq_len=8, policy=SMALL, mesh_shape="dp=-1",
+        publish_every=1, fused_single_h2d=True,
+    )
+    acfg = ActorConfig(
+        env_addr=env_addr, broker_url=f"mem://{broker_name}",
+        rollout_len=8, max_dota_time=20.0, policy=SMALL, seed=5,
+    )
+    stop = threading.Event()
+    actors = [
+        threading.Thread(target=run_actor_thread, args=(acfg, broker_name, i, stop), daemon=True)
+        for i in range(2)
+    ]
+    for t in actors:
+        t.start()
+    learner = Learner(lcfg, broker_connect(f"mem://{broker_name}"))
+    try:
+        assert learner.fused_io is not None and learner.fused_io.single_mode
+        steps = learner.run(num_steps=3, batch_timeout=120.0)
+    finally:
+        stop.set()
+    assert steps == 3 and learner.version == 3
+    assert learner.staging.stats()["consumer_errors"] == 0

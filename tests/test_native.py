@@ -314,3 +314,27 @@ def test_grouped_pack_rejects_wrong_rows():
     groups, out = io.alloc_views()
     with pytest.raises(ValueError, match="rows"):
         native.pack_frames(lib, frames[:3], 8, 8, False, out=out)
+
+
+@pytest.mark.parametrize("obs_bf16", [False, True])
+def test_single_buffer_pack_bitwise_matches_dense(obs_bf16):
+    """The C packer writing through SINGLE-buffer leaf views (byte-offset
+    strides into one [B, row_bytes] u8 buffer) must equal the dense pack
+    bitwise, and the buffer must equal pack_transfer of the dense batch."""
+    rollouts = [make_rollout(L=3 + (i % 4), H=8, seed=i, actor_id=i) for i in range(6)]
+    for r in rollouts:
+        r.obs.global_feats[0, :3] = [np.nan, 1.00390625, -1.00390625]
+    frames = [serialize_rollout(r) for r in rollouts]
+
+    dense = native.pack_frames(lib, frames, 8, 8, False, obs_bf16=obs_bf16)
+    io = _template_from(dense)
+    io.single_mode = True
+    buf, out = io.alloc_transfer()
+    native.pack_frames(lib, frames, 8, 8, False, obs_bf16=obs_bf16, out=out)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(dense), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(
+            np.ascontiguousarray(a).view(np.uint8), np.ascontiguousarray(b).view(np.uint8)
+        )
+    np.testing.assert_array_equal(buf, io.pack_transfer(dense))

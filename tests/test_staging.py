@@ -372,3 +372,36 @@ def test_staging_fused_groups_match_dense_path(dtype, native_on):
         assert dense.get_batch_groups(timeout=0.1) == (None, None)
     finally:
         fused.stop(), dense.stop()
+
+
+def test_staging_fused_single_buffer_matches_dense():
+    """Single-buffer staging (one u8 transfer payload) emits bitwise the
+    dense batch, and the payload equals pack_transfer of that batch."""
+    cfg = LearnerConfig(
+        batch_size=4,
+        seq_len=8,
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="bfloat16"),
+    )
+    rollouts = [make_rollout(L=3 + i, H=8, seed=i, actor_id=i) for i in range(4)]
+    for r in rollouts:
+        r.obs.global_feats[0, :3] = [np.nan, 1.00390625, -1.00390625]
+    frames = [serialize_rollout(r) for r in rollouts]
+
+    io = _fused_io_for(cfg)
+    io.single_mode = True
+    mem.reset("fsb_a"), mem.reset("fsb_b")
+    fused = StagingBuffer(cfg, connect("mem://fsb_a"), fused_io=io).start()
+    dense = StagingBuffer(cfg, connect("mem://fsb_b")).start()
+    try:
+        pub_a, pub_b = connect("mem://fsb_a"), connect("mem://fsb_b")
+        for f in frames:
+            pub_a.publish_experience(f)
+            pub_b.publish_experience(f)
+        batch_f, buf = fused.get_batch_groups(timeout=30.0)
+        batch_d = dense.get_batch(timeout=30.0)
+        assert isinstance(buf, np.ndarray) and buf.dtype == np.uint8
+        assert buf.shape == (cfg.batch_size, io.row_bytes)
+        _bitwise_equal(batch_f, batch_d)
+        np.testing.assert_array_equal(buf, io.pack_transfer(batch_d))
+    finally:
+        fused.stop(), dense.stop()
