@@ -352,6 +352,10 @@ def main() -> None:
         # H2D-transfer attribution (mirrors learner._fetch_next)
         t0 = time.perf_counter()
         b, groups = staging.get_batch_groups(timeout=120.0)
+        if b is None:
+            # mirror fetch_single: a starved pipe inside a scarce TPU
+            # window must be a diagnosable error, not b.mask on None
+            raise RuntimeError("staging starved (timeout)")
         steps = int(np.sum(b.mask))
         t1 = time.perf_counter()
         dev = jax.device_put(groups, io.shardings)

@@ -98,6 +98,53 @@ class PPOConfig:
     # skipped (lax.cond no-ops — semantics of the classic mid-loop
     # `break`, with static shapes). 0 disables. Typical: 0.03.
     kl_stop: float = 0.0
+    # ACER-style truncated-importance-weight cap (c-bar, arxiv 1611.01224)
+    # applied to REPLAYED rows only: where a batch row's stamped
+    # behavior-policy staleness is > 0, the IS ratio entering the clipped
+    # surrogate is min(ratio, replay_rho_bar) — bounding the variance of
+    # stale-ratio gradients (the A<0, ratio>>1 corner plain PPO clipping
+    # leaves unbounded). Fresh rows (staleness 0) are untouched, so with
+    # replay disabled the loss is bit-identical to plain PPO.
+    replay_rho_bar: float = 2.0
+
+
+@dataclass
+class ReplayConfig:
+    """Host-side prioritized replay reservoir between staging and the
+    learner (dotaclient_tpu/replay/). Default OFF: with enabled=False the
+    staging/learner data plane is bit-identical to the drop-on-stale
+    pipeline (reference behavior)."""
+
+    # Master switch. When on, rollouts that aged past ppo.max_staleness
+    # (previously dropped on the host) are retained in the reservoir and
+    # re-sampled into batches with ACER truncated importance weights.
+    enabled: bool = False
+    # Target fraction of each packed batch drawn from the reservoir
+    # (0 <= ratio < 1); the rest stays fresh-from-the-broker. Batches
+    # never block on the reservoir — a short reservoir just means more
+    # fresh rows.
+    ratio: float = 0.25
+    # The reservoir's OWN staleness window, in learner versions: frames
+    # older than this are expired/rejected outright (the pre-replay drop).
+    # Must exceed ppo.max_staleness to retain anything.
+    max_staleness: int = 32
+    # Hard bound on resident reservoir bytes (serialized-frame sizes);
+    # lowest-priority entries are evicted first. Default 256 MiB.
+    byte_budget: int = 256 << 20
+    # PER priority exponent on the |TD-error| key (0 = uniform).
+    alpha: float = 0.6
+    # Age decay half-life for sampling/eviction priority, in learner
+    # versions: an entry this many versions old weighs half as much.
+    age_half_life: float = 8.0
+    # Per-entry sample cap before retirement (0 = unlimited): bounds how
+    # often one surprising chunk can recur in the gradient.
+    max_replays: int = 4
+    # Compressed spill of cold entries: once occupancy crosses
+    # spill_threshold * byte_budget, the coldest entries are zlib-
+    # compressed in place (still sampleable), buying headroom before
+    # eviction has to throw data away.
+    spill_compress: bool = True
+    spill_threshold: float = 0.5
 
 
 @dataclass
@@ -107,6 +154,7 @@ class LearnerConfig:
     batch_size: int = 256  # sequences per train step (global, across dp shards)
     seq_len: int = 16  # rollout chunk length = LSTM truncation window
     ppo: PPOConfig = field(default_factory=PPOConfig)
+    replay: ReplayConfig = field(default_factory=ReplayConfig)
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     broker_url: str = "mem://"
     checkpoint_dir: str = ""

@@ -90,10 +90,25 @@ def _build() -> bool:
         if proc.returncode != 0:
             _log.warning("native packer build failed:\n%s", proc.stderr)
             return False
+        # Publish the .so FIRST, then the fingerprint — atomically (temp
+        # + replace) so a concurrent loader can never observe a
+        # truncated/partial .host. The order matters: a crash between
+        # the two replaces leaves the NEW .so next to the OLD fingerprint
+        # → ISA mismatch → spurious rebuild (benign). The inverse order
+        # would be unsafe on the ISA-mismatch rebuild path: current-host
+        # fingerprint stamped next to a foreign-ISA .so whose mtime is
+        # FRESH, so the next loader would reuse it and SIGILL mid-pack.
         os.replace(tmp, _LIB)
         tmp = None
-        with open(_LIB_HOST, "w") as f:
-            f.write(_host_isa())
+        fd, tmp_host = tempfile.mkstemp(suffix=".host.tmp", dir=_DIR)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(_host_isa())
+            os.replace(tmp_host, _LIB_HOST)
+        except Exception:
+            if os.path.exists(tmp_host):
+                os.unlink(tmp_host)
+            raise
         return True
     except Exception as e:
         _log.warning("native packer build error: %s", e)
@@ -263,8 +278,14 @@ def pack_frames(
     data is contiguous — per-leaf row strides are passed to C. The
     caller owns initialization (zeros + NOOP-legal action-mask padding,
     exactly zeros_train_batch's contract).
+
+    Exception contract: a malformed FRAME raises plain ValueError (the
+    staging consumer drops the batch and continues); an `out` template
+    LAYOUT/CONFIG mismatch raises BatchLayoutError (a ValueError
+    subclass), which staging treats as fatal — it would fail every
+    batch, not this one.
     """
-    from dotaclient_tpu.ops.batch import zeros_train_batch
+    from dotaclient_tpu.ops.batch import BatchLayoutError, zeros_train_batch
 
     n = len(frames)
     if out is None:
@@ -308,20 +329,20 @@ def pack_frames(
                 stride_vals.append(0)
                 continue
             if np.dtype(arr.dtype).name != want:
-                raise ValueError(
+                raise BatchLayoutError(
                     f"out leaf dtype {np.dtype(arr.dtype).name} != {want} "
                     f"(obs_bf16={obs_bf16}; template/flag mismatch)"
                 )
             if arr.shape[0] != n:
-                raise ValueError(f"out batch rows {arr.shape[0]} != {n} frames")
+                raise BatchLayoutError(f"out batch rows {arr.shape[0]} != {n} frames")
             stride_elems, rem = divmod(arr.strides[0], arr.itemsize)
             if rem:
-                raise ValueError("out leaf row stride not a multiple of itemsize")
+                raise BatchLayoutError("out leaf row stride not a multiple of itemsize")
             # within-row contiguity: trailing dims must be C-contiguous
             expect = arr.itemsize
             for dim, st_b in zip(arr.shape[:0:-1], arr.strides[:0:-1]):
                 if st_b != expect:
-                    raise ValueError("out leaf rows must be internally contiguous")
+                    raise BatchLayoutError("out leaf rows must be internally contiguous")
                 expect *= dim
             stride_vals.append(stride_elems)
         strides_arg = (ctypes.c_int64 * 20)(*stride_vals)

@@ -25,6 +25,17 @@ from dotaclient_tpu.env.featurizer import Observation
 from dotaclient_tpu.ops.action_dist import Action
 
 
+class BatchLayoutError(ValueError):
+    """A batch/template LAYOUT or CONFIG mismatch at a pack boundary —
+    out-leaf dtype/row/stride validation in the native packer, treedef or
+    row-count validation in the fused transfer pack. Distinct from the
+    plain ValueError a malformed FRAME raises: a bad frame costs its own
+    batch (staging drops it and continues), but a layout mismatch is a
+    builder/staging config disagreement that would fail every batch
+    forever — staging lets it propagate and kills the consumer loudly
+    instead of logging an endless dropped_bad stream (ADVICE r5 item 1)."""
+
+
 class AuxTargets(NamedTuple):
     """Targets for the auxiliary value heads (benchmark config 5)."""
 
@@ -43,9 +54,18 @@ class TrainBatch(NamedTuple):
     mask: jnp.ndarray  # [B, T] f32 — 1.0 on real steps
     initial_state: tuple  # (c, h) each [B, H] f32
     aux: Optional[AuxTargets] = None  # present iff cfg.policy.aux_heads
+    # [B] f32 — pack-time learner version minus each row's behavior-policy
+    # version; 0.0 on fresh/bypass rows, > 0 on rows sampled from the
+    # replay reservoir. None whenever replay is disabled, so the treedef
+    # (and every compiled program keyed on it) is unchanged from the
+    # pre-replay layout. Consumed by ops/ppo.py's ACER truncated
+    # importance weights.
+    behavior_staleness: Optional[jnp.ndarray] = None
 
 
-def zeros_train_batch(B: int, T: int, lstm_hidden: int, with_aux: bool, obs_dtype=None) -> TrainBatch:
+def zeros_train_batch(
+    B: int, T: int, lstm_hidden: int, with_aux: bool, obs_dtype=None, with_staleness: bool = False
+) -> TrainBatch:
     """The one canonical all-zeros numpy TrainBatch skeleton.
 
     Single source of truth for the batch layout: the staging packer fills
@@ -85,4 +105,7 @@ def zeros_train_batch(B: int, T: int, lstm_hidden: int, with_aux: bool, obs_dtyp
             np.zeros((B, lstm_hidden), np.float32),
         ),
         aux=AuxTargets(win=z.copy(), last_hit=z.copy(), net_worth=z.copy()) if with_aux else None,
+        # with_staleness is only set by replay-enabled templates/batches;
+        # the default keeps the treedef identical to the pre-replay layout.
+        behavior_staleness=np.zeros((B,), np.float32) if with_staleness else None,
     )

@@ -36,6 +36,7 @@ def _surrogate(
     aux_targets,
     cfg: PPOConfig,
     aux_coef: float,
+    staleness=None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Clipped surrogate + value + entropy (+aux) given a completed unroll
     `out` and FIXED advantages/returns — shared by the one-update path
@@ -43,7 +44,17 @@ def _surrogate(
     (which precomputes them once per consumed batch). Advantages are
     normalized over whatever slice `mask` covers — the full batch in the
     one-update path, the minibatch in the reuse path (the PPO2
-    convention)."""
+    convention).
+
+    `staleness` ([B] f32 or None) is the replay reservoir's per-row
+    behavior-policy staleness stamp (runtime/staging.py). Rows with
+    staleness > 0 were sampled off-policy from the reservoir; their IS
+    ratio is truncated at cfg.replay_rho_bar (ACER's c-bar, arxiv
+    1611.01224) before entering the surrogate, bounding the one corner
+    plain PPO clipping leaves unbounded (A < 0 with ratio >> 1, where
+    min(unclipped, clipped) selects the unclipped term). Rows with
+    staleness 0 — and the staleness=None replay-disabled path — use the
+    raw ratio, so the loss is bit-identical to plain PPO there."""
     T = actions.type.shape[1]
     values = out.value  # [B, T+1]
     dist_t = jax.tree.map(lambda x: x[:, :T], out.dist)
@@ -54,8 +65,18 @@ def _surrogate(
     norm_adv = (advantages - masked_mean(advantages, mask)) / masked_std(advantages, mask)
     norm_adv = jax.lax.stop_gradient(norm_adv * mask)
 
-    unclipped = ratio * norm_adv
-    clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps) * norm_adv
+    if staleness is not None:
+        stale_row = (staleness > 0.0).astype(ratio.dtype)[:, None]  # [B, 1] over T
+        surr_ratio = jnp.where(stale_row > 0, jnp.minimum(ratio, cfg.replay_rho_bar), ratio)
+        trunc_frac = masked_mean(
+            (stale_row * (ratio > cfg.replay_rho_bar)).astype(jnp.float32), mask
+        )
+    else:
+        surr_ratio = ratio
+        trunc_frac = jnp.zeros((), jnp.float32)
+
+    unclipped = surr_ratio * norm_adv
+    clipped = jnp.clip(surr_ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps) * norm_adv
     policy_loss = -masked_mean(jnp.minimum(unclipped, clipped), mask)
 
     v_pred = values[:, :T]
@@ -82,6 +103,10 @@ def _surrogate(
         "advantage_mean": masked_mean(advantages, mask),
         "return_mean": masked_mean(returns, mask),
         "value_mean": masked_mean(v_pred, mask),
+        # Always present (0.0 when replay is off) so the metrics dict —
+        # and the reuse scan's carried metric structure — never changes
+        # shape with the replay flag.
+        "replay_trunc_frac": trunc_frac,
     }
 
     if aux_targets is not None and out.aux is not None:
@@ -137,6 +162,7 @@ def ppo_loss(
         batch.aux,
         cfg,
         aux_coef,
+        staleness=batch.behavior_staleness,
     )
 
 
@@ -154,6 +180,7 @@ class ReuseBatch(NamedTuple):
     mask: jnp.ndarray
     initial_state: object
     aux: object  # AuxTargets or None
+    staleness: object = None  # [B] f32 replay staleness stamp, or None
 
 
 def precompute_reuse(params, apply_fn, batch: TrainBatch, cfg: PPOConfig) -> ReuseBatch:
@@ -178,6 +205,7 @@ def precompute_reuse(params, apply_fn, batch: TrainBatch, cfg: PPOConfig) -> Reu
         mask=batch.mask,
         initial_state=batch.initial_state,
         aux=batch.aux,
+        staleness=batch.behavior_staleness,
     )
 
 
@@ -198,4 +226,5 @@ def ppo_minibatch_loss(
         mb.aux,
         cfg,
         aux_coef,
+        staleness=mb.staleness,
     )

@@ -130,15 +130,19 @@ class FusedBatchIO:
         # Same pack-boundary validation contract as pack(): a mis-sized
         # or structurally different batch must fail HERE with a named
         # error, not silently truncate the leaf zip or broadcast one row
-        # across the buffer.
+        # across the buffer. BatchLayoutError marks it as a persistent
+        # config mismatch — staging crashes its consumer loudly instead
+        # of logging dropped_bad forever (ops/batch.py).
+        from dotaclient_tpu.ops.batch import BatchLayoutError
+
         leaves, treedef = jax.tree.flatten(batch)
         if treedef != self.treedef:
-            raise ValueError(
+            raise BatchLayoutError(
                 f"single pack: batch structure {treedef} != template {self.treedef}"
             )
         rows = np.asarray(leaves[0]).shape[0]
         if rows != self.local_rows:
-            raise ValueError(
+            raise BatchLayoutError(
                 f"single pack: got {rows} rows, expected {self.local_rows} "
                 f"(template batch {self.batch}; multihost learners set "
                 f"local_rows to their per-process share)"
@@ -234,10 +238,12 @@ class FusedBatchIO:
         template: in multihost mode each process packs its LOCAL share
         (global_batch / process_count rows) and the learner stitches the
         shares into the global array (runtime/learner.py _fetch_next)."""
+        from dotaclient_tpu.ops.batch import BatchLayoutError
+
         leaves = jax.tree.leaves(batch)
         rows = np.asarray(leaves[0]).shape[0]
         if rows != self.local_rows:
-            raise ValueError(
+            raise BatchLayoutError(
                 f"fused pack: got {rows} rows, expected {self.local_rows} "
                 f"(template batch {self.batch}; multihost learners set "
                 f"local_rows to their per-process share)"

@@ -176,6 +176,7 @@ def _build_reuse_step_fn(cfg: LearnerConfig, mesh, net, opt, use_sp: bool, sp: s
         "advantage_mean",
         "return_mean",
         "value_mean",
+        "replay_trunc_frac",
         "grad_norm",
     ] + (["aux_loss"] if cfg.policy.aux_heads else [])
 
@@ -309,6 +310,13 @@ def _build_fused(cfg: LearnerConfig, mesh, single: bool):
             f"incompatible with sequence parallelism (tf_sp_axis set); "
             f"use build_train_step"
         )
+    if cfg.replay.enabled:
+        raise ValueError(
+            "fused H2D transfer is incompatible with the replay reservoir: "
+            "the per-row behavior_staleness stamp is not part of the "
+            "dtype-grouped transfer layout; use build_train_step (the "
+            "Learner falls back to the tree path automatically)"
+        )
     from dotaclient_tpu.parallel.fused_io import FusedBatchIO
     from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
 
@@ -361,10 +369,18 @@ def build_single_train_step(cfg: LearnerConfig, mesh):
 
 
 def _batch_template(cfg: LearnerConfig):
-    """A TrainBatch-shaped pytree for sharding derivation."""
+    """A TrainBatch-shaped pytree for sharding derivation. With replay
+    enabled the batch carries the [B] behavior_staleness stamp, so the
+    template (and every sharding/jit treedef derived from it) must too."""
     from dotaclient_tpu.ops.batch import zeros_train_batch
 
-    return zeros_train_batch(cfg.batch_size, cfg.seq_len, cfg.policy.lstm_hidden, cfg.policy.aux_heads)
+    return zeros_train_batch(
+        cfg.batch_size,
+        cfg.seq_len,
+        cfg.policy.lstm_hidden,
+        cfg.policy.aux_heads,
+        with_staleness=cfg.replay.enabled,
+    )
 
 
 def make_train_batch(cfg: LearnerConfig, rng_seed: int = 0) -> TrainBatch:
@@ -428,4 +444,7 @@ def make_train_batch(cfg: LearnerConfig, rng_seed: int = 0) -> TrainBatch:
         mask=mask,
         initial_state=(np.zeros((B, H), np.float32), np.zeros((B, H), np.float32)),
         aux=aux,
+        # All-fresh stamp iff replay is on, so a random batch always
+        # matches _batch_template's treedef for the same config.
+        behavior_staleness=np.zeros((B,), np.float32) if cfg.replay.enabled else None,
     )

@@ -199,10 +199,14 @@ class Learner:
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg.mesh_shape)
         # Fused 4-buffer H2D path when enabled and not sequence-parallel
         # (fused_io.py); per-leaf tree path otherwise. Same compiled math.
+        # The replay reservoir also forces the tree path: the per-row
+        # behavior_staleness stamp is not part of the fused transfer
+        # layout, and replay targets data-starved regimes where the H2D
+        # transfer-count overhead is not the bottleneck anyway.
         self.fused_io = None
         from dotaclient_tpu.parallel.train_step import is_sequence_parallel
 
-        if cfg.fused_h2d and not is_sequence_parallel(cfg, self.mesh):
+        if cfg.fused_h2d and not is_sequence_parallel(cfg, self.mesh) and not cfg.replay.enabled:
             from dotaclient_tpu.parallel.train_step import (
                 build_fused_train_step,
                 build_single_train_step,
@@ -534,6 +538,12 @@ class Learner:
                     scalars["staleness_dropped"] = stats["dropped_stale"]
                     scalars["queue_ready"] = stats["ready_batches"]
                     scalars["episodes"] = stats["episodes"]
+                    # Replay reservoir health (replay.enabled only):
+                    # occupancy, hit ratio, replayed-frame age histogram
+                    # buckets, bytes spilled — all pre-flattened scalars.
+                    for k, v in stats.items():
+                        if k.startswith("replay_"):
+                            scalars[k] = v
                     scalars["weights_published"] = self.publisher.published
                     scalars["weights_coalesced"] = self.publisher.coalesced
                     if self.checkpointer is not None:

@@ -16,6 +16,19 @@ import time
 from typing import Dict, Optional
 
 
+def histogram_scalars(prefix: str, edges, counts) -> Dict[str, float]:
+    """Flatten a bucketed histogram into the TB/JSONL-friendly scalar
+    names this logger speaks: `{prefix}_le_{edge}` per closed bucket plus
+    `{prefix}_gt_{last_edge}` for the open tail. `counts` has
+    len(edges)+1 entries. Used for the replay reservoir's replayed-frame
+    age histogram (dotaclient_tpu/replay/reservoir.py) — scalars per
+    bucket keep the stream greppable and TB-plottable without a
+    histogram proto dependency."""
+    out = {f"{prefix}_le_{edge}": float(counts[i]) for i, edge in enumerate(edges)}
+    out[f"{prefix}_gt_{edges[-1]}"] = float(counts[len(edges)])
+    return out
+
+
 class MetricsLogger:
     def __init__(self, log_dir: str = "", flush_every: int = 20):
         self._tb = None

@@ -9,14 +9,27 @@ device-boundary note). Structure:
 - a consumer thread drains the broker and deserializes frames;
 - rollouts older than `max_staleness` learner versions are dropped here,
   on the host, before they cost any device time (SURVEY.md §7
-  "Staleness/backpressure");
+  "Staleness/backpressure") — unless the replay reservoir is enabled
+  (LearnerConfig.replay, dotaclient_tpu/replay/), in which case
+  near-stale rollouts are RETAINED in a prioritized reservoir and mixed
+  back into batches at a configurable ratio, each row stamped with its
+  behavior-policy staleness for the ACER truncated importance weights
+  in ops/ppo.py;
 - a packer assembles ready batches into a bounded queue (depth 2) so
   packing the next batch overlaps the device step on the current one
   (double buffering);
 - single-writer ownership: only the consumer thread touches the pending
-  list, only get_batch pops ready batches (SURVEY.md §5 race-detection
-  note — structural avoidance, mirrored from the reference's
-  single-threaded consumers).
+  list AND the reservoir, only get_batch pops ready batches (SURVEY.md
+  §5 race-detection note — structural avoidance, mirrored from the
+  reference's single-threaded consumers).
+
+Failure split (ADVICE r5 item 1): a malformed FRAME costs its own batch
+at worst (dropped_bad, consumer continues); a batch/template LAYOUT or
+CONFIG mismatch (ops.batch.BatchLayoutError from the native packer or
+the fused transfer pack) is a persistent builder/staging disagreement
+that would fail every batch forever — the consumer thread dies loudly
+and get_batch/get_batch_groups re-raise instead of starving the learner
+behind per-batch warnings.
 """
 
 from __future__ import annotations
@@ -30,7 +43,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from dotaclient_tpu.config import LearnerConfig
-from dotaclient_tpu.ops.batch import TrainBatch, zeros_train_batch
+from dotaclient_tpu.ops.batch import BatchLayoutError, TrainBatch, zeros_train_batch
 
 _log = logging.getLogger(__name__)
 from dotaclient_tpu.transport.base import Broker
@@ -146,11 +159,52 @@ class StagingBuffer:
         self._ready: "queue.Queue" = queue.Queue(maxsize=2)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Set when the consumer thread dies on a BatchLayoutError; the
+        # learner-side getters re-raise it so the mismatch surfaces as a
+        # fast failure, not silent starvation.
+        self._fatal: Optional[BaseException] = None
         self._lib = None
         if getattr(cfg, "native_packer", True):
             from dotaclient_tpu import native
 
             self._lib = native.load_packer()
+        # Replay reservoir (dotaclient_tpu/replay/): owned and touched by
+        # the consumer thread only, same single-writer discipline as
+        # _pending. Payloads match the pending-item type — raw frame
+        # bytes on the native path, Rollout objects on the python path —
+        # so sampled entries re-enter the SAME packer unchanged.
+        self._reservoir = None
+        self._replay_target = 0
+        if cfg.replay.enabled:
+            if fused_io is not None:
+                raise ValueError(
+                    "replay reservoir and fused H2D staging are mutually "
+                    "exclusive: the behavior_staleness stamp is not part of "
+                    "the dtype-grouped transfer layout (the Learner builds "
+                    "the tree-path train step when replay.enabled)"
+                )
+            if cfg.replay.max_staleness <= cfg.ppo.max_staleness:
+                raise ValueError(
+                    f"replay.max_staleness={cfg.replay.max_staleness} must "
+                    f"exceed ppo.max_staleness={cfg.ppo.max_staleness} — a "
+                    f"smaller window can never retain a frame the fresh "
+                    f"filter would drop"
+                )
+            from dotaclient_tpu.replay import ReplayReservoir
+
+            if self._lib is not None:
+                enc = dec = None  # native items ARE serialized frames
+            else:
+                from dotaclient_tpu.transport.serialize import serialize_rollout
+
+                enc, dec = serialize_rollout, deserialize_rollout
+            self._reservoir = ReplayReservoir(cfg.replay, encode=enc, decode=dec, seed=cfg.seed)
+            # Cap at B-1: every batch keeps at least one fresh row, so
+            # batch formation always drains the broker and the loop can
+            # never spin on a reservoir-only diet.
+            self._replay_target = min(
+                int(round(cfg.batch_size * cfg.replay.ratio)), cfg.batch_size - 1
+            )
         # actor heartbeats: actor_id → last time a frame from it arrived
         # (written only by the consumer thread; stats() reads a snapshot)
         self._actor_seen: Dict[int, float] = {}
@@ -161,6 +215,8 @@ class StagingBuffer:
             "dropped_stale": 0,
             "dropped_bad": 0,
             "batches": 0,
+            "rows_packed": 0,
+            "rows_replayed": 0,
             "episode_return_sum": 0.0,
             "episodes": 0,
             "consumer_errors": 0,
@@ -188,11 +244,16 @@ class StagingBuffer:
                 frames = self.broker.consume_experience(max_items=B, timeout=0.2)
                 if frames:
                     self._ingest(frames)
-                while len(self._pending) >= B:
-                    items = self._pending[:B]
-                    del self._pending[:B]
+                while not self._stop.is_set():
+                    items, staleness = self._next_batch_items(B)
+                    if items is None:
+                        break
                     try:
                         batch_groups = self._pack(items)
+                    except BatchLayoutError:
+                        # layout/config mismatch: fails every batch, not
+                        # this batch — propagate to the fatal handler below
+                        raise
                     except ValueError:
                         # a frame passed ingest validation but failed the
                         # packer — drop the batch, never livelock on it
@@ -200,20 +261,63 @@ class StagingBuffer:
                         with self._stats_lock:
                             self._stats["dropped_bad"] += len(items)
                         continue
+                    if staleness is not None:
+                        batch, groups = batch_groups
+                        batch_groups = (
+                            batch._replace(behavior_staleness=np.asarray(staleness, np.float32)),
+                            groups,
+                        )
                     with self._stats_lock:
                         self._stats["batches"] += 1
+                        self._stats["rows_packed"] += len(items)
+                        if staleness is not None:
+                            self._stats["rows_replayed"] += sum(1 for s in staleness if s > 0)
                     while not self._stop.is_set():
                         try:
                             self._ready.put(batch_groups, timeout=0.2)
                             break
                         except queue.Full:
                             continue
+            except BatchLayoutError as e:
+                # Persistent builder/staging config disagreement: crash the
+                # consumer LOUDLY (ADVICE r5 item 1). The learner-side
+                # getters re-raise _fatal so the failure is fast, not a
+                # silent per-batch dropped_bad starvation.
+                _log.critical("staging layout/config mismatch; consumer dying: %s", e)
+                self._fatal = e
+                self._stop.set()
+                raise
             except Exception:
                 # The consumer thread must never die silently — a dead
                 # consumer hangs the learner in get_batch forever.
                 _log.exception("staging consumer error; continuing")
                 with self._stats_lock:
                     self._stats["consumer_errors"] += 1
+
+    def _next_batch_items(self, B: int):
+        """(items, staleness-list-or-None) for one batch, or (None, None)
+        when not enough material is pending. Replay mode fills up to
+        `replay.ratio` of the batch from the reservoir — never blocking
+        on it (a short reservoir just means more fresh rows) — and
+        stamps per-row behavior-policy staleness; fresh rows stamp 0."""
+        if self._reservoir is None:
+            if len(self._pending) < B:
+                return None, None
+            items = self._pending[:B]
+            del self._pending[:B]
+            return items, None
+        now_v = self.version_fn()
+        self._reservoir.expire(now_v)
+        k = min(self._replay_target, self._reservoir.occupancy)
+        if len(self._pending) < B - k:
+            return None, None
+        items = self._pending[: B - k]
+        del self._pending[: B - k]
+        staleness = [0.0] * len(items)
+        for payload, version in self._reservoir.sample(k, now_v):
+            items.append(payload)
+            staleness.append(float(max(now_v - version, 0)))
+        return items, staleness
 
     def _pack(self, items: List):
         """(TrainBatch, groups-or-None). Fused mode packs straight into
@@ -285,8 +389,26 @@ class StagingBuffer:
             last_done,
         )
 
+    def _offer_replay(self, item, frame: bytes, version: int, current_version: int) -> bool:
+        """Consumer-thread-only: admit one would-be-stale item into the
+        reservoir. Priority is the PER |TD-error| proxy computed from the
+        actor-stamped behavior values — the native path pays a full
+        deserialize here, but only for frames that were pure waste
+        before, so any admitted frame is recovered value."""
+        try:
+            rollout = item if isinstance(item, Rollout) else deserialize_rollout(frame)
+        except (ValueError, KeyError):
+            return False
+        from dotaclient_tpu.replay import td_error_priority
+
+        priority = td_error_priority(
+            rollout.rewards, rollout.behavior_value, rollout.dones, self.cfg.ppo.gamma
+        )
+        return self._reservoir.offer(item, version, priority, len(frame), current_version)
+
     def _ingest(self, frames: List[bytes]) -> None:
-        min_version = self.version_fn() - self.cfg.ppo.max_staleness
+        version_now = self.version_fn()
+        min_version = version_now - self.cfg.ppo.max_staleness
         H = self.cfg.policy.lstm_hidden
         consumed = len(frames)
         dropped_stale = dropped_bad = episodes = 0
@@ -310,7 +432,7 @@ class StagingBuffer:
             )
         else:
             parsed_iter = (self._parse(f) for f in frames)
-        for parsed in parsed_iter:
+        for i, parsed in enumerate(parsed_iter):
             if parsed is None:
                 dropped_bad += 1
                 continue
@@ -329,6 +451,14 @@ class StagingBuffer:
                 dropped_bad += 1
                 continue
             if version < min_version:
+                # Pre-replay behavior: pure waste (dropped_stale). With
+                # the reservoir on, near-stale frames are retained for
+                # off-policy reuse instead; the reservoir itself rejects
+                # anything past replay.max_staleness (still a stale drop).
+                if self._reservoir is not None and self._offer_replay(
+                    item, frames[i], version, version_now
+                ):
+                    continue
                 dropped_stale += 1
                 continue
             if L and last_done > 0:
@@ -344,9 +474,36 @@ class StagingBuffer:
 
     # -- learner side ----------------------------------------------------
 
+    def _check_fatal(self) -> None:
+        if self._fatal is not None:
+            raise RuntimeError(
+                "staging consumer died on a layout/config mismatch — every "
+                "batch would fail; fix the builder/staging config disagreement"
+            ) from self._fatal
+
+    def _get_ready(self, timeout: Optional[float]):
+        """queue.get that stays responsive to a consumer death: waits in
+        short slices and re-checks _fatal between them, so a learner
+        already blocked when the consumer dies on a BatchLayoutError
+        fails within ~0.2s instead of sitting out its full batch timeout
+        against a queue nothing will ever fill again."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            self._check_fatal()
+            if deadline is None:
+                step = 0.2
+            else:
+                step = min(0.2, deadline - time.monotonic())
+                if step <= 0:
+                    raise queue.Empty
+            try:
+                return self._ready.get(timeout=step)
+            except queue.Empty:
+                continue
+
     def get_batch(self, timeout: Optional[float] = None) -> Optional[TrainBatch]:
         try:
-            return self._ready.get(timeout=timeout)[0]
+            return self._get_ready(timeout)[0]
         except queue.Empty:
             return None
 
@@ -357,7 +514,7 @@ class StagingBuffer:
         `groups`; consume before the next two batches overwrite nothing —
         every batch allocates fresh buffers, so no aliasing hazard."""
         try:
-            return self._ready.get(timeout=timeout)
+            return self._get_ready(timeout)
         except queue.Empty:
             return None, None
 
@@ -371,6 +528,12 @@ class StagingBuffer:
         cutoff = time.monotonic() - self.heartbeat_window_s
         seen = dict(self._actor_seen)  # snapshot; pruning lives in _ingest
         out["active_actors"] = sum(1 for t in seen.values() if t >= cutoff)
+        if self._reservoir is not None:
+            for k, v in self._reservoir.stats().items():
+                out[f"replay_{k}"] = v
+            # Fraction of packed rows served from the reservoir — the
+            # headline "how much previously-wasted work is being reused".
+            out["replay_hit_ratio"] = out["rows_replayed"] / max(out["rows_packed"], 1)
         return out
 
     def stop(self) -> None:
