@@ -441,6 +441,73 @@ def main() -> None:
             if s_staging is not None:
                 s_staging.stop()
 
+    # --- per-stage pipeline trace breakdown (dotaclient_tpu/obs/): a
+    # short run of the SAME pipeline with trace-stamped (DTR2) frames,
+    # reported as mean latency per hop plus the e2e actor→apply scalar.
+    # Deliberately OUTSIDE the timed headline window: tracing is opt-in
+    # in production and the number of record must stay comparable across
+    # rounds. Best-effort — a failure degrades to a missing field.
+    trace_breakdown = None
+    t_stop = t_staging = None
+    try:
+        from dotaclient_tpu.obs.trace import PipelineTracer
+        from dotaclient_tpu.transport.serialize import stamp_rollout_trace
+
+        mem.reset("bench_trace")
+        t_conn = connect("mem://bench_trace", maxlen=cfg.batch_size * 4)
+        t_frames = _make_frames(cfg, 256)
+        t_stop = threading.Event()
+
+        def traced_producer():
+            i = 0
+            while not t_stop.is_set():
+                if t_conn.experience_depth() >= cfg.batch_size * 3:
+                    time.sleep(0.001)
+                    continue
+                # fresh trace id + birth per publish — the per-frame
+                # stamp copy is exactly what a traced actor pays
+                t_conn.publish_experience(
+                    stamp_rollout_trace(t_frames[i % len(t_frames)], i + 1, time.time())
+                )
+                i += 1
+
+        tracer = PipelineTracer()
+        t_staging = StagingBuffer(
+            cfg, connect("mem://bench_trace"), version_fn=lambda: 0,
+            fused_io=io, tracer=tracer,
+        ).start()
+        t_threads = [threading.Thread(target=traced_producer, daemon=True) for _ in range(2)]
+        for t in t_threads:
+            t.start()
+        for _ in range(6):
+            b, groups = t_staging.get_batch_groups(timeout=120.0)
+            if b is None:
+                raise RuntimeError("traced staging starved (timeout)")
+            trace = t_staging.last_batch_trace
+            dev = jax.device_put(groups, io.shardings)
+            if trace is not None:
+                tracer.hop_batch("h2d", trace)
+            state, metrics = train_step(state, dev)
+            if trace is not None:
+                tracer.hop_batch("apply", trace)
+                tracer.e2e(trace)
+        jax.block_until_ready(metrics["loss"])
+        sc = tracer.scalars()
+        trace_breakdown = {
+            k.replace("trace_", "").replace("_mean_ms", "_ms"): round(v, 3)
+            for k, v in sc.items()
+            if k.endswith("_mean_ms")
+        }
+        if "trace_e2e_actor_apply_s" in sc:
+            trace_breakdown["e2e_actor_apply_s"] = round(sc["trace_e2e_actor_apply_s"], 4)
+    except Exception as e:
+        trace_breakdown = {"error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        if t_stop is not None:
+            t_stop.set()
+        if t_staging is not None:
+            t_staging.stop()
+
     # --- transfer-layout A/B (informational, best-effort): the same
     # batch bytes H2D as 17 pytree leaves vs 4 dtype groups vs ONE
     # concatenated byte buffer. On the tunneled chip the per-transfer RPC
@@ -568,6 +635,9 @@ def main() -> None:
         "h2d_bytes_per_iter": int(h2d_bytes) if h2d_bytes else None,
         "d2h_bytes_per_iter": int(d2h_bytes) if d2h_bytes else None,
         "transfer_layout_ab": transfer_ab,
+        # mean ms per pipeline hop from the traced section (obs/trace.py
+        # hop chain: consume → staging_admit → pack → h2d → apply) + e2e
+        "trace_stage_breakdown": trace_breakdown,
     }
     if e2e_single is not None:
         out["e2e_single_buffer_steps_per_sec"] = round(e2e_single, 1)

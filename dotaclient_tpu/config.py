@@ -148,6 +148,34 @@ class ReplayConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Pipeline observability (dotaclient_tpu/obs/): rollout tracing,
+    flight recorder, and the /metrics scrape endpoint. Default OFF with
+    zero hot-path overhead: no trace stamping (wire frames stay
+    byte-identical legacy DTR1), no hop recording, no ring writes, no
+    HTTP server. Shared by the actor and learner binaries (--obs.*)."""
+
+    # Master switch: stamp trace ids on published rollouts (actor),
+    # record per-hop pipeline events + flight-recorder ring (both).
+    enabled: bool = False
+    # HTTP /metrics port, Prometheus text format (0 = no server). Serves
+    # the latest MetricsLogger scalars plus live obs gauges (broker
+    # queue depth, staging occupancy, replay reservoir stats). Stdlib
+    # http.server only — no new dependencies.
+    metrics_port: int = 0
+    # Bounded in-memory ring of recent pipeline events per process,
+    # dumped to JSON on crash, BatchLayoutError, SIGTERM, or explicit
+    # FlightRecorder.dump().
+    ring_size: int = 2048
+    # Where flight-recorder dumps land ("" = current working directory).
+    dump_dir: str = ""
+    # Install process-wide SIGTERM + excepthook dump triggers. On by
+    # default when obs is enabled; off for embedders (tests, drivers)
+    # that own their signal handling.
+    install_handlers: bool = True
+
+
+@dataclass
 class LearnerConfig:
     """Learner binary (reference: optimizer.py CLI)."""
 
@@ -155,6 +183,7 @@ class LearnerConfig:
     seq_len: int = 16  # rollout chunk length = LSTM truncation window
     ppo: PPOConfig = field(default_factory=PPOConfig)
     replay: ReplayConfig = field(default_factory=ReplayConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     broker_url: str = "mem://"
     checkpoint_dir: str = ""
@@ -269,6 +298,7 @@ class ActorConfig:
     # usage is ADVANTAGEOUS (scripts/ab_cast.py trains with and without);
     # never set in production.
     disable_cast: bool = False
+    obs: ObsConfig = field(default_factory=ObsConfig)
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     seed: int = 0
     actor_id: int = 0

@@ -1,0 +1,109 @@
+"""Pipeline observability: tracing, flight recorder, scrape surface.
+
+The ROADMAP north star is a production-scale deployment, but the
+actor → broker → staging → replay → learner pipe had no per-hop timing
+and no scrape endpoint — you could see THAT throughput was low
+(env_steps_per_sec), never WHERE a rollout spent its time. This package
+is the measurement layer:
+
+- obs/trace.py        per-stage latency histograms from trace-stamped
+                      rollout chunks (DTR2 wire extension) + the e2e
+                      actor→apply scalar that decomposes staleness;
+- obs/flight_recorder bounded ring of recent pipeline events, dumped to
+                      JSON on crash / BatchLayoutError / SIGTERM;
+- obs/http            stdlib-only Prometheus-text /metrics endpoint;
+- obs/registry        the documented scalar-name contract + drift guard.
+
+Everything is opt-in via --obs.* and default-off with zero hot-path
+overhead: no tracer/recorder objects exist, wire frames stay
+byte-identical DTR1, staging/learner take their pre-obs paths
+unchanged (asserted in tests/test_obs.py).
+
+`ObsRuntime` is the per-process bundle the binaries construct:
+
+    self.obs = ObsRuntime.create(cfg.obs, role="learner")  # or None
+
+Actors use stamp() to trace outgoing chunks; the learner hands
+`tracer`/`recorder` to its StagingBuffer and starts the scrape server
+with live gauge sources.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from dotaclient_tpu.config import ObsConfig
+from dotaclient_tpu.obs.flight_recorder import FlightRecorder
+from dotaclient_tpu.obs.http import MetricsHTTPServer
+from dotaclient_tpu.obs.trace import LATENCY_EDGES_MS, STAGES, PipelineTracer, TraceRef
+
+__all__ = [
+    "LATENCY_EDGES_MS",
+    "STAGES",
+    "FlightRecorder",
+    "MetricsHTTPServer",
+    "ObsRuntime",
+    "PipelineTracer",
+    "TraceRef",
+]
+
+
+class ObsRuntime:
+    """One process's observability bundle: recorder + tracer (+ scrape
+    server for processes that call serve_metrics)."""
+
+    def __init__(self, cfg: ObsConfig, role: str):
+        self.cfg = cfg
+        self.role = role
+        self.recorder = FlightRecorder(
+            role, ring_size=cfg.ring_size, dump_dir=cfg.dump_dir
+        )
+        self.tracer = PipelineTracer(recorder=self.recorder)
+        self.server: Optional[MetricsHTTPServer] = None
+        self._trace_seq = 0
+
+    @classmethod
+    def create(cls, cfg: ObsConfig, role: str) -> Optional["ObsRuntime"]:
+        """None when obs is disabled — callers keep a single `if self.obs
+        is None` guard and the disabled path constructs nothing."""
+        if not cfg.enabled:
+            return None
+        rt = cls(cfg, role)
+        if cfg.install_handlers:
+            rt.recorder.install_handlers()
+        return rt
+
+    # ------------------------------------------------------------- actor
+
+    def stamp(self, rollout, actor_id: int):
+        """Trace-stamp an outgoing rollout chunk (actor publish path):
+        allocates the trace id, stamps birth, records the publish event.
+        Returns the stamped Rollout (serialize_rollout then emits DTR2)."""
+        self._trace_seq += 1
+        # High word = actor, low word = per-process sequence: ids stay
+        # unique across the fleet without coordination, and a dump's
+        # trace id alone names the publishing actor.
+        trace_id = ((actor_id & 0xFFFFFFFF) << 32) | (self._trace_seq & 0xFFFFFFFF)
+        birth = time.time()
+        self.recorder.record("publish", t=birth, trace=trace_id, actor=actor_id)
+        return rollout._replace(trace_id=trace_id, birth_time=birth)
+
+    # ------------------------------------------------------------ scrape
+
+    def serve_metrics(
+        self, sources: List[Callable[[], Dict[str, float]]]
+    ) -> Optional[MetricsHTTPServer]:
+        """Start the /metrics endpoint when cfg.metrics_port is set (> 0).
+        Adds the tracer's scalars as an implicit source."""
+        if self.cfg.metrics_port <= 0:
+            return None
+        self.server = MetricsHTTPServer(
+            self.cfg.metrics_port, sources + [self.tracer.scalars]
+        ).start()
+        return self.server
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None

@@ -1,0 +1,126 @@
+"""Scrape surface: stdlib-only HTTP /metrics in Prometheus text format.
+
+The k8s deploy had no way to scrape the learner — MetricsLogger writes
+local JSONL/TB only. This serves the latest logged scalars plus live
+gauges (broker queue depth, staging occupancy, replay reservoir stats)
+over plain http.server: no prometheus_client dependency (the container
+constraint), no new threadpools beyond one daemon serving thread.
+
+Exposition rules (the subset of the Prometheus text format scrapers
+need): one `# TYPE <name> gauge` line then `<name> <value>` per metric,
+names sanitized to [a-zA-Z_:][a-zA-Z0-9_:]* and prefixed `dotaclient_`,
+non-finite values skipped (Prometheus rejects NaN lines from some
+ingest paths, and a NaN gauge carries no information anyway).
+
+Sources are zero-arg callables returning {name: number}; each scrape
+calls them fresh so gauges are live, and a source that throws is
+skipped for that scrape (a broken stats provider must not take the
+whole endpoint down with it).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str, prefix: str = "dotaclient_") -> str:
+    name = _NAME_OK.sub("_", name)
+    if name and name[0].isdigit():
+        name = f"_{name}"
+    return f"{prefix}{name}"
+
+
+def render_prometheus(scalars: Dict[str, float], prefix: str = "dotaclient_") -> str:
+    lines: List[str] = []
+    for name in sorted(scalars):
+        try:
+            v = float(scalars[name])
+        except (TypeError, ValueError):
+            continue
+        if not math.isfinite(v):
+            continue
+        pname = sanitize_metric_name(name, prefix)
+        lines.append(f"# TYPE {pname} gauge")
+        # .10g, not %g: cumulative counters (consumed, bucket counts)
+        # outgrow %g's 6 significant digits within hours and rate()
+        # over a rounded counter produces flat-then-jump artifacts.
+        lines.append(f"{pname} {v:.10g}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsHTTPServer:
+    """One daemon thread serving GET /metrics (and /healthz) until
+    stop(). Sources are sampled per scrape; port=0 binds an ephemeral
+    port (tests), read back via `.port`."""
+
+    def __init__(self, port: int, sources: Optional[List[Callable[[], Dict[str, float]]]] = None):
+        self._sources: List[Callable[[], Dict[str, float]]] = list(sources or [])
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._requested_port = port
+
+    def add_source(self, source: Callable[[], Dict[str, float]]) -> None:
+        self._sources.append(source)
+
+    def collect(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for source in self._sources:
+            try:
+                out.update(source())
+            except Exception:
+                _log.exception("metrics source failed; skipping for this scrape")
+        return out
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._requested_port
+
+    def start(self) -> "MetricsHTTPServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] not in ("/metrics", "/healthz"):
+                    self.send_error(404)
+                    return
+                if self.path.startswith("/healthz"):
+                    body = b"ok\n"
+                else:
+                    body = render_prometheus(server.collect()).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # scrape spam stays out of stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer(("", self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="obs-metrics-http"
+        )
+        self._thread.start()
+        _log.info("obs /metrics serving on port %d", self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,91 @@
+"""Metric-name registry: the documented contract between emitters and
+dashboards.
+
+Dashboards and alerts select series BY NAME; a rename in learner.py (or
+a new scalar nobody documents) silently drops/misses series with no
+error anywhere. This registry is the single source of truth for every
+scalar the learner/staging/replay/obs pipeline emits, and
+tests/test_obs.py::test_emitted_scalars_are_registered drives a real
+closed-loop learner and fails tier-1 if an emitted name isn't here —
+so a rename must touch this file (and therefore the dashboards note in
+README) to land.
+
+Two name classes:
+- SCALARS: exact, hand-documented names.
+- PREFIXES: documented dynamic families whose tails are data-dependent
+  (histogram bucket edges, replay reservoir stats, checkpoint-mirror
+  stats, per-stage trace scalars). A family prefix documents the whole
+  family; keep these FEW and specific — a catch-all prefix would defeat
+  the drift guard.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# Exact scalar names → one-line meaning. Grouped by emitter.
+SCALARS: Dict[str, str] = {
+    # --- compiled train step (parallel/train_step.py metric_keys) ------
+    "loss": "total PPO objective",
+    "policy_loss": "clipped-surrogate policy term",
+    "value_loss": "clipped value regression term",
+    "entropy": "mean policy entropy over real steps",
+    "ratio_mean": "mean importance ratio",
+    "ratio_clip_frac": "fraction of ratios clipped",
+    "approx_kl": "approximate KL(new || behavior)",
+    "advantage_mean": "mean GAE advantage (pre-normalization)",
+    "return_mean": "mean bootstrapped return target",
+    "value_mean": "mean predicted value",
+    "replay_trunc_frac": "fraction of replayed rows with truncated IS ratio",
+    "grad_norm": "global gradient norm before clipping",
+    "aux_loss": "auxiliary value-head loss (aux_heads only)",
+    "ppo_updates_done": "minibatch updates applied (KL early stop aware)",
+    "ppo_kl_stopped": "1 if the KL early stop fired for this batch",
+    # --- learner loop (runtime/learner.py) -----------------------------
+    "env_steps_per_sec": "real (unmasked) env steps trained per second",
+    "time_wait_batch_s": "per-step host wait for a packed batch",
+    "time_device_put_s": "per-step host→device transfer time",
+    "time_step_s": "per-step residual (device step + dispatch)",
+    "active_actors": "actors heard from within the heartbeat window",
+    "staleness_dropped": "rollouts dropped for version staleness (cumulative)",
+    "queue_ready": "packed batches waiting in the staging queue",
+    "episodes": "episodes completed (cumulative, from done frames)",
+    "weights_published": "weight fanout frames actually sent",
+    "weights_coalesced": "weight publishes superseded before sending",
+    "mean_episode_return": "mean per-episode return over consumed frames",
+    # --- evaluator (eval/evaluator.py) ---------------------------------
+    "win_rate": "evaluation win rate vs the scripted yardstick",
+    "mean_eval_return": "mean evaluation episode return",
+    "trueskill_mu": "anchored TrueSkill mean",
+    "trueskill_sigma": "anchored TrueSkill uncertainty",
+    "skill": "conservative TrueSkill estimate (mu - 3 sigma)",
+    # --- obs (dotaclient_tpu/obs/trace.py) -----------------------------
+    "trace_e2e_actor_apply_s": "mean actor-publish → train-step-apply latency",
+}
+
+# Documented dynamic families (prefix → meaning of the family).
+PREFIXES: Dict[str, str] = {
+    # replay reservoir stats + age histogram, re-prefixed by staging:
+    # replay_occupancy, replay_admitted, replay_age_le_<edge>, ...
+    "replay_": "replay reservoir health (runtime/staging.py stats passthrough)",
+    # checkpoint remote-mirror health: ckpt_mirror_lag_steps, ...
+    "ckpt_mirror_": "checkpoint remote-mirror health (runtime/checkpoint.py)",
+    # per-stage pipeline latency histograms + means:
+    # trace_<stage>_ms_le_<edge>, trace_<stage>_ms_gt_<last>,
+    # trace_<stage>_mean_ms (obs/trace.py STAGES)
+    "trace_": "pipeline per-stage latency scalars (obs/trace.py)",
+    # obs gauges exported only on the scrape surface (not JSONL):
+    # obs_broker_experience_depth, obs_staging_*, ...
+    "obs_": "live scrape-surface gauges (obs/__init__.py sources)",
+}
+
+
+def is_registered(name: str) -> bool:
+    return name in SCALARS or any(name.startswith(p) for p in PREFIXES)
+
+
+def unregistered(names) -> list:
+    """The subset of `names` no dashboard could know about — the drift
+    guard's assertion payload. `step`/`time` are the JSONL record's own
+    envelope fields, not scalars."""
+    return sorted(n for n in names if n not in ("step", "time") and not is_registered(n))

@@ -50,10 +50,13 @@ def td_error_priority(rewards, values, dones, gamma: float) -> float:
 class _Entry:
     __slots__ = (
         "eid", "payload", "version", "priority", "nbytes", "raw_nbytes",
-        "uses", "compressed", "spill_exempt",
+        "uses", "compressed", "spill_exempt", "meta",
     )
 
-    def __init__(self, eid: int, payload: Any, version: int, priority: float, nbytes: int):
+    def __init__(
+        self, eid: int, payload: Any, version: int, priority: float, nbytes: int,
+        meta: Any = None,
+    ):
         self.eid = eid
         self.payload = payload
         self.version = version
@@ -63,6 +66,10 @@ class _Entry:
         self.uses = 0
         self.compressed = False
         self.spill_exempt = False  # zlib couldn't shrink it; try only once
+        # Opaque caller context carried alongside the payload (the obs
+        # pipeline's TraceRef). Never encoded/spilled — it rides the
+        # entry object, not the payload bytes.
+        self.meta = meta
 
 
 class ReplayReservoir:
@@ -127,10 +134,12 @@ class ReplayReservoir:
     # ---------------------------------------------------------- admission
 
     def offer(self, payload: Any, version: int, priority: float, nbytes: int,
-              current_version: int) -> bool:
+              current_version: int, meta: Any = None) -> bool:
         """Admit one near-stale item. Returns False (rejected) when the
         item is already past the reservoir's own staleness window —
-        the caller counts that as a plain stale drop."""
+        the caller counts that as a plain stale drop. `meta` is opaque
+        caller context (obs TraceRef) returned with the payload by
+        sample()."""
         if current_version - version > self.cfg.max_staleness:
             with self._stats_lock:
                 self._stats["rejected_stale"] += 1
@@ -138,7 +147,9 @@ class ReplayReservoir:
         priority = float(priority)
         if not np.isfinite(priority):  # belt-and-braces vs a caller's own key
             priority = 0.0
-        e = _Entry(self._next_id, payload, version, max(priority, 0.0), int(nbytes))
+        e = _Entry(
+            self._next_id, payload, version, max(priority, 0.0), int(nbytes), meta=meta
+        )
         self._next_id += 1
         self._buckets.setdefault(version, {})[e.eid] = e
         self._bytes += e.nbytes
@@ -182,12 +193,13 @@ class ReplayReservoir:
         # would drain fresh frames on every failed attempt).
         return np.nan_to_num(w, nan=0.0, posinf=1e30, neginf=0.0)
 
-    def sample(self, k: int, current_version: int) -> List[Tuple[Any, int]]:
+    def sample(self, k: int, current_version: int) -> List[Tuple[Any, int, Any]]:
         """Draw up to k distinct entries, priority-weighted, and return
-        [(payload, behavior_version)]. Entries stay resident (classic
-        PER reuse) until they expire, are evicted, or hit the per-entry
-        `max_replays` cap (then retired). Call `expire` first; this
-        method assumes the window is already clean."""
+        [(payload, behavior_version, meta)] — `meta` is whatever the
+        offer() caller attached (None by default). Entries stay resident
+        (classic PER reuse) until they expire, are evicted, or hit the
+        per-entry `max_replays` cap (then retired). Call `expire` first;
+        this method assumes the window is already clean."""
         entries = self._entries()
         k = min(k, len(entries))
         if k <= 0:
@@ -211,7 +223,7 @@ class ReplayReservoir:
                 payload = self._decode(zlib.decompress(e.payload))
             else:
                 payload = e.payload
-            out.append((payload, e.version))
+            out.append((payload, e.version, e.meta))
             e.uses += 1
             age = max(current_version - e.version, 0)
             b = 0

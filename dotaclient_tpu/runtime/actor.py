@@ -331,6 +331,13 @@ class Actor:
         self.steps_done = 0
         self.episodes_done = 0
         self.rollouts_published = 0
+        # Observability (--obs.*, dotaclient_tpu/obs/): when enabled the
+        # actor trace-stamps each published chunk (DTR2 wire extension)
+        # and keeps a flight-recorder ring; None = byte-identical legacy
+        # DTR1 frames and zero extra work.
+        from dotaclient_tpu.obs import ObsRuntime
+
+        self.obs = ObsRuntime.create(cfg.obs, role=f"actor{actor_id}")
         # ±1 result of the last finished episode, 0.0 for a decided draw
         # (episode ended with no winning team), None while in flight or
         # after an abandoned episode — read by the evaluator and the
@@ -451,6 +458,8 @@ class Actor:
                     win,
                     cfg.policy.aux_heads,
                 )
+                if self.obs is not None:
+                    rollout = self.obs.stamp(rollout, self.actor_id)
                 self.broker.publish_experience(serialize_rollout(rollout))
                 self.rollouts_published += 1
                 state, chunk = next_chunk(cfg.policy, state)

@@ -296,8 +296,19 @@ class Learner:
         # transfer buffers (leaf views), so _fetch_next ships `groups`
         # without the io.pack regroup copy (~0.7 ms/batch of host memcpy
         # at flagship shapes — critical-path time on a 1-core host).
+        # Observability (dotaclient_tpu/obs/, --obs.*): None when off —
+        # every obs touchpoint below is a single `is not None` check, so
+        # the disabled hot path is unchanged.
+        from dotaclient_tpu.obs import ObsRuntime
+
+        self.obs = ObsRuntime.create(cfg.obs, role="learner")
         self.staging = StagingBuffer(
-            staging_cfg, broker, version_fn=lambda: self.version, fused_io=self.fused_io
+            staging_cfg,
+            broker,
+            version_fn=lambda: self.version,
+            fused_io=self.fused_io,
+            tracer=self.obs.tracer if self.obs is not None else None,
+            recorder=self.obs.recorder if self.obs is not None else None,
         )
         self.flattener = ParamFlattener(state.params)
         self.publisher = WeightPublisher(
@@ -307,6 +318,12 @@ class Learner:
             legacy_dtw1=cfg.publish_legacy_dtw1,
         )
         self.metrics = MetricsLogger(cfg.log_dir)
+        if self.obs is not None:
+            # Scrape surface (obs/http.py): the latest logged scalars plus
+            # live gauges sampled per scrape — queue depth straight from
+            # the broker, staging/replay occupancy from stats(). Runs for
+            # the process lifetime (run() is re-entrant); close() stops it.
+            self.obs.serve_metrics([self.metrics.latest, self._obs_gauges])
         self.env_steps_done = 0  # total real (unmasked) env steps trained on
         if cfg.profile_port:
             # device-trace endpoint (SURVEY.md §5 tracing note): attach
@@ -351,6 +368,18 @@ class Learner:
 
     # ---------------------------------------------------------------- ops
 
+    def _obs_gauges(self):
+        """Live gauges for the /metrics scrape (obs_ prefix = the
+        scrape-only family in obs/registry.py). Sampled per scrape, off
+        the train loop."""
+        out = {"obs_learner_version": float(self.version)}
+        depth = self.broker.experience_depth()
+        if depth >= 0:  # -1 = this transport can't know it cheaply
+            out["obs_broker_experience_depth"] = float(depth)
+        for k, v in self.staging.stats().items():
+            out[f"obs_staging_{k}"] = float(v)
+        return out
+
     def publish_weights(self) -> None:
         if not self._primary:
             return  # one fanout per version — process 0 publishes
@@ -374,17 +403,22 @@ class Learner:
 
         Called AFTER the current step has been dispatched, so the host
         wait and the transfer overlap the running device step. Returns
-        (batch_dev, env_steps, wait_s, put_s) or (None, 0, w, 0). In
-        fused mode the pack happened on the STAGING thread (straight
-        into the transfer buffers), so wait_s is queue wait; only the
-        dense-staging fallback pays io.pack here (still charged to
-        wait_s, never to put_s — that bucket is the pure H2D transfer).
+        (batch_dev, env_steps, wait_s, put_s, trace) or
+        (None, 0, w, 0.0, None); `trace` is the batch's obs trace refs
+        (staging.last_batch_trace) with the h2d hop already recorded —
+        at DISPATCH time, like every hop this loop records (the loop
+        never syncs the device per step). In fused mode the pack
+        happened on the STAGING thread (straight into the transfer
+        buffers), so wait_s is queue wait; only the dense-staging
+        fallback pays io.pack here (still charged to wait_s, never to
+        put_s — that bucket is the pure H2D transfer).
         """
         t0 = time.perf_counter()
         batch, groups = self.staging.get_batch_groups(timeout=batch_timeout)
         t1 = time.perf_counter()
         if batch is None:
-            return None, 0, t1 - t0, 0.0
+            return None, 0, t1 - t0, 0.0, None
+        trace = self.staging.last_batch_trace
         env_steps = int(np.sum(batch.mask))
         if self.fused_io is not None:
             # Staging packed straight into the transfer buffers (groups
@@ -408,7 +442,9 @@ class Learner:
                 )
             else:
                 batch_dev = jax.device_put(groups, shardings)
-            return batch_dev, env_steps, t2 - t0, time.perf_counter() - t2
+            if self.obs is not None and trace is not None:
+                self.obs.tracer.hop_batch("h2d", trace)
+            return batch_dev, env_steps, t2 - t0, time.perf_counter() - t2, trace
         if self._n_proc > 1:
             batch_dev = jax.tree.map(
                 lambda arr, sh: jax.make_array_from_process_local_data(sh, np.asarray(arr)),
@@ -417,7 +453,9 @@ class Learner:
             )
         else:
             batch_dev = jax.device_put(batch, self.batch_sharding)
-        return batch_dev, env_steps, t1 - t0, time.perf_counter() - t1
+        if self.obs is not None and trace is not None:
+            self.obs.tracer.hop_batch("h2d", trace)
+        return batch_dev, env_steps, t1 - t0, time.perf_counter() - t1, trace
 
     def run(
         self,
@@ -464,7 +502,7 @@ class Learner:
                     return batch_timeout
                 return max(0.05, min(batch_timeout, deadline - time.monotonic()))
 
-            next_batch, next_env_steps, w, p = self._fetch_next(_bt())
+            next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
             win_wait += w
             win_put += p
             while num_steps is None or done_steps < num_steps:
@@ -480,14 +518,20 @@ class Learner:
                     if deadline is not None and time.monotonic() >= deadline:
                         break
                     _log.warning("no batch within %.0fs; waiting", batch_timeout)
-                    next_batch, next_env_steps, w, p = self._fetch_next(_bt())
+                    next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
                     win_wait += w
                     win_put += p
                     continue
                 idle = 0
-                batch_dev, env_steps = next_batch, next_env_steps
+                batch_dev, env_steps, batch_trace = next_batch, next_env_steps, next_trace
                 # Async dispatch: returns immediately, device runs the step.
                 self.state, metrics = self.train_step(self.state, batch_dev)
+                if self.obs is not None and batch_trace is not None:
+                    # Terminal hops at DISPATCH (the loop's only routine
+                    # sync is the metrics fetch): per-stage apply delta +
+                    # the e2e actor→apply scalar that decomposes staleness.
+                    self.obs.tracer.hop_batch("apply", batch_trace)
+                    self.obs.tracer.e2e(batch_trace)
                 self.version += 1
                 done_steps += 1
                 self.env_steps_done += env_steps
@@ -500,11 +544,11 @@ class Learner:
                     # Skipped on the final step: a trailing prefetch would
                     # eat (and discard) one packed batch per phased-run
                     # call and could stall up to batch_timeout.
-                    next_batch, next_env_steps, w, p = self._fetch_next(_bt())
+                    next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
                     win_wait += w
                     win_put += p
                 else:
-                    next_batch, next_env_steps = None, 0
+                    next_batch, next_env_steps, next_trace = None, 0, None
 
                 if self.version % cfg.publish_every == 0 and self._primary:
                     # One async on-device flatten dispatch; the blocking
@@ -555,6 +599,11 @@ class Learner:
                                 scalars[f"ckpt_mirror_{k}"] = v
                     if stats["episodes"] > 0:
                         scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
+                    if self.obs is not None:
+                        # Per-stage pipeline latency histograms + the e2e
+                        # actor→apply decomposition (obs/trace.py). Empty
+                        # until traced frames flow (actors opted in).
+                        scalars.update(self.obs.tracer.scalars())
                     self.metrics.log(self.version, scalars)
                     win_wait = win_put = 0.0
                     win_env_steps = win_steps = 0
@@ -570,6 +619,8 @@ class Learner:
         return done_steps
 
     def close(self) -> None:
+        if self.obs is not None:
+            self.obs.close()
         self.metrics.close()
 
 

@@ -23,7 +23,10 @@ def histogram_scalars(prefix: str, edges, counts) -> Dict[str, float]:
     len(edges)+1 entries. Used for the replay reservoir's replayed-frame
     age histogram (dotaclient_tpu/replay/reservoir.py) — scalars per
     bucket keep the stream greppable and TB-plottable without a
-    histogram proto dependency."""
+    histogram proto dependency. Empty `edges` means there is no
+    bucketing to name — return {} rather than index edges[-1]."""
+    if not len(edges):
+        return {}
     out = {f"{prefix}_le_{edge}": float(counts[i]) for i, edge in enumerate(edges)}
     out[f"{prefix}_gt_{edges[-1]}"] = float(counts[len(edges)])
     return out
@@ -33,8 +36,14 @@ class MetricsLogger:
     def __init__(self, log_dir: str = "", flush_every: int = 20):
         self._tb = None
         self._jsonl = None
-        self._flush_every = flush_every
+        self._flush_every = max(int(flush_every), 1)
         self._writes = 0
+        self._closed = False
+        # Latest logged record, served by the obs /metrics scrape surface
+        # (obs/http.py): updated once per metrics window, never on the
+        # per-row hot path.
+        self._latest: Dict[str, float] = {}
+        self._latest_step = -1
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
             self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a", buffering=1)
@@ -46,24 +55,49 @@ class MetricsLogger:
                 self._tb = None
 
     def log(self, step: int, scalars: Dict[str, float]) -> None:
+        # Post-close logging is a no-op, not an IO error: phased drivers
+        # (and the learner's re-entrant run()) may race a final metrics
+        # window against teardown, and a closed JSONL handle must not
+        # turn a clean shutdown into a crash.
+        if self._closed:
+            return
+        clean = {k: float(v) for k, v in scalars.items()}
+        self._latest = clean
+        self._latest_step = step
         if self._jsonl is not None:
             rec = {"step": step, "time": time.time()}
-            rec.update({k: float(v) for k, v in scalars.items()})
+            rec.update(clean)
             self._jsonl.write(json.dumps(rec) + "\n")
         if self._tb is not None:
-            for k, v in scalars.items():
-                self._tb.add_scalar(k, float(v), step)
-            self._writes += 1
-            if self._writes % self._flush_every == 0:
-                self._tb.flush()
+            for k, v in clean.items():
+                self._tb.add_scalar(k, v, step)
+        # Flush pacing counts WRITES, uniformly: previously the counter
+        # only advanced when TB was importable, so the documented pacing
+        # was dead code on every headless host. JSONL is line-buffered,
+        # but an explicit periodic flush also covers exotic buffering
+        # (and keeps TB/JSONL on one cadence).
+        self._writes += 1
+        if self._writes % self._flush_every == 0:
+            self.flush()
+
+    def latest(self) -> Dict[str, float]:
+        """Most recent scalars handed to log() (empty before the first
+        window). Returns a copy — scrape threads must not alias the dict
+        the logging thread will replace."""
+        return dict(self._latest)
 
     def flush(self) -> None:
+        if self._closed:
+            return
         if self._tb is not None:
             self._tb.flush()
         if self._jsonl is not None:
             self._jsonl.flush()
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         if self._tb is not None:
             self._tb.flush()
             self._tb.close()

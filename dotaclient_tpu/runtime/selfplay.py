@@ -115,6 +115,11 @@ class SelfPlayActor:
         self.last_win: Optional[float] = None  # radiant (live) perspective
         self.last_heroes: list = []  # live side's pool draws, last episode
         self.last_weight_time = time.monotonic()  # kill-switch clock
+        # Same opt-in trace stamping as Actor (runtime/actor.py): None
+        # when --obs.enabled is off, and frames stay legacy DTR1.
+        from dotaclient_tpu.obs import ObsRuntime
+
+        self.obs = ObsRuntime.create(cfg.obs, role=f"selfplay{actor_id}")
         self.league: Optional[League] = None
         if cfg.opponent == "league":
             self.league = League(
@@ -169,6 +174,8 @@ class SelfPlayActor:
             win,
             self.cfg.policy.aux_heads,
         )
+        if self.obs is not None:
+            rollout = self.obs.stamp(rollout, self.actor_id)
         self.broker.publish_experience(serialize_rollout(rollout))
         self.rollouts_published += 1
         side.state, side.chunk = next_chunk(self.cfg.policy, side.state)

@@ -46,8 +46,14 @@ from dotaclient_tpu.config import LearnerConfig
 from dotaclient_tpu.ops.batch import BatchLayoutError, TrainBatch, zeros_train_batch
 
 _log = logging.getLogger(__name__)
+from dotaclient_tpu.obs.trace import TraceRef
 from dotaclient_tpu.transport.base import Broker
-from dotaclient_tpu.transport.serialize import Rollout, deserialize_rollout
+from dotaclient_tpu.transport.serialize import (
+    Rollout,
+    deserialize_rollout,
+    peek_rollout_trace,
+    strip_rollout_trace,
+)
 
 
 def fill_rollouts(batch: TrainBatch, rollouts: List[Rollout], seq_len: int) -> None:
@@ -143,10 +149,27 @@ class StagingBuffer:
         broker: Broker,
         version_fn: Callable[[], int] = lambda: 0,
         fused_io=None,
+        tracer=None,
+        recorder=None,
     ):
         self.cfg = cfg
         self.broker = broker
         self.version_fn = version_fn
+        # Pipeline observability (dotaclient_tpu/obs/), both optional:
+        # `tracer` records per-hop latency for trace-stamped frames,
+        # `recorder` receives pipeline events and dumps its ring on the
+        # fatal BatchLayoutError path. None (the default) keeps every
+        # pre-obs code path byte-for-byte: no per-row hop work, no
+        # parallel trace bookkeeping.
+        self._tracer = tracer
+        self._recorder = recorder
+        # Parallel to _pending, ONLY maintained when tracer is set: the
+        # TraceRef (or None) for each pending item, same single-writer
+        # discipline.
+        self._pending_traces: List = []
+        # Trace refs of the batch most recently returned by
+        # get_batch_groups (learner-thread-read; None when untraced).
+        self.last_batch_trace = None
         # Fused-H2D mode (parallel/fused_io.FusedBatchIO): the packer
         # fills leaf VIEWS of the dtype-grouped transfer buffers, so the
         # learner ships `groups` without a regroup copy. The caller must
@@ -245,11 +268,11 @@ class StagingBuffer:
                 if frames:
                     self._ingest(frames)
                 while not self._stop.is_set():
-                    items, staleness = self._next_batch_items(B)
+                    items, staleness, traces = self._next_batch_items(B)
                     if items is None:
                         break
                     try:
-                        batch_groups = self._pack(items)
+                        batch, groups = self._pack(items)
                     except BatchLayoutError:
                         # layout/config mismatch: fails every batch, not
                         # this batch — propagate to the fatal handler below
@@ -262,11 +285,11 @@ class StagingBuffer:
                             self._stats["dropped_bad"] += len(items)
                         continue
                     if staleness is not None:
-                        batch, groups = batch_groups
-                        batch_groups = (
-                            batch._replace(behavior_staleness=np.asarray(staleness, np.float32)),
-                            groups,
+                        batch = batch._replace(
+                            behavior_staleness=np.asarray(staleness, np.float32)
                         )
+                    if self._tracer is not None and traces is not None:
+                        self._tracer.hop_batch("pack", traces)
                     with self._stats_lock:
                         self._stats["batches"] += 1
                         self._stats["rows_packed"] += len(items)
@@ -274,7 +297,7 @@ class StagingBuffer:
                             self._stats["rows_replayed"] += sum(1 for s in staleness if s > 0)
                     while not self._stop.is_set():
                         try:
-                            self._ready.put(batch_groups, timeout=0.2)
+                            self._ready.put((batch, groups, traces), timeout=0.2)
                             break
                         except queue.Full:
                             continue
@@ -284,6 +307,12 @@ class StagingBuffer:
                 # getters re-raise _fatal so the failure is fast, not a
                 # silent per-batch dropped_bad starvation.
                 _log.critical("staging layout/config mismatch; consumer dying: %s", e)
+                if self._recorder is not None:
+                    # Soak/nightly BatchLayoutError deaths were
+                    # unreproducible — dump the recent pipeline events
+                    # (incl. the offending chunks' trace hops) before dying.
+                    self._recorder.record("batch_layout_error", error=str(e))
+                    self._recorder.dump("batch_layout_error")
                 self._fatal = e
                 self._stop.set()
                 raise
@@ -294,30 +323,54 @@ class StagingBuffer:
                 with self._stats_lock:
                     self._stats["consumer_errors"] += 1
 
+    def _take_pending(self, n: int):
+        """Pop the first n pending items (+ their trace refs when the
+        tracer maintains the parallel list)."""
+        items = self._pending[:n]
+        del self._pending[:n]
+        traces = None
+        if self._tracer is not None:
+            traces = self._pending_traces[:n]
+            del self._pending_traces[:n]
+        return items, traces
+
     def _next_batch_items(self, B: int):
-        """(items, staleness-list-or-None) for one batch, or (None, None)
-        when not enough material is pending. Replay mode fills up to
-        `replay.ratio` of the batch from the reservoir — never blocking
-        on it (a short reservoir just means more fresh rows) — and
-        stamps per-row behavior-policy staleness; fresh rows stamp 0."""
+        """(items, staleness-list-or-None, trace-refs-or-None) for one
+        batch, or (None, None, None) when not enough material is pending.
+        Replay mode fills up to `replay.ratio` of the batch from the
+        reservoir — never blocking on it (a short reservoir just means
+        more fresh rows) — and stamps per-row behavior-policy staleness;
+        fresh rows stamp 0."""
         if self._reservoir is None:
             if len(self._pending) < B:
-                return None, None
-            items = self._pending[:B]
-            del self._pending[:B]
-            return items, None
+                return None, None, None
+            items, traces = self._take_pending(B)
+            return items, None, traces
         now_v = self.version_fn()
         self._reservoir.expire(now_v)
         k = min(self._replay_target, self._reservoir.occupancy)
         if len(self._pending) < B - k:
-            return None, None
-        items = self._pending[: B - k]
-        del self._pending[: B - k]
+            return None, None, None
+        items, traces = self._take_pending(B - k)
         staleness = [0.0] * len(items)
-        for payload, version in self._reservoir.sample(k, now_v):
+        for payload, version, meta in self._reservoir.sample(k, now_v):
             items.append(payload)
             staleness.append(float(max(now_v - version, 0)))
-        return items, staleness
+            if self._tracer is not None:
+                ref = None
+                if meta is not None:
+                    # Fresh per-re-emit TraceRef COPY: a resident entry can
+                    # be sampled into several in-flight batches (classic
+                    # PER reuse, max_replays), and the learner thread hops
+                    # each batch's refs concurrently with this thread —
+                    # sharing one mutable ref would race on last_t and
+                    # corrupt the very histograms replay debugging needs.
+                    # The resident meta keeps its admit-time last_t, so
+                    # every re-emit measures time-in-reservoir.
+                    ref = TraceRef(meta.trace_id, meta.birth, last_t=meta.last_t)
+                    self._tracer.hop("replay_reemit", ref)
+                traces.append(ref)
+        return items, staleness, traces
 
     def _pack(self, items: List):
         """(TrainBatch, groups-or-None). Fused mode packs straight into
@@ -389,12 +442,16 @@ class StagingBuffer:
             last_done,
         )
 
-    def _offer_replay(self, item, frame: bytes, version: int, current_version: int) -> bool:
+    def _offer_replay(
+        self, item, frame: bytes, version: int, current_version: int, ref=None
+    ) -> bool:
         """Consumer-thread-only: admit one would-be-stale item into the
         reservoir. Priority is the PER |TD-error| proxy computed from the
         actor-stamped behavior values — the native path pays a full
         deserialize here, but only for frames that were pure waste
-        before, so any admitted frame is recovered value."""
+        before, so any admitted frame is recovered value. `ref` (the
+        chunk's TraceRef) rides the reservoir entry as opaque meta so a
+        later re-emit can keep the hop chain going."""
         try:
             rollout = item if isinstance(item, Rollout) else deserialize_rollout(frame)
         except (ValueError, KeyError):
@@ -404,7 +461,12 @@ class StagingBuffer:
         priority = td_error_priority(
             rollout.rewards, rollout.behavior_value, rollout.dones, self.cfg.ppo.gamma
         )
-        return self._reservoir.offer(item, version, priority, len(frame), current_version)
+        admitted = self._reservoir.offer(
+            item, version, priority, len(frame), current_version, meta=ref
+        )
+        if admitted and ref is not None and self._tracer is not None:
+            self._tracer.hop("replay_admit", ref)
+        return admitted
 
     def _ingest(self, frames: List[bytes]) -> None:
         version_now = self.version_fn()
@@ -414,7 +476,26 @@ class StagingBuffer:
         dropped_stale = dropped_bad = episodes = 0
         ep_ret = 0.0
         now = time.monotonic()
+        tr = self._tracer
+        # Rolling-upgrade intake for the native path: trace-stamped DTR2
+        # frames are normalized here to the byte-identical DTR1 layout
+        # the C packer speaks (transport.serialize.strip_rollout_trace),
+        # independent of whether THIS process traces — a consumer must
+        # parse every producer's frames mid-roll. An all-DTR1 drain (the
+        # default-off fleet) pays one 4-byte prefix check per frame and
+        # keeps the exact frame objects (no copies — asserted in
+        # tests/test_obs.py). The python fallback needs none of this:
+        # deserialize_rollout speaks both magics natively.
+        frame_traces: Optional[List] = None
         if self._lib is not None:
+            for i, f in enumerate(frames):
+                if f[:4] == b"DTR2":
+                    if tr is not None:
+                        if frame_traces is None:
+                            frame_traces = [None] * consumed
+                        tid, birth = peek_rollout_trace(f)
+                        frame_traces[i] = TraceRef(tid, birth)
+                    frames[i] = strip_rollout_trace(f)
             # ONE ctypes call parses/validates every frame of the drain
             # (the per-frame FFI loop cost 1.3ms/batch at 256 frames —
             # r5 profile); the python loop below then touches only plain
@@ -450,13 +531,23 @@ class StagingBuffer:
             if L > self.cfg.seq_len or frame_h != H:
                 dropped_bad += 1
                 continue
+            ref = None
+            if tr is not None:
+                if frame_traces is not None:
+                    ref = frame_traces[i]
+                elif isinstance(item, Rollout) and item.traced:
+                    # python fallback: the trace rode through deserialize
+                    ref = TraceRef(item.trace_id, item.birth_time)
+                if ref is not None:
+                    # covers serialize + broker queueing + the wire
+                    tr.hop("consume", ref)
             if version < min_version:
                 # Pre-replay behavior: pure waste (dropped_stale). With
                 # the reservoir on, near-stale frames are retained for
                 # off-policy reuse instead; the reservoir itself rejects
                 # anything past replay.max_staleness (still a stale drop).
                 if self._reservoir is not None and self._offer_replay(
-                    item, frames[i], version, version_now
+                    item, frames[i], version, version_now, ref=ref
                 ):
                     continue
                 dropped_stale += 1
@@ -465,6 +556,10 @@ class StagingBuffer:
                 episodes += 1
                 ep_ret += frame_ret
             self._pending.append(item)
+            if tr is not None:
+                if ref is not None:
+                    tr.hop("staging_admit", ref)
+                self._pending_traces.append(ref)
         with self._stats_lock:
             self._stats["consumed"] += consumed
             self._stats["dropped_stale"] += dropped_stale
@@ -512,11 +607,19 @@ class StagingBuffer:
         buffer dict when the buffer was built with fused_io, else None
         (caller falls back to io.pack). The batch's leaves are views into
         `groups`; consume before the next two batches overwrite nothing —
-        every batch allocates fresh buffers, so no aliasing hazard."""
+        every batch allocates fresh buffers, so no aliasing hazard.
+
+        Side channel: `self.last_batch_trace` is set to the returned
+        batch's trace refs (or None) — the learner records the h2d/apply
+        hops from it. Single-consumer by contract (only the learner loop
+        pops batches), so the attribute read is race-free."""
         try:
-            return self._get_ready(timeout)
+            batch, groups, traces = self._get_ready(timeout)
         except queue.Empty:
+            self.last_batch_trace = None
             return None, None
+        self.last_batch_trace = traces
+        return batch, groups
 
     def stats(self) -> Dict[str, float]:
         with self._stats_lock:

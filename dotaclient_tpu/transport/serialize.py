@@ -17,6 +17,22 @@ Rollout frame layout (little-endian):
   f32    episode_return (metrics only)
   then the arrays, in fixed order, raw bytes (shapes derivable from L/H).
 
+Traced rollout frame (DTR2, emitted ONLY for trace-stamped rollouts —
+the obs/ pipeline-tracing extension):
+  magic  b'DTR2'
+  then the DTR1 header fields unchanged (u32 version … f32 episode_return)
+  u64    trace_id   — pipeline trace id stamped by the publishing actor
+  f64    birth_time — time.time() at publish (e2e latency origin)
+  then the arrays, identical to DTR1.
+Rolling-upgrade contract, the publish_legacy_dtw1 precedent: compat is
+one-directional — NEW readers (deserialize_rollout, the staging intake's
+strip_rollout_trace normalization) accept BOTH magics, old readers
+reject DTR2. Tracing is therefore opt-in per actor (--obs.enabled) and
+default-off: with it off the frames are byte-identical DTR1, so a fleet
+rolls consumers first, then turns tracing on — exactly the DTW1→DTW2
+ordering. Golden bytes for both layouts are frozen in
+tests/test_transport.py.
+
 Weight frame layout (current, DTW2 — the authoritative spec any native
 or non-Python reader is written from; golden bytes frozen in
 tests/test_transport.py):
@@ -47,9 +63,12 @@ from dotaclient_tpu.env import featurizer as F
 from dotaclient_tpu.ops.action_dist import Action
 
 _ROLLOUT_MAGIC = b"DTR1"
+_ROLLOUT_MAGIC2 = b"DTR2"  # trace-extended (obs/): header + trace_id/birth
 _WEIGHTS_MAGIC = b"DTW1"  # legacy: no boot_epoch (read-compat only)
 _WEIGHTS_MAGIC2 = b"DTW2"
 _HDR = struct.Struct("<4sIHHBIf")
+# DTR2 = the DTR1 header + u64 trace_id + f64 birth_time, arrays unchanged.
+_HDR2 = struct.Struct("<4sIHHBIfQd")
 
 _FLAG_AUX = 1
 
@@ -78,10 +97,18 @@ class Rollout(NamedTuple):
     actor_id: int = 0
     episode_return: float = 0.0
     aux: Optional[RolloutAux] = None
+    # Pipeline-tracing extension (dotaclient_tpu/obs/): both zero means
+    # untraced — serialize_rollout then emits byte-identical legacy DTR1.
+    trace_id: int = 0
+    birth_time: float = 0.0
 
     @property
     def length(self) -> int:
         return int(self.rewards.shape[0])
+
+    @property
+    def traced(self) -> bool:
+        return bool(self.trace_id or self.birth_time)
 
 
 def _obs_arrays(obs: F.Observation) -> List[np.ndarray]:
@@ -99,7 +126,17 @@ def serialize_rollout(r: Rollout) -> bytes:
     L = r.length
     H = r.initial_state[0].shape[-1]
     flags = _FLAG_AUX if r.aux is not None else 0
-    parts = [_HDR.pack(_ROLLOUT_MAGIC, r.version, L, H, flags, r.actor_id, r.episode_return)]
+    if r.traced:
+        parts = [
+            _HDR2.pack(
+                _ROLLOUT_MAGIC2, r.version, L, H, flags, r.actor_id,
+                r.episode_return, r.trace_id, r.birth_time,
+            )
+        ]
+    else:
+        # Untraced rollouts stay byte-identical legacy DTR1 — old
+        # consumers keep parsing every frame a default-config actor emits.
+        parts = [_HDR.pack(_ROLLOUT_MAGIC, r.version, L, H, flags, r.actor_id, r.episode_return)]
     arrays = _obs_arrays(r.obs)
     arrays += [np.ascontiguousarray(a, np.int32) for a in r.actions]
     arrays += [
@@ -135,11 +172,56 @@ def _expected_layout(L: int, H: int, flags: int):
     return layout
 
 
-def deserialize_rollout(data: bytes) -> Rollout:
+def peek_rollout_trace(data: bytes) -> Tuple[int, float]:
+    """(trace_id, birth_time) of a DTR2 frame, (0, 0.0) for DTR1 or any
+    frame too short to carry the extension. Constant-time header peek —
+    no array parsing."""
+    if len(data) >= _HDR2.size and data[:4] == _ROLLOUT_MAGIC2:
+        trace_id, birth = struct.unpack_from("<Qd", data, _HDR.size)
+        return trace_id, birth
+    return 0, 0.0
+
+
+def strip_rollout_trace(data: bytes) -> bytes:
+    """DTR2 frame → the byte-identical DTR1 frame (trace extension
+    removed). DTR1 frames pass through untouched (same object, no copy).
+
+    This is the staging intake's rolling-upgrade normalization: the
+    native C packer (native/packer.cc) speaks exactly the DTR1 layout,
+    so traced frames are normalized once at ingest — paid only for
+    frames a producer chose to stamp, never on the legacy path."""
+    if len(data) >= _HDR2.size and data[:4] == _ROLLOUT_MAGIC2:
+        return _ROLLOUT_MAGIC + data[4:_HDR.size] + data[_HDR2.size:]
+    return data
+
+
+def stamp_rollout_trace(data: bytes, trace_id: int, birth_time: float) -> bytes:
+    """DTR1 frame → the DTR2 frame carrying the given trace extension.
+    Inverse of strip_rollout_trace, for producers that re-publish
+    already-serialized frames (bench.py's synthetic actors, tests) —
+    real actors stamp the Rollout before serializing instead."""
     if len(data) < _HDR.size or data[:4] != _ROLLOUT_MAGIC:
+        raise ValueError("can only stamp a DTR1 rollout frame")
+    return (
+        _ROLLOUT_MAGIC2
+        + data[4:_HDR.size]
+        + struct.pack("<Qd", trace_id, birth_time)
+        + data[_HDR.size:]
+    )
+
+
+def deserialize_rollout(data: bytes) -> Rollout:
+    trace_id, birth_time = 0, 0.0
+    if len(data) >= _HDR2.size and data[:4] == _ROLLOUT_MAGIC2:
+        magic, version, L, H, flags, actor_id, ep_ret, trace_id, birth_time = (
+            _HDR2.unpack_from(data)
+        )
+        off = _HDR2.size
+    elif len(data) >= _HDR.size and data[:4] == _ROLLOUT_MAGIC:
+        magic, version, L, H, flags, actor_id, ep_ret = _HDR.unpack_from(data)
+        off = _HDR.size
+    else:
         raise ValueError("bad rollout frame")
-    magic, version, L, H, flags, actor_id, ep_ret = _HDR.unpack_from(data)
-    off = _HDR.size
     arrays = []
     for shape, dtype in _expected_layout(L, H, flags):
         n = int(np.prod(shape)) * np.dtype(dtype).itemsize
@@ -170,6 +252,8 @@ def deserialize_rollout(data: bytes) -> Rollout:
         actor_id=actor_id,
         episode_return=ep_ret,
         aux=aux,
+        trace_id=trace_id,
+        birth_time=birth_time,
     )
 
 

@@ -1,0 +1,64 @@
+"""runtime/metrics.py unit coverage: histogram flattening edge cases and
+the MetricsLogger lifecycle (post-close logging, uniform flush pacing,
+latest-scalars snapshot for the obs scrape surface)."""
+
+import json
+
+from dotaclient_tpu.runtime.metrics import MetricsLogger, histogram_scalars
+
+
+def test_histogram_scalars_shape():
+    out = histogram_scalars("age", (4, 8), [1, 2, 3])
+    assert out == {"age_le_4": 1.0, "age_le_8": 2.0, "age_gt_8": 3.0}
+
+
+def test_histogram_scalars_empty_edges():
+    """Empty edges used to IndexError on edges[-1]; the contract is now
+    an empty dict (no buckets to name)."""
+    assert histogram_scalars("x", (), [5]) == {}
+    assert histogram_scalars("x", [], []) == {}
+
+
+def test_histogram_scalars_numpy_edges():
+    import numpy as np
+
+    out = histogram_scalars("h", np.array([2]), np.array([7, 9]))
+    assert out == {"h_le_2": 7.0, "h_gt_2": 9.0}
+    assert histogram_scalars("h", np.array([]), np.array([1])) == {}
+
+
+def test_logger_post_close_log_is_noop(tmp_path):
+    logger = MetricsLogger(str(tmp_path))
+    logger.log(1, {"a": 1.0})
+    logger.close()
+    logger.log(2, {"a": 2.0})  # must not raise on the closed handle
+    logger.flush()  # idem
+    logger.close()  # idempotent
+    lines = (tmp_path / "metrics.jsonl").read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["step"] == 1
+
+
+def test_logger_flush_pacing_uniform_without_tb(tmp_path):
+    """The pacing counter advances per log() call regardless of TB
+    availability (it was dead code on headless hosts), flushing every
+    flush_every writes."""
+    logger = MetricsLogger(str(tmp_path), flush_every=3)
+    flushes = []
+    logger.flush = lambda: flushes.append(1)  # count pacing-driven flushes
+    for step in range(7):
+        logger.log(step, {"v": float(step)})
+    assert logger._writes == 7
+    assert len(flushes) == 2  # at writes 3 and 6
+
+
+def test_logger_latest_snapshot_no_log_dir():
+    """latest() works (and log() is safe) with no sinks configured —
+    the obs scrape surface reads it even on log_dir=''."""
+    logger = MetricsLogger("")
+    assert logger.latest() == {}
+    logger.log(5, {"loss": 0.25, "entropy": 1})
+    got = logger.latest()
+    assert got == {"loss": 0.25, "entropy": 1.0}
+    got["loss"] = 99.0  # a copy: scrape threads can't mutate the source
+    assert logger.latest()["loss"] == 0.25
+    logger.close()

@@ -1,0 +1,427 @@
+"""dotaclient_tpu/obs/: pipeline tracing, flight recorder, scrape
+surface, and the metric-name drift guard (ISSUE 2).
+
+The zero-overhead-when-off contract is asserted directly: legacy frames
+pass staging untouched (same objects), batches keep their treedef, and
+no trace bookkeeping exists. Tests that bind ports or poll endpoints
+carry BOTH `slow` (tier-1 runs -m 'not slow') and stay out of nightly's
+way per the marker rules in pytest.ini.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import LearnerConfig, ObsConfig, PolicyConfig
+from dotaclient_tpu.obs import ObsRuntime
+from dotaclient_tpu.obs.flight_recorder import FlightRecorder
+from dotaclient_tpu.obs.http import MetricsHTTPServer, render_prometheus
+from dotaclient_tpu.obs.trace import PipelineTracer, TraceRef
+from dotaclient_tpu.runtime.staging import StagingBuffer
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect
+from dotaclient_tpu.transport.serialize import serialize_rollout, stamp_rollout_trace
+
+from tests.test_transport import make_rollout
+
+CFG = LearnerConfig(
+    batch_size=4,
+    seq_len=8,
+    policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16),
+)
+
+
+# --------------------------------------------------------------- tracer
+
+
+def test_tracer_histograms_and_e2e():
+    tr = PipelineTracer()
+    ref = TraceRef(trace_id=7, birth=100.0)
+    tr.hop("consume", ref, now=100.002)  # 2 ms → le_3 bucket
+    tr.hop("pack", ref, now=100.052)  # 50 ms → le_100 bucket
+    tr.e2e([ref], now=100.5)
+    sc = tr.scalars()
+    assert sc["trace_consume_ms_le_3"] == 1.0
+    assert sc["trace_consume_ms_le_1"] == 0.0
+    assert sc["trace_pack_ms_le_100"] == 1.0
+    assert abs(sc["trace_consume_mean_ms"] - 2.0) < 1e-6
+    assert abs(sc["trace_e2e_actor_apply_s"] - 0.5) < 1e-9
+    # open tail: a delta beyond the last edge lands in _gt_
+    tr.hop("h2d", TraceRef(1, 0.0, last_t=0.0), now=50.0)
+    assert tr.scalars()["trace_h2d_ms_gt_10000"] == 1.0
+
+
+def test_tracer_hop_batch_skips_untraced_rows():
+    tr = PipelineTracer()
+    refs = [TraceRef(1, 0.0, last_t=1.0), None, TraceRef(2, 0.0, last_t=1.0)]
+    tr.hop_batch("pack", refs, now=1.002)  # 2 ms, squarely in (1, 3]
+    assert tr.scalars()["trace_pack_ms_le_3"] == 2.0
+    tr.e2e([None], now=5.0)  # no birth: ignored, never a crash
+
+
+def test_tracer_mirrors_hops_into_recorder():
+    rec = FlightRecorder("t", ring_size=8)
+    tr = PipelineTracer(recorder=rec)
+    tr.hop("consume", TraceRef(trace_id=42, birth=1.0), now=1.25)
+    assert rec.events_recorded == 1
+    with_lock = list(rec._ring)
+    assert with_lock[0]["ev"] == "consume" and with_lock[0]["trace"] == 42
+
+
+# ------------------------------------------------------ flight recorder
+
+
+def test_flight_recorder_ring_bounded_and_dump(tmp_path):
+    rec = FlightRecorder("learner", ring_size=16, dump_dir=str(tmp_path))
+    for i in range(100):
+        rec.record("ev", seq=i)
+    path = rec.dump("test_reason")
+    assert path is not None
+    payload = json.loads(open(path).read())
+    assert payload["reason"] == "test_reason" and payload["role"] == "learner"
+    assert len(payload["events"]) == 16  # bounded ring kept the newest
+    assert payload["events"][-1]["seq"] == 99
+    assert payload["events_recorded"] == 100
+    # one artifact per distinct reason; a new reason dumps again
+    assert rec.dump("test_reason") is None
+    assert rec.dump("other_reason") is not None
+
+
+def test_flight_recorder_dump_dir_created(tmp_path):
+    rec = FlightRecorder("actor0", ring_size=4, dump_dir=str(tmp_path / "sub" / "dir"))
+    rec.record("x")
+    assert rec.dump("r") is not None
+
+
+def test_obs_runtime_disabled_is_none():
+    assert ObsRuntime.create(ObsConfig(enabled=False), role="x") is None
+
+
+def test_obs_runtime_stamp():
+    rt = ObsRuntime(ObsConfig(enabled=True), role="actor3")
+    r = rt.stamp(make_rollout(L=4, H=8), actor_id=3)
+    assert r.traced and (r.trace_id >> 32) == 3 and r.birth_time > 0
+    r2 = rt.stamp(make_rollout(L=4, H=8), actor_id=3)
+    assert r2.trace_id != r.trace_id  # per-process sequence advances
+    assert rt.recorder.events_recorded == 2  # publish events
+
+
+# ------------------------------------------- staging: off = zero overhead
+
+
+def test_staging_obs_off_legacy_frames_untouched():
+    """With obs off (no tracer), legacy DTR1 frames flow through ingest
+    as the EXACT same objects — no normalization copy, no parallel trace
+    bookkeeping, no batch trace side channel."""
+    mem.reset("obs_off")
+    buf = StagingBuffer(CFG, connect("mem://obs_off"))
+    frames = [serialize_rollout(make_rollout(L=4, H=8, version=0, seed=i)) for i in range(3)]
+    buf._ingest(list(frames))
+    if buf.native:
+        for pending, original in zip(buf._pending, frames):
+            assert pending is original  # identity: zero per-row copies
+    assert buf._pending_traces == []
+    assert buf.last_batch_trace is None
+
+
+def test_staging_obs_off_batch_treedef_unchanged():
+    """Batches produced with obs off keep the exact TrainBatch treedef of
+    a zeros_train_batch — the obs subsystem adds no leaves."""
+    import jax
+
+    from dotaclient_tpu.ops.batch import zeros_train_batch
+
+    mem.reset("obs_td")
+    broker = connect("mem://obs_td")
+    buf = StagingBuffer(CFG, connect("mem://obs_td")).start()
+    try:
+        for i in range(4):
+            broker.publish_experience(serialize_rollout(make_rollout(L=4, H=8, version=0, seed=i)))
+        batch = buf.get_batch(timeout=10)
+    finally:
+        buf.stop()
+    assert batch is not None
+    ref = zeros_train_batch(4, CFG.seq_len, 8, False)
+    ref = jax.tree.map(np.asarray, ref)
+    assert jax.tree_util.tree_structure(batch) == jax.tree_util.tree_structure(ref)
+
+
+def test_staging_obs_off_parses_dtr2_from_upgraded_producer():
+    """Rolling upgrade, consumer side: even with obs OFF the staging
+    intake must accept a trace-stamped (DTR2) frame from an upgraded
+    producer — normalized, packed, never dropped_bad."""
+    mem.reset("obs_mixed")
+    broker = connect("mem://obs_mixed")
+    buf = StagingBuffer(CFG, connect("mem://obs_mixed")).start()
+    try:
+        for i in range(4):
+            frame = serialize_rollout(make_rollout(L=4, H=8, version=0, seed=i))
+            if i % 2:
+                frame = stamp_rollout_trace(frame, i + 1, time.time())
+            broker.publish_experience(frame)
+        batch = buf.get_batch(timeout=10)
+        assert batch is not None
+        stats = buf.stats()
+        assert stats["dropped_bad"] == 0 and stats["rows_packed"] == 4
+    finally:
+        buf.stop()
+
+
+# --------------------------------------------- staging: on = hop chain
+
+
+@pytest.mark.parametrize("native_on", [True, False])
+def test_staging_traced_batch_hops(native_on):
+    # native_packer=False exercises the python fallback's trace intake
+    # (Rollout fields) vs the native path's header peek + strip
+    cfg = LearnerConfig(batch_size=4, seq_len=8, policy=CFG.policy,
+                        native_packer=native_on)
+    tracer = PipelineTracer()
+    name = f"obs_on_{int(native_on)}"
+    mem.reset(name)
+    broker = connect(f"mem://{name}")
+    buf = StagingBuffer(cfg, connect(f"mem://{name}"), tracer=tracer).start()
+    try:
+        for i in range(4):
+            frame = serialize_rollout(make_rollout(L=4, H=8, version=0, seed=i))
+            broker.publish_experience(stamp_rollout_trace(frame, 100 + i, time.time()))
+        batch, groups = buf.get_batch_groups(timeout=10)
+        assert batch is not None
+        trace = buf.last_batch_trace
+        assert trace is not None and sum(r is not None for r in trace) == 4
+        assert {r.trace_id for r in trace} == {100, 101, 102, 103}
+    finally:
+        buf.stop()
+    sc = tracer.scalars()
+    for stage in ("consume", "staging_admit", "pack"):
+        total = sum(v for k, v in sc.items()
+                    if k.startswith(f"trace_{stage}_ms_") and "_mean" not in k)
+        assert total == 4.0, (stage, sc)
+
+
+def test_replay_reemit_carries_trace():
+    """A traced chunk that ages into the reservoir keeps its TraceRef
+    (meta passthrough) and records replay_admit / replay_reemit hops on
+    the way back into a batch."""
+    from dotaclient_tpu.config import ReplayConfig
+
+    cfg = LearnerConfig(
+        batch_size=4,
+        seq_len=8,
+        policy=CFG.policy,
+        replay=ReplayConfig(enabled=True, ratio=0.25, max_staleness=32,
+                            spill_compress=False),
+    )
+    tracer = PipelineTracer()
+    version = [0]
+    mem.reset("obs_replay")
+    broker = connect("mem://obs_replay")
+    buf = StagingBuffer(cfg, connect("mem://obs_replay"),
+                        version_fn=lambda: version[0], tracer=tracer)
+    # one traced frame that is already past ppo.max_staleness (4) but
+    # inside replay.max_staleness (32) → reservoir admission
+    version[0] = 10
+    stale = stamp_rollout_trace(
+        serialize_rollout(make_rollout(L=4, H=8, version=2, seed=9)), 555, time.time()
+    )
+    buf._ingest([stale])
+    assert buf._reservoir.occupancy == 1
+    # three fresh frames → batch = 3 fresh + 1 replayed
+    fresh = [
+        stamp_rollout_trace(
+            serialize_rollout(make_rollout(L=4, H=8, version=10, seed=i)), 600 + i,
+            time.time(),
+        )
+        for i in range(3)
+    ]
+    buf._ingest(fresh)
+    items, staleness, traces = buf._next_batch_items(4)
+    assert items is not None and len(items) == 4
+    assert sum(1 for s in staleness if s > 0) == 1
+    assert traces[-1] is not None and traces[-1].trace_id == 555
+    sc = tracer.scalars()
+    assert sc["trace_replay_admit_mean_ms"] >= 0.0
+    assert sc["trace_replay_reemit_mean_ms"] >= 0.0
+
+
+def test_flight_recorder_dumps_on_batch_layout_error(tmp_path):
+    """The acceptance path: an induced BatchLayoutError kills the staging
+    consumer loudly AND leaves a flight-recorder JSON artifact holding
+    the offending chunks' trace events."""
+    from dotaclient_tpu.ops.batch import BatchLayoutError
+
+    rec = FlightRecorder("learner", ring_size=64, dump_dir=str(tmp_path))
+    tracer = PipelineTracer(recorder=rec)
+    mem.reset("obs_fatal")
+    broker = connect("mem://obs_fatal")
+    buf = StagingBuffer(CFG, connect("mem://obs_fatal"), tracer=tracer, recorder=rec)
+
+    def boom(items):
+        raise BatchLayoutError("induced template mismatch")
+
+    buf._pack = boom
+    buf.start()
+    try:
+        for i in range(4):
+            frame = serialize_rollout(make_rollout(L=4, H=8, version=0, seed=i))
+            broker.publish_experience(stamp_rollout_trace(frame, 900 + i, time.time()))
+        deadline = time.time() + 10
+        while buf._fatal is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert buf._fatal is not None
+        with pytest.raises(RuntimeError):
+            buf.get_batch(timeout=0.5)
+    finally:
+        buf.stop()
+    assert rec.last_dump_path is not None
+    payload = json.loads(open(rec.last_dump_path).read())
+    assert payload["reason"] == "batch_layout_error"
+    events = payload["events"]
+    assert any(e["ev"] == "batch_layout_error" for e in events)
+    # the offending chunks' trace events made it into the artifact
+    traced_ids = {e.get("trace") for e in events if e["ev"] in ("consume", "staging_admit")}
+    assert {900, 901, 902, 903} <= traced_ids
+
+
+# ------------------------------------------------------- drift guard
+
+
+def test_registry_unregistered_filter():
+    from dotaclient_tpu.obs import registry
+
+    assert registry.is_registered("loss")
+    assert registry.is_registered("replay_age_le_4")
+    assert registry.is_registered("trace_pack_ms_le_10")
+    assert registry.is_registered("ckpt_mirror_lag_steps")
+    assert not registry.is_registered("bogus_scalar")
+    assert registry.unregistered(["step", "time", "loss", "bogus_scalar"]) == ["bogus_scalar"]
+
+
+def test_emitted_scalars_are_registered(tmp_path):
+    """The drift guard (tier-1): drive a real closed-loop learner window
+    — staging, replay stats, obs trace scalars, device metrics — and
+    fail if ANY emitted scalar name is missing from obs/registry.py.
+    Renames must touch the registry (and the dashboards note) to land."""
+    from dotaclient_tpu.config import ReplayConfig
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.runtime.learner import Learner
+
+    mem.reset("obs_reg")
+    broker = connect("mem://obs_reg")
+    pol = PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="float32")
+    cfg = LearnerConfig(
+        batch_size=8,
+        seq_len=4,
+        policy=pol,
+        broker_url="mem://obs_reg",
+        log_dir=str(tmp_path),
+        metrics_every=1,
+        # replay forces the tree H2D path and emits the replay_* family
+        replay=ReplayConfig(enabled=True, ratio=0.25, max_staleness=32),
+        obs=ObsConfig(enabled=True, install_handlers=False),
+    )
+    learner = Learner(cfg, connect("mem://obs_reg"))
+    try:
+        for i in range(16):
+            frame = serialize_rollout(make_rollout(L=4, H=8, version=0, seed=i))
+            broker.publish_experience(stamp_rollout_trace(frame, i + 1, time.time()))
+        steps = learner.run(num_steps=2, batch_timeout=60.0, max_idle=3)
+    finally:
+        learner.close()
+    assert steps == 2
+    lines = (tmp_path / "metrics.jsonl").read_text().splitlines()
+    assert lines, "learner logged no metrics"
+    emitted = set()
+    for line in lines:
+        emitted.update(json.loads(line).keys())
+    assert "trace_e2e_actor_apply_s" in emitted  # tracing actually ran
+    missing = registry.unregistered(emitted)
+    assert not missing, (
+        f"scalars emitted but not documented in obs/registry.py: {missing} — "
+        f"register them (or fix the rename) so dashboards don't lose series"
+    )
+
+
+# --------------------------------------------------- scrape surface
+
+
+def test_render_prometheus_format():
+    text = render_prometheus({"loss": 0.5, "weird name!": 2.0, "nan_gauge": float("nan")})
+    lines = text.splitlines()
+    assert "# TYPE dotaclient_loss gauge" in lines
+    assert "dotaclient_loss 0.5" in lines
+    assert "dotaclient_weird_name_ 2" in lines
+    assert not any("nan" in ln for ln in lines)
+    # cumulative counters keep full precision (a %g render would round
+    # 1234567 and make rate() over the scrape produce artifacts)
+    assert "dotaclient_big 1234567" in render_prometheus({"big": 1234567.0})
+
+
+@pytest.mark.slow  # binds a port (ephemeral) + does a real HTTP roundtrip
+def test_metrics_endpoint_scrape():
+    latest = {"loss": 0.125, "env_steps_per_sec": 1000.0}
+    server = MetricsHTTPServer(0, sources=[lambda: dict(latest)]).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+        assert "dotaclient_loss 0.125" in body
+        assert "# TYPE dotaclient_env_steps_per_sec gauge" in body
+        latest["loss"] = 0.5  # live: the next scrape sees the new value
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+        assert "dotaclient_loss 0.5" in body
+        assert urllib.request.urlopen(f"{base}/healthz", timeout=10).read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/bogus", timeout=10)
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow  # binds a port; full learner loop behind it
+def test_learner_obs_end_to_end_scrape(tmp_path):
+    """Acceptance slice: traced frames through a real learner produce
+    per-stage latency scalars, and a /metrics scrape returns them (plus
+    the live obs gauges) in Prometheus text format."""
+    import socket
+
+    from dotaclient_tpu.runtime.learner import Learner
+
+    sock = socket.socket()
+    sock.bind(("", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    mem.reset("obs_e2e")
+    broker = connect("mem://obs_e2e")
+    pol = PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="float32")
+    cfg = LearnerConfig(
+        batch_size=8,
+        seq_len=4,
+        policy=pol,
+        broker_url="mem://obs_e2e",
+        log_dir=str(tmp_path),
+        metrics_every=1,
+        obs=ObsConfig(enabled=True, metrics_port=port, install_handlers=False),
+    )
+    learner = Learner(cfg, connect("mem://obs_e2e"))
+    try:
+        for i in range(24):
+            frame = serialize_rollout(make_rollout(L=4, H=8, version=0, seed=i))
+            broker.publish_experience(stamp_rollout_trace(frame, i + 1, time.time()))
+        steps = learner.run(num_steps=2, batch_timeout=60.0, max_idle=3)
+        assert steps == 2
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        # latest logged scalars (incl. per-stage trace latencies) ...
+        assert "dotaclient_trace_e2e_actor_apply_s" in body
+        assert "dotaclient_trace_pack_mean_ms" in body
+        assert "dotaclient_loss" in body
+        # ... plus live gauges sampled at scrape time
+        assert "dotaclient_obs_learner_version 2" in body
+        assert "dotaclient_obs_staging_rows_packed" in body
+        assert "dotaclient_obs_broker_experience_depth" in body
+    finally:
+        learner.close()

@@ -52,7 +52,7 @@ def test_reservoir_priority_eviction_order():
         res.offer(bytes([i]) * 100, version=50, priority=pri, nbytes=100, current_version=50)
     assert res.occupancy == 2  # third offer pushed over budget → one evicted
     assert res.stats()["evicted"] == 1
-    kept = {p[0] for p, _ in (res.sample(2, 50))}
+    kept = {p[0] for p, _, _ in (res.sample(2, 50))}
     assert kept == {0, 2}  # the pri=0.1 entry is gone
 
 
@@ -68,7 +68,7 @@ def test_reservoir_age_decays_priority():
     res.offer(b"new" * 40, version=39, priority=1.0, nbytes=100, current_version=40)
     res.offer(b"mid" * 40, version=30, priority=1.0, nbytes=100, current_version=40)
     assert res.occupancy == 2
-    kept = {p for p, _ in res.sample(2, 40)}
+    kept = {p for p, _, _ in res.sample(2, 40)}
     assert b"old" * 40 not in kept
 
 
@@ -113,7 +113,7 @@ def test_reservoir_spill_round_trip_rollout():
     assert s["spilled_entries"] == 1
     assert s["bytes_spilled"] == len(raw)
     assert res.occupancy_bytes < len(raw)  # actually smaller in store
-    (got, version), = res.sample(1, 8)
+    (got, version, _), = res.sample(1, 8)
     assert version == 7
     np.testing.assert_array_equal(got.rewards, r0.rewards)
     np.testing.assert_array_equal(got.obs.unit_feats, r0.obs.unit_feats)

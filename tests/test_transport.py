@@ -14,8 +14,11 @@ from dotaclient_tpu.transport.serialize import (
     deserialize_rollout,
     deserialize_weights,
     flatten_params,
+    peek_rollout_trace,
     serialize_rollout,
     serialize_weights,
+    stamp_rollout_trace,
+    strip_rollout_trace,
     unflatten_params,
 )
 from dotaclient_tpu.transport.tcp import BrokerServer, TcpBroker
@@ -84,6 +87,140 @@ def test_rollout_rejects_garbage():
         deserialize_rollout(good[: len(good) // 2])
     with pytest.raises(ValueError):
         deserialize_rollout(good + b"x")
+
+
+# --- rollout-frame golden bytes: DTR1 / DTR2 rolling upgrade ------------
+#
+# serialize.py's module docstring is the wire SPEC; these freeze the
+# rollout layouts the same way the DTW goldens below freeze the weight
+# layouts. The frames are ~2.5 KB (featurizer-schema arrays), so the
+# array tail is pinned by sha256 and the header — the layout-bearing
+# part — by exact hex.
+#
+# DTR1 header: 44545231   magic b'DTR1'
+#              07000000   u32 version=7
+#              0100 0200  u16 L=1, u16 H=2
+#              00         u8 flags=0
+#              0b000000   u32 actor_id=11
+#              0000a03f   f32 episode_return=1.25
+ROLLOUT_DTR1_HEADER_HEX = "445452310700000001000200000b0000000000a03f"
+ROLLOUT_DTR1_SHA256 = "7ae3c118d28965b3caed639768188b0d4ac05ee30ab2b8bce5009c7df4d9b183"
+# DTR2 = the same header under magic b'DTR2', then the trace extension:
+#              0df0fecaefbeadde   u64 trace_id=0xDEADBEEFCAFEF00D
+#              00000060b813da41   f64 birth_time=1.75e9
+# then the arrays, byte-identical to DTR1.
+ROLLOUT_DTR2_HEADER_HEX = (
+    "445452320700000001000200000b0000000000a03f0df0fecaefbeadde00000060b813da41"
+)
+ROLLOUT_DTR2_SHA256 = "f1d0c9d4e45fb1127d9f3ac4848de136e3f34406088d03dcb7751585a70f6498"
+
+GOLDEN_TRACE_ID = 0xDEADBEEFCAFEF00D
+GOLDEN_BIRTH = 1.75e9
+
+
+def make_golden_rollout():
+    """Fully deterministic rollout (arange/constant arrays, no RNG) so
+    the frozen hashes are reproducible everywhere."""
+    L, H = 1, 2
+    T1 = L + 1
+
+    def ar(shape, dtype, scale=0.125):
+        n = int(np.prod(shape))
+        return (np.arange(n, dtype=np.float64) * scale).astype(dtype).reshape(shape)
+
+    obs = F.Observation(
+        global_feats=ar((T1, F.GLOBAL_FEATURES), np.float32),
+        hero_feats=ar((T1, F.HERO_FEATURES), np.float32),
+        unit_feats=ar((T1, F.MAX_UNITS, F.UNIT_FEATURES), np.float32),
+        unit_mask=(np.arange(T1 * F.MAX_UNITS).reshape(T1, F.MAX_UNITS) % 2).astype(bool),
+        target_mask=(np.arange(T1 * F.MAX_UNITS).reshape(T1, F.MAX_UNITS) % 3 == 0),
+        action_mask=np.ones((T1, F.N_ACTION_TYPES), bool),
+    )
+    return Rollout(
+        obs=obs,
+        actions=Action(
+            type=np.array([1], np.int32),
+            move_x=np.array([2], np.int32),
+            move_y=np.array([3], np.int32),
+            target=np.array([4], np.int32),
+        ),
+        behavior_logp=np.array([-1.5], np.float32),
+        behavior_value=np.array([0.25], np.float32),
+        rewards=np.array([0.5], np.float32),
+        dones=np.array([1.0], np.float32),
+        initial_state=(np.array([0.1, 0.2], np.float32), np.array([0.3, 0.4], np.float32)),
+        version=7,
+        actor_id=11,
+        episode_return=1.25,
+    )
+
+
+def test_rollout_frame_golden_bytes_dtr1():
+    """An UNTRACED rollout serializes to byte-identical legacy DTR1 —
+    the 'new producer, obs off → old consumer' leg of the rolling
+    upgrade: a default-config actor's frames never change."""
+    import hashlib
+
+    data = serialize_rollout(make_golden_rollout())
+    assert data[:21].hex() == ROLLOUT_DTR1_HEADER_HEX
+    assert hashlib.sha256(data).hexdigest() == ROLLOUT_DTR1_SHA256
+
+
+def test_rollout_frame_golden_bytes_dtr2():
+    """The trace-extended frame: frozen header + tail, and the stamped
+    frame is exactly stamp_rollout_trace(DTR1 frame)."""
+    import hashlib
+
+    r = make_golden_rollout()._replace(trace_id=GOLDEN_TRACE_ID, birth_time=GOLDEN_BIRTH)
+    data = serialize_rollout(r)
+    assert data[:37].hex() == ROLLOUT_DTR2_HEADER_HEX
+    assert hashlib.sha256(data).hexdigest() == ROLLOUT_DTR2_SHA256
+    assert data == stamp_rollout_trace(serialize_rollout(make_golden_rollout()),
+                                       GOLDEN_TRACE_ID, GOLDEN_BIRTH)
+
+
+def test_rollout_rolling_upgrade_both_directions():
+    """old producer → new consumer: a plain DTR1 frame decodes with zero
+    trace fields. new producer → old consumer: strip_rollout_trace
+    recovers the byte-identical DTR1 frame an old parser (python or the
+    native C packer) speaks — the staging intake's normalization."""
+    plain = serialize_rollout(make_golden_rollout())
+    r_old = deserialize_rollout(plain)  # old producer, new consumer
+    assert r_old.trace_id == 0 and r_old.birth_time == 0.0 and not r_old.traced
+    traced = stamp_rollout_trace(plain, GOLDEN_TRACE_ID, GOLDEN_BIRTH)
+    r_new = deserialize_rollout(traced)  # new producer, new consumer
+    assert r_new.trace_id == GOLDEN_TRACE_ID and r_new.birth_time == GOLDEN_BIRTH
+    np.testing.assert_array_equal(r_new.rewards, r_old.rewards)
+    # new producer → old consumer, via the intake normalization
+    assert strip_rollout_trace(traced) == plain
+    assert strip_rollout_trace(plain) is plain  # legacy frames: no copy
+    assert peek_rollout_trace(traced) == (GOLDEN_TRACE_ID, GOLDEN_BIRTH)
+    assert peek_rollout_trace(plain) == (0, 0.0)
+
+
+def test_rollout_trace_survives_reserialize():
+    """deserialize → serialize round-trips the trace extension (the
+    replay reservoir's python-path spill encode/decode)."""
+    traced = serialize_rollout(
+        make_golden_rollout()._replace(trace_id=5, birth_time=2.5)
+    )
+    assert serialize_rollout(deserialize_rollout(traced)) == traced
+
+
+def test_native_packer_rejects_dtr2_but_accepts_stripped():
+    """The native C header parser is the in-repo stand-in for an OLD
+    consumer: it must reject the extended frame outright (never
+    misparse it), and accept the stripped normalization."""
+    from dotaclient_tpu import native
+
+    lib = native.load_packer()
+    if lib is None:
+        pytest.skip("native packer unavailable")
+    plain = serialize_rollout(make_golden_rollout())
+    traced = stamp_rollout_trace(plain, 1, 1.0)
+    assert native.frame_header(lib, traced) is None
+    hdr = native.frame_header(lib, strip_rollout_trace(traced))
+    assert hdr is not None and hdr[0] == 7 and hdr[1] == 1
 
 
 # --- weight-frame golden bytes (VERDICT r4 item 5) ----------------------
