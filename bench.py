@@ -508,6 +508,47 @@ def main() -> None:
         if t_staging is not None:
             t_staging.stop()
 
+    # --- compute decomposition (obs/compute.py, ISSUE 3): fenced
+    # per-phase timing of the SAME compiled step plus the recompile
+    # sentinel — OUTSIDE the timed headline window, because the fencing
+    # deliberately destroys the prefetch overlap the headline measures.
+    # recompiles MUST read 0 here (one steady batch shape feeds the
+    # section); a nonzero count means the bench itself has a shape bug.
+    compute_section = None
+    try:
+        from dotaclient_tpu.obs.compute import RecompileSentinel, StepPhaseTimer
+
+        sentinel = RecompileSentinel(train_step, label="bench_train_step")
+        ph = StepPhaseTimer()
+        for _ in range(4):
+            t0p = time.perf_counter()
+            groups_p = io.pack(host_batch)
+            t1p = time.perf_counter()
+            ph.add("pack", t1p - t0p)
+            dev_p = jax.device_put(groups_p, io.shardings)
+            jax.block_until_ready(dev_p)
+            t2p = time.perf_counter()
+            ph.add("h2d", t2p - t1p)
+            state, metrics = sentinel(state, dev_p)
+            jax.block_until_ready(metrics["loss"])
+            t3p = time.perf_counter()
+            ph.add("device_step", t3p - t2p)
+            ph.step(t3p - t0p)
+        sc = ph.window_scalars()
+        compute_section = {
+            "phase_pack_s": round(sc["compute_phase_pack_s"], 5),
+            "phase_h2d_s": round(sc["compute_phase_h2d_s"], 5),
+            "phase_device_step_s": round(sc["compute_phase_device_step_s"], 5),
+            "phase_wall_s": round(sc["compute_phase_wall_s"], 5),
+            "recompiles": sentinel.recompiles,
+            "first_call_s": round(sentinel.last_compile_s, 4),
+            "note": "fenced per-phase split outside the headline window; "
+            "fetch/host are learner-loop phases a pre-packed bench batch "
+            "does not exercise",
+        }
+    except Exception as e:
+        compute_section = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # --- transfer-layout A/B (informational, best-effort): the same
     # batch bytes H2D as 17 pytree leaves vs 4 dtype groups vs ONE
     # concatenated byte buffer. On the tunneled chip the per-transfer RPC
@@ -638,6 +679,9 @@ def main() -> None:
         # mean ms per pipeline hop from the traced section (obs/trace.py
         # hop chain: consume → staging_admit → pack → h2d → apply) + e2e
         "trace_stage_breakdown": trace_breakdown,
+        # fenced pack/h2d/device-step split + recompile sentinel count
+        # from the post-headline compute section (obs/compute.py)
+        "compute_breakdown": compute_section,
     }
     if e2e_single is not None:
         out["e2e_single_buffer_steps_per_sec"] = round(e2e_single, 1)

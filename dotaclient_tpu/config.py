@@ -148,6 +148,55 @@ class ReplayConfig:
 
 
 @dataclass
+class WatchdogConfig:
+    """Learner liveness watchdog (dotaclient_tpu/obs/watchdog.py): a
+    side thread that reads MetricsLogger.latest() + live gauges and
+    escalates on stall / input starvation / NaN loss / steps/s
+    regression: log -> flight-recorder dump -> flip /healthz to 503 (so
+    a k8s liveness probe restarts the pod). Default OFF; requires
+    obs.enabled."""
+
+    enabled: bool = False
+    # Seconds between checks (also the granularity of every window below).
+    interval_s: float = 5.0
+    # STALL: no learner-version advance for this many seconds. Must
+    # comfortably exceed a worst-case batch wait + checkpoint write.
+    stall_s: float = 120.0
+    # Until the FIRST version advance the stall threshold is
+    # max(stall_s, boot_grace_s): cold start legitimately spends minutes
+    # in compile + checkpoint restore + waiting for the first published
+    # rollouts, and a 120s stall_s would trip /healthz into a liveness
+    # restart that replays the identical slow boot — an unbounded
+    # crashloop. 600s covers multihost cluster formation with margin.
+    boot_grace_s: float = 600.0
+    # STARVATION: fraction of recent step wall time spent in the fetch
+    # phase (compute_phase_fetch_frac) above this for consecutive checks.
+    # 0 disables — the DEFAULT, deliberately: starvation is usually an
+    # UPSTREAM failure (actors dead, fleet undersized) and restarting the
+    # learner adds no actors; a single-actor smoke trips it instantly.
+    # Opt in where a restart genuinely helps (wedged broker consumer) —
+    # the k8s manifests set 0.95 against a sized actor fleet. Needs obs
+    # step phases (the scalar it reads), so it is inert when
+    # step_phases is off.
+    starvation_frac: float = 0.0
+    # NaN/inf guard on the latest logged `loss`. On by default when the
+    # watchdog is on: a NaN loss never self-heals, restart is correct.
+    nan_check: bool = True
+    # REGRESSION: current env_steps_per_sec below this fraction of the
+    # trailing-window median. 0 disables (CI smokes and phased drivers
+    # have legitimately spiky rates).
+    regression_frac: float = 0.0
+    # Trailing window (number of metric samples) the regression baseline
+    # is computed over.
+    window: int = 12
+    # Consecutive failing checks before each escalation stage: strike 1
+    # logs, strike `dump_after` dumps the flight recorder, strike
+    # `trip_after` flips /healthz to 503.
+    dump_after: int = 2
+    trip_after: int = 3
+
+
+@dataclass
 class ObsConfig:
     """Pipeline observability (dotaclient_tpu/obs/): rollout tracing,
     flight recorder, and the /metrics scrape endpoint. Default OFF with
@@ -173,6 +222,24 @@ class ObsConfig:
     # default when obs is enabled; off for embedders (tests, drivers)
     # that own their signal handling.
     install_handlers: bool = True
+    # Learner step-phase decomposition (obs/compute.py StepPhaseTimer):
+    # fetch/pack/h2d/device_step/host wall time per iteration, logged as
+    # compute_phase_* scalars. COSTS THE PIPELINE OVERLAP: the loop
+    # fences the device (block_until_ready) once per step so each phase
+    # is causally attributable — exactly the round-3 overlap the normal
+    # loop exists to avoid. On by default under obs.enabled because a
+    # deploy that opted into observability wants the decomposition; set
+    # false to keep tracing/scrape at full pipelined speed.
+    step_phases: bool = True
+    # Where POST /profile?seconds=N captures land (jax.profiler.trace
+    # TensorBoard dirs). "" = dump_dir (or cwd). Replaces the deprecated
+    # learner profile_port always-on server.
+    profile_dir: str = ""
+    # Hard cap on a single on-demand profile capture; /profile clamps to
+    # this (an unbounded capture would fill the pod disk).
+    profile_max_seconds: float = 60.0
+    # Liveness watchdog (obs/watchdog.py) — learner only.
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
 
 
 @dataclass

@@ -11,7 +11,13 @@ is the measurement layer:
                       actor→apply scalar that decomposes staleness;
 - obs/flight_recorder bounded ring of recent pipeline events, dumped to
                       JSON on crash / BatchLayoutError / SIGTERM;
-- obs/http            stdlib-only Prometheus-text /metrics endpoint;
+- obs/http            stdlib-only Prometheus-text /metrics endpoint,
+                      structured /healthz (503 when the watchdog trips),
+                      POST /profile on-demand trace capture;
+- obs/compute         learner compute decomposition: step-phase timer,
+                      recompile sentinel, MFU accounting, ProfileCapture;
+- obs/watchdog        liveness thread (stall/starvation/NaN/regression →
+                      log → dump → 503) behind --obs.watchdog.*;
 - obs/registry        the documented scalar-name contract + drift guard.
 
 Everything is opt-in via --obs.* and default-off with zero hot-path
@@ -34,18 +40,23 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from dotaclient_tpu.config import ObsConfig
+from dotaclient_tpu.obs.compute import ComputeObserver, ProfileCapture
 from dotaclient_tpu.obs.flight_recorder import FlightRecorder
 from dotaclient_tpu.obs.http import MetricsHTTPServer
 from dotaclient_tpu.obs.trace import LATENCY_EDGES_MS, STAGES, PipelineTracer, TraceRef
+from dotaclient_tpu.obs.watchdog import Watchdog
 
 __all__ = [
     "LATENCY_EDGES_MS",
     "STAGES",
+    "ComputeObserver",
     "FlightRecorder",
     "MetricsHTTPServer",
     "ObsRuntime",
     "PipelineTracer",
+    "ProfileCapture",
     "TraceRef",
+    "Watchdog",
 ]
 
 
@@ -61,6 +72,9 @@ class ObsRuntime:
         )
         self.tracer = PipelineTracer(recorder=self.recorder)
         self.server: Optional[MetricsHTTPServer] = None
+        self.compute: Optional[ComputeObserver] = None
+        self.watchdog: Optional[Watchdog] = None
+        self.profiler: Optional[ProfileCapture] = None
         self._trace_seq = 0
 
     @classmethod
@@ -89,21 +103,72 @@ class ObsRuntime:
         self.recorder.record("publish", t=birth, trace=trace_id, actor=actor_id)
         return rollout._replace(trace_id=trace_id, birth_time=birth)
 
+    # ----------------------------------------------------------- compute
+
+    def attach_compute(
+        self, flops_per_step: float, peak_flops: Optional[float]
+    ) -> ComputeObserver:
+        """Build the learner's compute bundle (obs/compute.py): phase
+        timer (when cfg.step_phases), recompile sentinel factory, MFU
+        accounting — all sharing this runtime's flight recorder."""
+        self.compute = ComputeObserver(
+            flops_per_step,
+            peak_flops,
+            recorder=self.recorder,
+            step_phases=self.cfg.step_phases,
+        )
+        return self.compute
+
+    def attach_watchdog(self, latest_fn, version_fn) -> Optional[Watchdog]:
+        """Build + start the liveness watchdog when cfg.watchdog.enabled;
+        its verdict feeds the /healthz provider and its scalars the
+        scrape surface."""
+        if not self.cfg.watchdog.enabled:
+            return None
+        self.watchdog = Watchdog(
+            self.cfg.watchdog, latest_fn, version_fn, recorder=self.recorder
+        ).start()
+        return self.watchdog
+
     # ------------------------------------------------------------ scrape
 
     def serve_metrics(
-        self, sources: List[Callable[[], Dict[str, float]]]
+        self,
+        sources: List[Callable[[], Dict[str, float]]],
+        health_provider: Optional[Callable[[], Dict]] = None,
     ) -> Optional[MetricsHTTPServer]:
         """Start the /metrics endpoint when cfg.metrics_port is set (> 0).
-        Adds the tracer's scalars as an implicit source."""
+        Adds the tracer's scalars as an implicit source, the watchdog's
+        gauges when one is attached, and wires /healthz + POST /profile
+        (a ProfileCapture is built lazily here — the capture dir falls
+        back dump_dir → cwd)."""
         if self.cfg.metrics_port <= 0:
             return None
+        sources = list(sources) + [self.tracer.scalars]
+        # Late-bound: a watchdog attached AFTER the server starts (no
+        # ordering contract on callers) still appears on the scrape.
+        sources.append(
+            lambda: self.watchdog.scalars() if self.watchdog is not None else {}
+        )
+        if self.profiler is None:
+            self.profiler = ProfileCapture(
+                self.cfg.profile_dir or self.cfg.dump_dir,
+                max_seconds=self.cfg.profile_max_seconds,
+            )
         self.server = MetricsHTTPServer(
-            self.cfg.metrics_port, sources + [self.tracer.scalars]
+            self.cfg.metrics_port,
+            sources,
+            health_provider=health_provider,
+            # capture() returns (path, clamped-window) atomically — the
+            # obs/http.py handler echoes the window actually traced
+            profile_handler=self.profiler.capture,
         ).start()
         return self.server
 
     def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
         if self.server is not None:
             self.server.stop()
             self.server = None

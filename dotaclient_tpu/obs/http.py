@@ -1,10 +1,23 @@
-"""Scrape surface: stdlib-only HTTP /metrics in Prometheus text format.
+"""Scrape surface: stdlib-only HTTP /metrics in Prometheus text format,
+a structured /healthz, and on-demand POST /profile capture.
 
 The k8s deploy had no way to scrape the learner — MetricsLogger writes
 local JSONL/TB only. This serves the latest logged scalars plus live
 gauges (broker queue depth, staging occupancy, replay reservoir stats)
 over plain http.server: no prometheus_client dependency (the container
 constraint), no new threadpools beyond one daemon serving thread.
+
+/healthz returns a JSON body from the optional `health_provider` —
+{"ok": bool, ...} with HTTP 200 when ok and 503 when not (the k8s
+liveness-probe contract: probes key on the status code, humans read the
+body's watchdog verdict). With no provider it is a plain 200 {"ok":
+true} — a serving process is the only health there is to report.
+
+POST /profile?seconds=N runs the optional `profile_handler(seconds)`
+(obs/compute.py ProfileCapture → jax.profiler.trace) and returns the
+trace-dir path as JSON; 409 while a capture is in flight, 404 when no
+handler is wired. The handler blocks ITS request thread for the window
+(ThreadingHTTPServer: scrapes keep flowing meanwhile).
 
 Exposition rules (the subset of the Prometheus text format scrapers
 need): one `# TYPE <name> gauge` line then `<name> <value>` per metric,
@@ -20,12 +33,18 @@ whole endpoint down with it).
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+# compute.py is import-light at module level (jax only inside functions),
+# so this does not drag an accelerator runtime into the HTTP module.
+from dotaclient_tpu.obs.compute import CaptureBusyError
 
 _log = logging.getLogger(__name__)
 
@@ -58,18 +77,48 @@ def render_prometheus(scalars: Dict[str, float], prefix: str = "dotaclient_") ->
 
 
 class MetricsHTTPServer:
-    """One daemon thread serving GET /metrics (and /healthz) until
-    stop(). Sources are sampled per scrape; port=0 binds an ephemeral
-    port (tests), read back via `.port`."""
+    """One daemon thread serving GET /metrics + GET /healthz (+ POST
+    /profile when a handler is wired) until stop(). Sources are sampled
+    per scrape; port=0 binds an ephemeral port (tests), read back via
+    `.port`.
 
-    def __init__(self, port: int, sources: Optional[List[Callable[[], Dict[str, float]]]] = None):
+    `health_provider` is a zero-arg callable returning a JSON-able dict;
+    its "ok" key (default True) selects 200 vs 503. `profile_handler`
+    takes seconds and returns the capture path — or (path, seconds) to
+    report the window it ACTUALLY traced after clamping; it may raise —
+    the exception type name "CaptureBusyError" maps to 409, anything
+    else to 500."""
+
+    def __init__(
+        self,
+        port: int,
+        sources: Optional[List[Callable[[], Dict[str, float]]]] = None,
+        health_provider: Optional[Callable[[], Dict]] = None,
+        profile_handler: Optional[Callable[[float], str]] = None,
+    ):
         self._sources: List[Callable[[], Dict[str, float]]] = list(sources or [])
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._requested_port = port
+        self.health_provider = health_provider
+        self.profile_handler = profile_handler
 
     def add_source(self, source: Callable[[], Dict[str, float]]) -> None:
         self._sources.append(source)
+
+    def health(self) -> Dict:
+        """The /healthz body: provider's dict, or the serving-only
+        default. A provider that throws reads as unhealthy — a broken
+        health source must fail the probe, not mask it."""
+        if self.health_provider is None:
+            return {"ok": True}
+        try:
+            body = dict(self.health_provider())
+        except Exception as e:
+            _log.exception("health provider failed")
+            return {"ok": False, "error": f"health provider failed: {type(e).__name__}"}
+        body.setdefault("ok", True)
+        return body
 
     def collect(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -88,21 +137,65 @@ class MetricsHTTPServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path.split("?", 1)[0] not in ("/metrics", "/healthz"):
-                    self.send_error(404)
-                    return
-                if self.path.startswith("/healthz"):
-                    body = b"ok\n"
-                else:
-                    body = render_prometheus(server.collect()).encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-                )
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _reply_json(self, code: int, payload: Dict) -> None:
+                self._reply(
+                    code, (json.dumps(payload) + "\n").encode(), "application/json"
+                )
+
+            def do_GET(self):
+                route = self.path.split("?", 1)[0]
+                if route == "/metrics":
+                    self._reply(
+                        200,
+                        render_prometheus(server.collect()).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif route == "/healthz":
+                    body = server.health()
+                    self._reply_json(200 if body.get("ok", True) else 503, body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                if parsed.path != "/profile":
+                    self.send_error(404)
+                    return
+                if server.profile_handler is None:
+                    self._reply_json(
+                        404, {"error": "no profiler wired (obs profile capture is learner-only)"}
+                    )
+                    return
+                try:
+                    seconds = float(parse_qs(parsed.query).get("seconds", ["5"])[0])
+                except ValueError:
+                    seconds = math.nan  # "nan"/"inf" parse as floats; unify below
+                if not math.isfinite(seconds):
+                    self._reply_json(400, {"error": "seconds must be a finite number"})
+                    return
+                try:
+                    path = server.profile_handler(seconds)
+                except Exception as e:
+                    busy = isinstance(e, CaptureBusyError)
+                    if not busy:
+                        _log.exception("profile capture failed")
+                    self._reply_json(
+                        409 if busy else 500, {"error": f"{type(e).__name__}: {e}"}
+                    )
+                    return
+                # Echo what was actually traced: a (path, seconds) handler
+                # reports its clamped window — echoing the raw request
+                # would misdescribe the artifact.
+                if isinstance(path, tuple):
+                    path, seconds = path
+                self._reply_json(200, {"trace_dir": path, "seconds": seconds})
 
             def log_message(self, fmt, *args):  # scrape spam stays out of stderr
                 pass

@@ -61,6 +61,25 @@ SCALARS: Dict[str, str] = {
     "skill": "conservative TrueSkill estimate (mu - 3 sigma)",
     # --- obs (dotaclient_tpu/obs/trace.py) -----------------------------
     "trace_e2e_actor_apply_s": "mean actor-publish → train-step-apply latency",
+    # --- obs compute (dotaclient_tpu/obs/compute.py) -------------------
+    "compute_phase_fetch_s": "mean per-step host wait for a packed batch",
+    "compute_phase_pack_s": "mean per-step io.pack fallback time (≈0 on the fused path)",
+    "compute_phase_h2d_s": "mean per-step fenced host→device transfer time",
+    "compute_phase_device_step_s": "mean per-step fenced device train-step time",
+    "compute_phase_host_s": "mean per-step publish/checkpoint/metrics host work",
+    "compute_phase_wall_s": "mean loop-iteration wall time (phases sum to ≈ this)",
+    "compute_phase_fetch_frac": "fetch share of step wall (watchdog starvation signal)",
+    "compute_recompiles_total": "train-step signatures beyond the first (MUST stay 0 steady-state)",
+    "compute_compiles_total": "train-step compiles including the first",
+    "compute_compile_s": "cumulative train-step compile wall seconds",
+    "compute_last_compile_s": "wall seconds of the most recent compile",
+    "compute_flops_per_sec": "achieved model FLOP/s (ops/flops.py analytic count)",
+    "compute_mfu": "cumulative model-FLOPs utilization vs platform peak (TPU only)",
+    # --- obs watchdog (dotaclient_tpu/obs/watchdog.py) -----------------
+    "watchdog_ok": "1 while /healthz serves 200, 0 once tripped",
+    "watchdog_strikes": "consecutive failing checks (escalation ladder position)",
+    "watchdog_trips_total": "times the watchdog flipped /healthz to 503",
+    "watchdog_checks_total": "watchdog checks executed",
 }
 
 # Documented dynamic families (prefix → meaning of the family).

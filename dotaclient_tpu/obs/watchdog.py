@@ -1,0 +1,226 @@
+"""Acting watchdog: turns the learner's own telemetry into liveness.
+
+The failure modes this catches all share one trait: the process stays
+up, so nothing restarts it, and the cluster silently stops learning —
+a stalled train loop (wedged device, deadlocked collective), input
+starvation (actors dead, broker partitioned), a NaN'd loss (never
+self-heals; every later step is wasted), and a quiet steps/s collapse.
+
+The watchdog is a side thread reading MetricsLogger.latest() plus the
+live version counter — data the learner already produces; it adds ZERO
+work to the loop. On a failing check it escalates by consecutive
+strikes:
+
+  strike 1                log a warning (grep-able, alert-able)
+  strike cfg.dump_after   dump the flight recorder (evidence before the
+                          pod dies — the dump is the artifact a human
+                          reads after the restart)
+  strike cfg.trip_after   trip: /healthz flips to 503, and the k8s
+                          liveness probe restarts the pod
+
+A healthy check clears the strikes AND the trip — if the condition
+self-heals before the probe's failureThreshold, the pod lives. All
+thresholds under --obs.watchdog.*, default off.
+
+Testability: check() is a plain method driven by an injectable
+monotonic clock; the background thread is just `while not
+stop.wait(interval): check()`. Tests drive check() directly with a fake
+clock — no sleeps in tier-1.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from dotaclient_tpu.config import WatchdogConfig
+
+_log = logging.getLogger(__name__)
+
+
+class Watchdog:
+    def __init__(
+        self,
+        cfg: WatchdogConfig,
+        latest_fn: Callable[[], Dict[str, float]],
+        version_fn: Callable[[], int],
+        recorder=None,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg
+        self._latest = latest_fn
+        self._version = version_fn
+        self._recorder = recorder
+        self._now = time_fn
+        self._lock = threading.Lock()
+        t = self._now()
+        self._start_t = t
+        self._last_version = int(version_fn())
+        self._last_advance_t = t
+        self._booted = False  # flips on the first observed version advance
+        # (version, rate) samples for the regression baseline; appended
+        # only when the version advanced so one metrics window never
+        # floods the window with duplicates.
+        self._rates: deque = deque(maxlen=max(int(cfg.window), 1))
+        self._last_rate_version = self._last_version
+        self.strikes = 0
+        self.tripped = False
+        self.trips_total = 0
+        self.checks_done = 0
+        self.reasons: List[str] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ checks
+
+    def _failures(self) -> List[str]:
+        cfg = self.cfg
+        now = self._now()
+        fails: List[str] = []
+        try:
+            latest = self._latest()
+        except Exception:
+            latest = {}
+
+        # STALL — the version counter is the loop's heartbeat. Before the
+        # first advance the threshold is the (larger) boot grace: compile
+        # + restore + first-batch wait must not read as a stall, or the
+        # liveness restart replays the same slow boot forever.
+        v = int(self._version())
+        stall_s = cfg.stall_s if self._booted else max(cfg.stall_s, cfg.boot_grace_s)
+        if v != self._last_version:
+            self._last_version = v
+            self._last_advance_t = now
+            self._booted = True
+        elif now - self._last_advance_t > stall_s:
+            fails.append(
+                f"stall: version {v} unchanged for "
+                f"{now - self._last_advance_t:.0f}s (> {stall_s:.0f}s"
+                f"{'' if self._booted else ', boot grace'})"
+            )
+
+        # NaN/inf loss — never self-heals; restart is the cure.
+        if cfg.nan_check:
+            loss = latest.get("loss")
+            if loss is not None and not math.isfinite(float(loss)):
+                fails.append(f"nan_loss: latest loss is {loss!r}")
+
+        # STARVATION — fetch-phase fraction from the StepPhaseTimer
+        # scalars (inert unless obs.step_phases produced them).
+        if cfg.starvation_frac > 0:
+            frac = latest.get("compute_phase_fetch_frac")
+            if frac is not None and float(frac) > cfg.starvation_frac:
+                fails.append(
+                    f"starvation: fetch phase {float(frac):.0%} of step wall "
+                    f"(> {cfg.starvation_frac:.0%})"
+                )
+
+        # REGRESSION — current steps/s vs the trailing-window median.
+        if cfg.regression_frac > 0:
+            rate = latest.get("env_steps_per_sec")
+            if rate is not None:
+                rate = float(rate)
+                if len(self._rates) == self._rates.maxlen:
+                    baseline = statistics.median(self._rates)
+                    if baseline > 0 and rate < cfg.regression_frac * baseline:
+                        fails.append(
+                            f"regression: {rate:.1f} env-steps/s < "
+                            f"{cfg.regression_frac:.2f} x trailing median {baseline:.1f}"
+                        )
+                if v != self._last_rate_version:
+                    self._rates.append(rate)
+                    self._last_rate_version = v
+        return fails
+
+    def check(self) -> Dict:
+        """Run every detector once; escalate or clear. Returns verdict().
+        Never raises — a watchdog that dies IS the failure mode it
+        exists to catch, so detector errors log and count as healthy."""
+        try:
+            fails = self._failures()
+        except Exception:
+            _log.exception("watchdog check failed; treating as healthy")
+            fails = []
+        with self._lock:
+            self.checks_done += 1
+            if not fails:
+                if self.tripped:
+                    _log.warning("watchdog recovered; /healthz back to 200")
+                self.strikes = 0
+                self.reasons = []
+                self.tripped = False
+                return self._verdict_locked()
+            self.strikes += 1
+            self.reasons = fails
+            strikes = self.strikes
+        # Escalation I/O outside the lock: dump() can hit a slow disk and
+        # verdict()/healthz readers must never block behind it.
+        _log.warning("watchdog strike %d: %s", strikes, "; ".join(fails))
+        if strikes == self.cfg.dump_after and self._recorder is not None:
+            self._recorder.record("watchdog", strikes=strikes, reasons=fails)
+            self._recorder.dump("watchdog", once=False)
+        if strikes >= self.cfg.trip_after:
+            with self._lock:
+                if not self.tripped:
+                    self.tripped = True
+                    self.trips_total += 1
+                    _log.error(
+                        "watchdog TRIPPED after %d strikes (%s); /healthz -> 503",
+                        strikes,
+                        "; ".join(fails),
+                    )
+        return self.verdict()
+
+    # ----------------------------------------------------------- surface
+
+    def _verdict_locked(self) -> Dict:
+        return {
+            "enabled": True,
+            "ok": not self.tripped,
+            "tripped": self.tripped,
+            "strikes": self.strikes,
+            "reasons": list(self.reasons),
+            "trips_total": self.trips_total,
+            "checks_done": self.checks_done,
+            "uptime_s": round(self._now() - self._start_t, 1),
+        }
+
+    def verdict(self) -> Dict:
+        with self._lock:
+            return self._verdict_locked()
+
+    def scalars(self) -> Dict[str, float]:
+        """The watchdog_* gauge family for the scrape surface."""
+        with self._lock:
+            return {
+                "watchdog_ok": 0.0 if self.tripped else 1.0,
+                "watchdog_strikes": float(self.strikes),
+                "watchdog_trips_total": float(self.trips_total),
+                "watchdog_checks_total": float(self.checks_done),
+            }
+
+    # ------------------------------------------------------------ thread
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.cfg.interval_s):
+                self.check()
+
+        self._thread = threading.Thread(target=_run, daemon=True, name="obs-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

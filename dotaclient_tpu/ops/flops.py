@@ -108,3 +108,17 @@ def peak_flops_for(device_str: str) -> float | None:
         if key in s:
             return peak
     return None
+
+
+def aggregate_peak_flops(devices) -> float | None:
+    """Total peak FLOP/s over a device list — the MFU denominator for a
+    program spanning all of them (obs/compute.py MfuAccountant, bench).
+    None when any device has no table entry (CPU smoke, unknown TPU gen):
+    partial-fleet MFU would overstate utilization, so report none."""
+    total = 0.0
+    for d in devices:
+        peak = peak_flops_for(str(d))
+        if peak is None:
+            return None
+        total += peak
+    return total or None

@@ -368,6 +368,19 @@ def build_single_train_step(cfg: LearnerConfig, mesh):
     return _build_fused(cfg, mesh, single=True)
 
 
+def jit_cache_size(jitted) -> int:
+    """Compiled-executable count of a jitted callable — XLA's own ground
+    truth for 'how many programs has this step become', which the
+    recompile sentinel (obs/compute.py) cross-checks its aval-hash count
+    against in tests. Owned here next to the jits it describes. Returns
+    -1 when this jax doesn't expose the private probe (the sentinel then
+    stands alone — degraded, not broken)."""
+    try:
+        return int(jitted._cache_size())
+    except Exception:
+        return -1
+
+
 def _batch_template(cfg: LearnerConfig):
     """A TrainBatch-shaped pytree for sharding derivation. With replay
     enabled the batch carries the [B] behavior_staleness stamp, so the

@@ -318,16 +318,44 @@ class Learner:
             legacy_dtw1=cfg.publish_legacy_dtw1,
         )
         self.metrics = MetricsLogger(cfg.log_dir)
+        self._boot_monotonic = time.monotonic()
         if self.obs is not None:
+            # Compute observability (obs/compute.py): the train step gets
+            # the recompile sentinel (aval-signature hash + compile wall
+            # + shape-diff to the flight recorder), MFU accounting gets
+            # the analytic FLOPs model against the platform peak table,
+            # and — when cfg.obs.step_phases — the loop runs phase-fenced
+            # (run() below). With obs off, self.train_step stays the raw
+            # jit object: byte-identical hot path, asserted in test_obs.
+            from dotaclient_tpu.ops.flops import aggregate_peak_flops, train_step_flops
+
+            compute = self.obs.attach_compute(
+                train_step_flops(cfg), aggregate_peak_flops(jax.devices())
+            )
+            self.train_step = compute.wrap_train_step(self.train_step)
+            # Liveness watchdog (obs/watchdog.py, --obs.watchdog.*): reads
+            # the telemetry the loop already produces; trips /healthz.
+            self.obs.attach_watchdog(self.metrics.latest, lambda: self.version)
             # Scrape surface (obs/http.py): the latest logged scalars plus
             # live gauges sampled per scrape — queue depth straight from
             # the broker, staging/replay occupancy from stats(). Runs for
             # the process lifetime (run() is re-entrant); close() stops it.
-            self.obs.serve_metrics([self.metrics.latest, self._obs_gauges])
+            # /healthz serves the structured health body (503 once the
+            # watchdog trips — the k8s liveness-probe contract) and POST
+            # /profile captures on-demand jax.profiler traces.
+            self.obs.serve_metrics(
+                [self.metrics.latest, self._obs_gauges], health_provider=self._health
+            )
         self.env_steps_done = 0  # total real (unmasked) env steps trained on
         if cfg.profile_port:
-            # device-trace endpoint (SURVEY.md §5 tracing note): attach
-            # TensorBoard's profiler or jax.profiler.trace to this port
+            # DEPRECATED (MIGRATION.md): the always-on profiler server is
+            # superseded by on-demand POST /profile?seconds=N on the obs
+            # metrics port, which needs no TensorBoard round-trip to
+            # start a capture. Kept functional for one deprecation cycle.
+            _log.warning(
+                "--profile_port is deprecated; use POST /profile?seconds=N on "
+                "the obs metrics port (--obs.metrics_port) instead"
+            )
             jax.profiler.start_server(cfg.profile_port)
         self.checkpointer = None
         if cfg.checkpoint_dir:
@@ -380,6 +408,23 @@ class Learner:
             out[f"obs_staging_{k}"] = float(v)
         return out
 
+    def _health(self):
+        """The /healthz body (obs/http.py contract: "ok" selects the
+        status code). A learner without a watchdog is healthy by virtue
+        of serving; with one, the watchdog verdict decides."""
+        wd = (
+            self.obs.watchdog.verdict()
+            if self.obs is not None and self.obs.watchdog is not None
+            else {"enabled": False, "ok": True}
+        )
+        return {
+            "ok": bool(wd.get("ok", True)),
+            "role": "learner",
+            "version": int(self.version),
+            "uptime_s": round(time.monotonic() - self._boot_monotonic, 1),
+            "watchdog": wd,
+        }
+
     def publish_weights(self) -> None:
         if not self._primary:
             return  # one fanout per version — process 0 publishes
@@ -413,9 +458,12 @@ class Learner:
         fallback pays io.pack here (still charged to wait_s, never to
         put_s — that bucket is the pure H2D transfer).
         """
+        timer = self.obs.compute.timer if self.obs is not None and self.obs.compute else None
         t0 = time.perf_counter()
         batch, groups = self.staging.get_batch_groups(timeout=batch_timeout)
         t1 = time.perf_counter()
+        if timer is not None:
+            timer.add("fetch", t1 - t0)
         if batch is None:
             return None, 0, t1 - t0, 0.0, None
         trace = self.staging.last_batch_trace
@@ -430,6 +478,8 @@ class Learner:
             if groups is None:
                 groups = self.fused_io.pack_transfer(batch)
             t2 = time.perf_counter()
+            if timer is not None:
+                timer.add("pack", t2 - t1)
             shardings = self.fused_io.transfer_shardings()
             if self._n_proc > 1:
                 # Each process contributes its local rows; the result is
@@ -442,6 +492,10 @@ class Learner:
                 )
             else:
                 batch_dev = jax.device_put(groups, shardings)
+            if timer is not None:
+                # Fence: the phase is the real transfer, not its dispatch.
+                jax.block_until_ready(batch_dev)
+                timer.add("h2d", time.perf_counter() - t2)
             if self.obs is not None and trace is not None:
                 self.obs.tracer.hop_batch("h2d", trace)
             return batch_dev, env_steps, t2 - t0, time.perf_counter() - t2, trace
@@ -453,6 +507,9 @@ class Learner:
             )
         else:
             batch_dev = jax.device_put(batch, self.batch_sharding)
+        if timer is not None:
+            jax.block_until_ready(batch_dev)
+            timer.add("h2d", time.perf_counter() - t1)
         if self.obs is not None and trace is not None:
             self.obs.tracer.hop_batch("h2d", trace)
         return batch_dev, env_steps, t1 - t0, time.perf_counter() - t1, trace
@@ -478,6 +535,12 @@ class Learner:
         cfg = self.cfg
         self.staging.start()
         self.publisher.start()
+        # Step-phase decomposition (obs/compute.py): when the timer
+        # exists the loop FENCES the device once per step so each phase
+        # is causally attributable — trading the round-3 prefetch overlap
+        # for legibility. timer=None keeps the pipelined shape untouched.
+        compute = self.obs.compute if self.obs is not None else None
+        timer = compute.timer if compute is not None else None
         done_steps = 0
         # per-window accumulators, reset at every metrics log
         win_wait = win_put = 0.0
@@ -524,8 +587,15 @@ class Learner:
                     continue
                 idle = 0
                 batch_dev, env_steps, batch_trace = next_batch, next_env_steps, next_trace
+                t_pass = time.perf_counter()
                 # Async dispatch: returns immediately, device runs the step.
                 self.state, metrics = self.train_step(self.state, batch_dev)
+                if timer is not None:
+                    # Fence: device_step is dispatch + execution wall. The
+                    # prefetch below then runs AFTER the device finished —
+                    # the overlap cost the step_phases flag documents.
+                    jax.block_until_ready(metrics)
+                    timer.add("device_step", time.perf_counter() - t_pass)
                 if self.obs is not None and batch_trace is not None:
                     # Terminal hops at DISPATCH (the loop's only routine
                     # sync is the metrics fetch): per-stage apply delta +
@@ -550,6 +620,7 @@ class Learner:
                 else:
                     next_batch, next_env_steps, next_trace = None, 0, None
 
+                t_host = time.perf_counter()
                 if self.version % cfg.publish_every == 0 and self._primary:
                     # One async on-device flatten dispatch; the blocking
                     # host read of the single buffer happens on the
@@ -563,6 +634,17 @@ class Learner:
                     )
                 if self.checkpointer is not None and self.version % cfg.checkpoint_every == 0:
                     self.checkpoint()
+
+                if timer is not None:
+                    # Close the pass BEFORE a possible metrics window so
+                    # window_scalars only ever aggregates fully-closed
+                    # passes (a half-recorded pass would make the phase
+                    # sum drift from the wall). The metrics sync/log below
+                    # is the observer's own cost and stays outside the
+                    # decomposition by design.
+                    t_end = time.perf_counter()
+                    timer.add("host", t_end - t_host)
+                    timer.step(t_end - t_pass)
 
                 if self.version % cfg.metrics_every == 0 or last:
                     # The ONLY routine device sync in the loop.
@@ -604,6 +686,12 @@ class Learner:
                         # actor→apply decomposition (obs/trace.py). Empty
                         # until traced frames flow (actors opted in).
                         scalars.update(self.obs.tracer.scalars())
+                    if compute is not None:
+                        # compute_* families (obs/compute.py): phase means
+                        # over this window (every pass fully closed — see
+                        # the timer close above), cumulative recompile
+                        # counters, cumulative MFU.
+                        scalars.update(compute.window_scalars(win_steps, dt))
                     self.metrics.log(self.version, scalars)
                     win_wait = win_put = 0.0
                     win_env_steps = win_steps = 0

@@ -125,6 +125,9 @@ class TestRope:
         assert np.isfinite(np.asarray(A.rope(x, pos))).all()
 
 
+@pytest.mark.skipif(
+    not RA.SHARD_MAP_AVAILABLE, reason="this jax has no shard_map (any location)"
+)
 class TestRingAttention:
     @pytest.fixture(scope="class")
     def sp_mesh(self):
@@ -138,6 +141,7 @@ class TestRingAttention:
         full = A.causal_attention(q, k, v, pos, pos)
         np.testing.assert_allclose(ring, full, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # shard_map VJP compile (~8s) — default gate only
     def test_gradients_match_single_device(self, sp_mesh):
         B, T, N, Dh = 1, 16, 2, 4
         q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (23, 24, 25))
@@ -183,11 +187,15 @@ class TestRingAttention:
         np.testing.assert_allclose(via_ring, via_full, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.skipif(
+    not RA.SHARD_MAP_AVAILABLE, reason="this jax has no shard_map (any location)"
+)
 class TestUlyssesAttention:
     @pytest.fixture(scope="class")
     def sp_mesh(self):
         return mesh_lib.make_mesh("sp=8")
 
+    @pytest.mark.slow  # all-to-all shard_map compile — default gate only
     def test_matches_single_device(self, sp_mesh):
         B, T, N, Dh = 2, 32, 8, 8  # heads divisible by sp=8
         q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (40, 41, 42))
@@ -198,6 +206,8 @@ class TestUlyssesAttention:
 
     @pytest.mark.nightly  # ring grads cover the default gate; this is the
     # ulysses-specific backward (compile-heavy shard_map VJP)
+    @pytest.mark.slow  # nightly-heavy must ALSO be slow: tier-1's
+    # -m 'not slow' REPLACES the addopts nightly exclusion
     def test_gradients_match_single_device(self, sp_mesh):
         B, T, N, Dh = 1, 16, 8, 4
         q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (43, 44, 45))
@@ -215,6 +225,7 @@ class TestUlyssesAttention:
         for gu, gf in zip(g_uly, g_full):
             np.testing.assert_allclose(gu, gf, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # two shard_map compiles in one test — default gate only
     def test_matches_ring(self, sp_mesh):
         """Both SP patterns compute the same function."""
         B, T, N, Dh = 2, 16, 8, 4
@@ -230,6 +241,8 @@ class TestUlyssesAttention:
         with pytest.raises(ValueError, match="heads"):
             RA.ulysses_causal_attention(q, q, q, pos, pos, sp_mesh)
 
+    @pytest.mark.slow  # ulysses compile — dispatch plumbing is covered by
+    # TestRingAttention::test_dispatch_helper in tier-1
     def test_dispatch_mode(self, sp_mesh):
         B, T, N, Dh = 1, 16, 8, 4
         q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (50, 51, 52))
@@ -252,6 +265,7 @@ class TestBlockwiseAttention:
         want = A.causal_attention(q, k, v, pos, pos)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # blockwise VJP compile — default gate only
     def test_gradients_match_dense(self):
         B, T, N, Dh = 1, 24, 2, 4
         q, k, v = (jnp.asarray(_rand((B, T, N, Dh), s)) for s in (63, 64, 65))
@@ -278,6 +292,10 @@ class TestBlockwiseAttention:
 
 @pytest.mark.nightly  # blockwise-vs-dense parity is covered in the default
 # gate at the op level (TestBlockwiseAttention); this is the ulysses composition
+@pytest.mark.slow  # nightly-heavy must ALSO be slow (tier-1 -m override)
+@pytest.mark.skipif(
+    not RA.SHARD_MAP_AVAILABLE, reason="this jax has no shard_map (any location)"
+)
 def test_ulysses_blockwise_matches_dense():
     """kv_block threading through the ulysses path changes memory only."""
     mesh = mesh_lib.make_mesh("sp=8")

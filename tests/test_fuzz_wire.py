@@ -17,6 +17,10 @@ import struct
 
 import numpy as np
 import pytest
+
+# Gate, don't die: an image without hypothesis must skip this file
+# cleanly, not error the whole collection (the container-deps rule).
+pytest.importorskip("hypothesis")
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 from dotaclient_tpu import native

@@ -170,6 +170,29 @@ def test_staging_obs_off_parses_dtr2_from_upgraded_producer():
         buf.stop()
 
 
+def test_learner_obs_off_train_step_not_wrapped():
+    """Zero-overhead-off, compute edition (PR 3): with obs disabled the
+    Learner's train_step is the raw jit callable — no RecompileSentinel
+    in the call path, no StepPhaseTimer fencing branch objects — and the
+    loop's `timer` binding resolves to None (byte-identical hot path)."""
+    from dotaclient_tpu.obs.compute import RecompileSentinel
+    from dotaclient_tpu.runtime.learner import Learner
+
+    mem.reset("obs_off_learner")
+    cfg = LearnerConfig(
+        batch_size=8,  # divisible by the 8-virtual-device dp mesh
+        seq_len=4,
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="float32"),
+        broker_url="mem://obs_off_learner",
+    )
+    learner = Learner(cfg, connect("mem://obs_off_learner"))
+    assert learner.obs is None
+    assert not isinstance(learner.train_step, RecompileSentinel)
+    # the jit object itself: callable with a lower() (duck-typed check —
+    # a wrapper would not expose jax's AOT surface)
+    assert hasattr(learner.train_step, "lower")
+
+
 # --------------------------------------------- staging: on = hop chain
 
 
@@ -338,11 +361,35 @@ def test_emitted_scalars_are_registered(tmp_path):
     for line in lines:
         emitted.update(json.loads(line).keys())
     assert "trace_e2e_actor_apply_s" in emitted  # tracing actually ran
+    # PR 3: the compute decomposition rides the same stream — prove it
+    # actually emitted (phases, sentinel counters) so the drift guard
+    # covers the compute_* family, not just tolerates its absence.
+    for name in (
+        "compute_phase_fetch_s",
+        "compute_phase_device_step_s",
+        "compute_phase_wall_s",
+        "compute_recompiles_total",
+        "compute_flops_per_sec",
+    ):
+        assert name in emitted, f"compute observability did not emit {name}"
     missing = registry.unregistered(emitted)
     assert not missing, (
         f"scalars emitted but not documented in obs/registry.py: {missing} — "
         f"register them (or fix the rename) so dashboards don't lose series"
     )
+
+
+def test_watchdog_scalars_are_registered():
+    """The watchdog_* family is scrape-only (it never passes through
+    MetricsLogger, so the JSONL drift guard above can't see it) — pin
+    its names against the registry directly."""
+    from dotaclient_tpu.config import WatchdogConfig
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.obs.watchdog import Watchdog
+
+    wd = Watchdog(WatchdogConfig(enabled=True), latest_fn=dict, version_fn=lambda: 0)
+    missing = registry.unregistered(wd.scalars().keys())
+    assert not missing, f"watchdog scalars not in obs/registry.py: {missing}"
 
 
 # --------------------------------------------------- scrape surface
@@ -372,7 +419,9 @@ def test_metrics_endpoint_scrape():
         latest["loss"] = 0.5  # live: the next scrape sees the new value
         body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
         assert "dotaclient_loss 0.5" in body
-        assert urllib.request.urlopen(f"{base}/healthz", timeout=10).read() == b"ok\n"
+        # /healthz is structured JSON now (PR 3); no provider = serving-only
+        health = json.loads(urllib.request.urlopen(f"{base}/healthz", timeout=10).read())
+        assert health == {"ok": True}
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(f"{base}/bogus", timeout=10)
     finally:

@@ -13,6 +13,7 @@ from dotaclient_tpu.config import ActorConfig, LearnerConfig, PolicyConfig
 from dotaclient_tpu.env import featurizer as F
 from dotaclient_tpu.models import policy as P
 from dotaclient_tpu.models.transformer_policy import KVCache
+from dotaclient_tpu.ops import ring_attention
 from dotaclient_tpu.parallel import mesh as mesh_lib
 from dotaclient_tpu.parallel.train_step import (
     build_train_step,
@@ -50,6 +51,9 @@ def net_and_params():
     return net, params
 
 
+@pytest.mark.slow  # ~25s of transformer unroll/step compiles — the family
+# ran ZERO tests in tier-1 before PR 3 (shard_map collection error), so the
+# default gate owns these; tier-1 keeps the cheap state/reject/actor tests
 class TestStepUnrollEquivalence:
     def test_kv_cache_step_matches_unroll(self, net_and_params):
         """T KV-cache steps must reproduce the teacher-forced unroll —
@@ -186,7 +190,11 @@ def _run_one_step(cfg, seed=0):
     return {k: float(v) for k, v in jax.device_get(metrics).items()}
 
 
+@pytest.mark.skipif(
+    not ring_attention.SHARD_MAP_AVAILABLE, reason="this jax has no shard_map (any location)"
+)
 class TestSequenceParallelTrainStep:
+    @pytest.mark.slow  # two full train-step compiles — default gate only
     def test_sp_matches_dp_only(self):
         """dp=2×sp=4 (ring attention, time-sharded obs) must produce the
         same loss/grad-norm as dp=8 with local attention."""
@@ -200,6 +208,7 @@ class TestSequenceParallelTrainStep:
         with pytest.raises(ValueError, match="seq_len"):
             build_train_step(cfg, mesh_lib.make_mesh(cfg.mesh_shape))
 
+    @pytest.mark.slow  # sp train-step compile + 20 stepped iterations
     def test_transformer_trains_on_fixed_batch(self):
         """20 repeated steps on one batch: the loss must fall — the
         family is actually optimizable, not just shape-correct."""
@@ -263,6 +272,7 @@ class TestActorIntegration:
 
 
 class TestRemat:
+    @pytest.mark.slow  # two train-step compiles — default gate only
     def test_remat_identical_loss_and_grads(self):
         """tf_remat must change memory behavior only: loss and gradients
         bit-compare against the stored-activation path."""
@@ -276,6 +286,10 @@ class TestRemat:
 
     @pytest.mark.nightly  # remat bit-parity is in the default gate; this
     # is the remat x sp composition (second big compile)
+    @pytest.mark.slow  # nightly-heavy must ALSO be slow (tier-1 -m override)
+    @pytest.mark.skipif(
+        not ring_attention.SHARD_MAP_AVAILABLE, reason="this jax has no shard_map"
+    )
     def test_remat_composes_with_sequence_parallelism(self):
         cfg = _tf_learner_cfg("dp=2,sp=4", "sp")
         cfg.policy.tf_remat = True
@@ -285,9 +299,13 @@ class TestRemat:
             assert m[k] == pytest.approx(ref[k], rel=1e-4, abs=1e-5), k
 
 
+@pytest.mark.skipif(
+    not ring_attention.SHARD_MAP_AVAILABLE, reason="this jax has no shard_map (any location)"
+)
 class TestUlyssesTrainStep:
     @pytest.mark.nightly  # ring train-step parity guards the default gate;
     # ulysses parity at op level is default too — this is the composition
+    @pytest.mark.slow  # nightly-heavy must ALSO be slow (tier-1 -m override)
     def test_ulysses_sp_matches_dp_only(self):
         """Full PPO step with all-to-all sequence parallelism == local
         attention (same batch, same init)."""
@@ -313,6 +331,7 @@ def test_ulysses_misconfig_rejected_at_build_time():
         build_train_step(cfg, mesh_lib.make_mesh(cfg.mesh_shape))
 
 
+@pytest.mark.slow  # two train-step compiles — default gate only
 def test_blockwise_local_attention_train_step_parity():
     """tf_attn_block changes memory shape only: same metrics as dense."""
     cfg_blk = _tf_learner_cfg("dp=8", "")
