@@ -119,14 +119,25 @@ class ObsRuntime:
         )
         return self.compute
 
-    def attach_watchdog(self, latest_fn, version_fn) -> Optional[Watchdog]:
+    def attach_watchdog(
+        self, latest_fn, version_fn, latest_seq_fn=None
+    ) -> Optional[Watchdog]:
         """Build + start the liveness watchdog when cfg.watchdog.enabled;
         its verdict feeds the /healthz provider and its scalars the
-        scrape surface."""
+        scrape surface. Call AFTER checkpoint restore: the watchdog
+        treats version advances as train-step heartbeats, and boot grace
+        must outlive the restore's version write. latest_seq_fn
+        (MetricsLogger.latest_step) identifies the metrics window behind
+        latest_fn so per-check detectors can tell a fresh sample from a
+        re-read of one already judged."""
         if not self.cfg.watchdog.enabled:
             return None
         self.watchdog = Watchdog(
-            self.cfg.watchdog, latest_fn, version_fn, recorder=self.recorder
+            self.cfg.watchdog,
+            latest_fn,
+            version_fn,
+            recorder=self.recorder,
+            latest_seq_fn=latest_seq_fn,
         ).start()
         return self.watchdog
 

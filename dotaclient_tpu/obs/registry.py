@@ -77,7 +77,12 @@ SCALARS: Dict[str, str] = {
     "compute_mfu": "cumulative model-FLOPs utilization vs platform peak (TPU only)",
     # --- obs watchdog (dotaclient_tpu/obs/watchdog.py) -----------------
     "watchdog_ok": "1 while /healthz serves 200, 0 once tripped",
-    "watchdog_strikes": "consecutive failing checks (escalation ladder position)",
+    "watchdog_strikes": (
+        "escalation ladder position: max of consecutive failing checks "
+        "(stall/NaN) and consecutive failing metrics windows "
+        "(starvation/regression) — window strikes advance per logged "
+        "window, not per check"
+    ),
     "watchdog_trips_total": "times the watchdog flipped /healthz to 503",
     "watchdog_checks_total": "watchdog checks executed",
 }

@@ -333,9 +333,11 @@ class Learner:
                 train_step_flops(cfg), aggregate_peak_flops(jax.devices())
             )
             self.train_step = compute.wrap_train_step(self.train_step)
-            # Liveness watchdog (obs/watchdog.py, --obs.watchdog.*): reads
-            # the telemetry the loop already produces; trips /healthz.
-            self.obs.attach_watchdog(self.metrics.latest, lambda: self.version)
+            # (The liveness watchdog attaches at the END of __init__,
+            # after checkpoint restore — the restore's version write must
+            # not read as the first train-step heartbeat, or boot grace
+            # ends before the first step. serve_metrics binds the
+            # watchdog's gauges late, so the ordering is safe.)
             # Scrape surface (obs/http.py): the latest logged scalars plus
             # live gauges sampled per scrape — queue depth straight from
             # the broker, staging/replay occupancy from stats(). Runs for
@@ -393,6 +395,20 @@ class Learner:
                     f"checkpoint (shared checkpoint_dir or remote mirror) "
                     f"before starting"
                 )
+        if self.obs is not None:
+            # Liveness watchdog (obs/watchdog.py, --obs.watchdog.*): reads
+            # the telemetry the loop already produces; trips /healthz.
+            # Attached LAST — after checkpoint restore has written
+            # self.version — so the restore is the watchdog's baseline,
+            # not its first heartbeat: a heartbeat-counted restore would
+            # drop the stall threshold from boot_grace_s to stall_s
+            # before the first (minutes-long) compile+first-batch wait,
+            # and the k8s liveness probe would crashloop every restored
+            # learner. latest_step keys the per-check freshness/dedup of
+            # the metrics-window detectors.
+            self.obs.attach_watchdog(
+                self.metrics.latest, lambda: self.version, self.metrics.latest_step
+            )
 
     # ---------------------------------------------------------------- ops
 

@@ -41,9 +41,10 @@ class MetricsLogger:
         self._closed = False
         # Latest logged record, served by the obs /metrics scrape surface
         # (obs/http.py): updated once per metrics window, never on the
-        # per-row hot path.
-        self._latest: Dict[str, float] = {}
-        self._latest_step = -1
+        # per-row hot path. ONE tuple, replaced atomically, so readers on
+        # other threads (scrape server, watchdog) can never pair one
+        # window's step with another window's scalars.
+        self._latest_rec: tuple = (-1, {})
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
             self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a", buffering=1)
@@ -62,8 +63,7 @@ class MetricsLogger:
         if self._closed:
             return
         clean = {k: float(v) for k, v in scalars.items()}
-        self._latest = clean
-        self._latest_step = step
+        self._latest_rec = (step, clean)
         if self._jsonl is not None:
             rec = {"step": step, "time": time.time()}
             rec.update(clean)
@@ -84,7 +84,17 @@ class MetricsLogger:
         """Most recent scalars handed to log() (empty before the first
         window). Returns a copy — scrape threads must not alias the dict
         the logging thread will replace."""
-        return dict(self._latest)
+        return dict(self._latest_rec[1])
+
+    def latest_step(self) -> int:
+        """Step of the most recent log() (-1 before the first window):
+        the metrics-window identity. The watchdog keys once-per-window
+        judging on this — latest() refreshes only once per window, and a
+        detector polling faster than the log cadence must not re-judge
+        (or re-sample) a window it has already seen. Steps are monotonic,
+        so a caller reading step → latest() → step again and seeing the
+        same value knows the middle read came from that exact window."""
+        return self._latest_rec[0]
 
     def flush(self) -> None:
         if self._closed:

@@ -172,8 +172,9 @@ def test_watchdog_stall_escalation_ladder(tmp_path):
     version = [0]
     cfg = WatchdogConfig(enabled=True, stall_s=10.0, dump_after=2, trip_after=3)
     wd, clock = _wd(cfg, dict, lambda: version[0], recorder=rec)
-    version[0] = 1  # first advance: boot grace over, stall_s governs
-    assert wd.check()["ok"]  # healthy at boot
+    assert wd.check()["ok"]  # first check: baseline only, never a heartbeat
+    version[0] = 1  # advance OBSERVED between checks: boot over, stall_s governs
+    assert wd.check()["ok"]
     clock.t += 60  # version never advanced again
     v1 = wd.check()
     assert v1["strikes"] == 1 and v1["ok"] and "stall" in v1["reasons"][0]
@@ -208,6 +209,30 @@ def test_watchdog_boot_grace_covers_slow_cold_start():
     assert not v["ok"] and "boot grace" in v["reasons"][0]
 
 
+def test_watchdog_restore_version_write_does_not_end_boot_grace():
+    """Checkpoint restore writes the version counter before the first
+    train step. If the watchdog read that write as the first heartbeat,
+    boot would end and the stall threshold would drop from boot_grace_s
+    to stall_s while the restored learner is still in its minutes-long
+    compile + first-batch wait — the liveness probe restarts the pod,
+    the restart restores again: the exact crashloop boot_grace_s exists
+    to prevent. The restore must land as the BASELINE; only an advance
+    observed between checks (a real step) ends boot."""
+    cfg = WatchdogConfig(enabled=True, stall_s=10.0, boot_grace_s=300.0, trip_after=1)
+    version = [0]
+    wd, clock = _wd(cfg, dict, lambda: version[0])
+    version[0] = 4200  # restore lands before the watchdog's first look
+    clock.t += 120  # well past stall_s, inside the boot grace
+    assert wd.check()["ok"]  # restore write == baseline, not a heartbeat
+    clock.t += 120  # 240s in, still no step: grace still governs
+    assert wd.check()["ok"]
+    version[0] = 4201  # the real first train step
+    assert wd.check()["ok"]
+    clock.t += 60  # booted now, so a 60s silence IS a stall (> stall_s)
+    v = wd.check()
+    assert not v["ok"] and "stall" in v["reasons"][0]
+
+
 def test_watchdog_nan_loss_detected():
     cfg = WatchdogConfig(enabled=True, trip_after=1)
     wd, clock = _wd(cfg, lambda: {"loss": float("nan")}, lambda: 0)
@@ -227,6 +252,158 @@ def test_watchdog_starvation_from_fetch_frac():
     assert not v["ok"] and "starvation" in v["reasons"][0]
     latest["compute_phase_fetch_frac"] = 0.2
     assert wd.check()["ok"]
+
+
+def test_watchdog_starvation_strikes_once_per_window():
+    """Window detectors strike per failing WINDOW, not per check.
+    latest() refreshes only every metrics_every steps while checks run
+    every interval_s, so per-check judging would either trip on a
+    transient episode that ended mid-window (3 re-reads of one stale
+    sample in 15s restart a recovered learner) or — if stale samples
+    were skipped — never accumulate the consecutive strikes sustained
+    starvation deserves."""
+    cfg = WatchdogConfig(enabled=True, starvation_frac=0.8, trip_after=3)
+    latest = {"compute_phase_fetch_frac": 0.95, "loss": 0.1}
+    state = {"v": 10, "seq": 10}  # seq: version at which latest() was logged
+    clock = FakeClock()
+    wd = Watchdog(
+        cfg,
+        latest_fn=lambda: dict(latest),
+        version_fn=lambda: state["v"],
+        time_fn=clock,
+        latest_seq_fn=lambda: state["seq"],
+    )
+    v1 = wd.check()  # fresh failing window: strike 1 (log only)
+    assert v1["ok"] and v1["strikes"] == 1 and "starvation" in v1["reasons"][0]
+    for _ in range(6):  # same window re-read across many checks: count holds
+        state["v"] += 1
+        v = wd.check()
+        assert v["ok"] and v["strikes"] == 1
+    latest["compute_phase_fetch_frac"] = 0.2  # next window healthy: clears
+    state["seq"] = state["v"]
+    v = wd.check()
+    assert v["ok"] and v["strikes"] == 0 and not v["reasons"]
+    latest["compute_phase_fetch_frac"] = 0.95  # SUSTAINED: three failing
+    for n in (1, 2, 3):  # consecutive windows walk the ladder to the trip
+        state["v"] += 1
+        state["seq"] = state["v"]
+        v = wd.check()
+        assert v["strikes"] == n
+    assert not v["ok"] and v["tripped"]
+
+
+def test_watchdog_reader_errors_hold_window_state():
+    """A torn or unreadable (latest, seq) pair must neither consume a
+    window's identity nor reset/re-judge its counts: the verdict holds
+    and the next stable check judges the pending window."""
+    cfg = WatchdogConfig(enabled=True, starvation_frac=0.8, trip_after=3)
+    latest = {"compute_phase_fetch_frac": 0.95, "loss": 0.1}
+    state = {"seq": 10, "seq_boom": False, "latest_boom": False}
+
+    def seq_fn():
+        if state["seq_boom"]:
+            raise RuntimeError("metrics backend gone")
+        return state["seq"]
+
+    def latest_fn():
+        if state["latest_boom"]:
+            raise RuntimeError("metrics backend gone")
+        return dict(latest)
+
+    wd = Watchdog(cfg, latest_fn, lambda: 0, time_fn=FakeClock(), latest_seq_fn=seq_fn)
+    assert wd.check()["strikes"] == 1  # window 10 judged once
+    state["seq_boom"] = True
+    v = wd.check()  # identity unreadable: held verdict, no re-judge
+    assert v["ok"] and v["strikes"] == 1
+    state["seq_boom"] = False
+    state["latest_boom"] = True
+    state["seq"] = 11  # a NEW window arrives but its data is unreadable
+    v = wd.check()
+    assert v["ok"] and v["strikes"] == 1  # identity NOT consumed, count held
+    state["latest_boom"] = False
+    v = wd.check()  # ...so the stable next check judges window 11 properly
+    assert v["strikes"] == 2 and "2 consecutive windows" in v["reasons"][0]
+
+
+def test_watchdog_regression_legacy_path_dedups_on_version():
+    """Without a window identity wired (latest_seq_fn=None), baseline
+    appends dedup on version advance — the pre-identity behavior — so a
+    re-served sample between steps cannot flood the median with copies
+    of itself."""
+    cfg = WatchdogConfig(enabled=True, regression_frac=0.5, window=4, trip_after=1)
+    latest = {"env_steps_per_sec": 100.0, "loss": 0.1}
+    state = {"v": 1}
+    wd = Watchdog(cfg, lambda: dict(latest), lambda: state["v"], time_fn=FakeClock())
+    for _ in range(6):  # version parked across six checks: ONE sample
+        assert wd.check()["ok"]
+    assert len(wd._rates) == 1
+
+
+def test_watchdog_regression_transient_dip_never_trips():
+    """One dipped window (say a checkpoint write straddled the log) is
+    ONE strike no matter how many checks re-read it before the next
+    window, and a healthy next window clears it — the trailing baseline
+    stays honest because the dip is appended exactly once."""
+    cfg = WatchdogConfig(enabled=True, regression_frac=0.5, window=4, trip_after=3)
+    latest = {"env_steps_per_sec": 100.0, "loss": 0.1}
+    state = {"v": 0, "seq": 0}
+    clock = FakeClock()
+    wd = Watchdog(
+        cfg,
+        latest_fn=lambda: dict(latest),
+        version_fn=lambda: state["v"],
+        time_fn=clock,
+        latest_seq_fn=lambda: state["seq"],
+    )
+    for s in range(1, 5):  # fill the baseline at the healthy rate
+        state["seq"] = s
+        state["v"] = s
+        assert wd.check()["ok"]
+    latest["env_steps_per_sec"] = 20.0  # one dipped window
+    state["seq"] = 5
+    for _ in range(6):  # many checks before the next window logs
+        state["v"] += 1
+        v = wd.check()
+        assert v["ok"] and v["strikes"] == 1 and "regression" in v["reasons"][0]
+    latest["env_steps_per_sec"] = 100.0  # recovered; next window clears
+    state["seq"] = 12
+    state["v"] = 12
+    v = wd.check()
+    assert v["ok"] and v["strikes"] == 0 and not v["reasons"]
+
+
+def test_watchdog_regression_baseline_one_sample_per_window():
+    """The trailing baseline holds one sample per metrics WINDOW. The
+    train-step version advances every step while latest() re-serves the
+    same logged sample, so keying the dedup on the version would append
+    a duplicate each check and skew the median toward the newest
+    window."""
+    cfg = WatchdogConfig(enabled=True, regression_frac=0.5, window=4, trip_after=1)
+    latest = {"env_steps_per_sec": 100.0, "loss": 0.1}
+    state = {"v": 0, "seq": 1}
+
+    def step_and_read():  # one train step per check; window unchanged
+        state["v"] += 1
+        return state["v"]
+
+    wd = Watchdog(
+        cfg,
+        latest_fn=lambda: dict(latest),
+        version_fn=step_and_read,
+        time_fn=FakeClock(),
+        latest_seq_fn=lambda: state["seq"],
+    )
+    for _ in range(6):
+        assert wd.check()["ok"]
+    assert len(wd._rates) == 1  # six checks, ONE window -> one sample
+    for s in range(2, 6):  # four more windows at the healthy rate
+        state["seq"] = s
+        assert wd.check()["ok"]
+    assert len(wd._rates) == 4
+    latest["env_steps_per_sec"] = 30.0  # < 0.5 x median(100)
+    state["seq"] = 6
+    v = wd.check()
+    assert not v["ok"] and "regression" in v["reasons"][0]
 
 
 def test_watchdog_steps_regression_vs_trailing_median():
@@ -504,6 +681,11 @@ def test_learner_healthz_200_healthy_503_tripped(tmp_path):
     cfg.obs.watchdog = WatchdogConfig(enabled=True, interval_s=3600.0, stall_s=1e9)
     learner = Learner(cfg, connect("mem://wd_health"))
     try:
+        # Baseline check BEFORE training: boot ends only on a version
+        # advance observed between checks (restore-safe contract), so
+        # the post-run check below must have something to compare to.
+        wd = learner.obs.watchdog
+        assert wd.check()["ok"]
         _feed(broker, 16)
         assert learner.run(num_steps=2, batch_timeout=60.0, max_idle=3) == 2
         url = f"http://127.0.0.1:{port}/healthz"
@@ -512,10 +694,9 @@ def test_learner_healthz_200_healthy_503_tripped(tmp_path):
         assert body["version"] == 2 and body["uptime_s"] >= 0
         assert body["watchdog"]["enabled"] is True and body["watchdog"]["tripped"] is False
         # trip it: a genuinely-stalled version counter via the real ladder
-        wd = learner.obs.watchdog
         wd.cfg.stall_s = 0.0  # any non-advance now reads as stall
-        # +1: the first check consumes the run()'s version advance and
-        # reads healthy; strikes start on the second
+        # +1: the first check observes the run()'s version advance (ending
+        # boot grace) and reads healthy; strikes start on the second
         for _ in range(wd.cfg.trip_after + 1):
             wd.check()
         with pytest.raises(urllib.error.HTTPError) as exc:

@@ -161,6 +161,24 @@ def test_multihost_learner_slice_consistency():
     )
 
 
+def test_learner_manifests_keep_pipelined_loop():
+    """Production learner deploys opt into the scrape surface, NOT phase
+    fencing: obs.step_phases defaults to true under --obs.enabled, and a
+    manifest that forgets to disable it silently pays a per-step device
+    fence and forfeits the prefetch overlap the pipelined loop exists
+    for."""
+    for name in ("learner", "learner-multihost"):
+        (_, doc), = [
+            (f, d) for f, d in DOCS
+            if d["metadata"]["name"] == name and d["kind"] != "Service"
+        ]
+        args = doc["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--obs.step_phases" in args, f"{name}: step_phases not pinned"
+        assert args[args.index("--obs.step_phases") + 1] == "false", (
+            f"{name}: production learner must run the pipelined (unfenced) loop"
+        )
+
+
 def test_actor_fleet_scale_and_kill_switch():
     (_, doc), = [(f, d) for f, d in DOCS if d["metadata"]["name"] == "actors"]
     assert doc["spec"]["replicas"] >= 2
