@@ -1,0 +1,49 @@
+"""Graftlint: repo-native static analysis for the hazards this codebase
+actually ships — thread-safety discipline around the seven daemon
+threads, JAX hot-path recompile/host-sync hazards, and observability
+contract drift.
+
+Three rule families (see the sibling modules for the full rule docs):
+
+- THR (thr_rules.py)  — classes that spawn a ``threading.Thread`` must
+  guard worker-written attributes read from public methods with the
+  instance lock, or read them as a single atomic rebound reference (the
+  MetricsLogger ``_latest_rec`` pattern PR 3's review converged on);
+  plus cross-module lock-acquisition-order consistency.
+- JAX (jax_rules.py)  — inside jit/shard_map regions: host syncs
+  (``.item()``, ``float()`` on tracers, ``np.asarray``, ``device_get``,
+  ``print``), tracer-dependent Python branches, unstable static args —
+  the static complement to the RecompileSentinel's
+  ``compute_recompiles_total == 0`` runtime invariant.
+- OBS (obs_rules.py)  — scalar names logged to MetricsLogger must exist
+  in ``obs/registry.py``; ``--flags`` in ``k8s/*.yaml`` must exist in
+  ``config.py`` (or the broker argparse); defined flags must be consumed
+  somewhere in the package.
+
+Runtime counterpart: ``lockcheck.py`` — an instrumented
+``threading.Lock`` that records per-thread acquisition order and
+detects lock-order inversions and over-held locks. Enabled by the
+``lockcheck`` fixture in tests; nothing imports it in production.
+
+Everything here is pure stdlib + ``ast`` — linting the package never
+imports the package (and never imports JAX), so the tier-1 lint test
+costs ~a second of wall clock. Entry point: ``scripts/lint_graft.py``.
+"""
+
+from __future__ import annotations
+
+from dotaclient_tpu.analysis.core import (
+    Finding,
+    LintReport,
+    lint_repo,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintReport",
+    "lint_repo",
+    "load_baseline",
+    "write_baseline",
+]

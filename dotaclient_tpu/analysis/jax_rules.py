@@ -1,0 +1,447 @@
+"""JAX rules: static complements to the RecompileSentinel.
+
+The runtime sentinel (obs/compute.py) proves `compute_recompiles_total
+== 0` steady-state; these rules catch the patterns that break that
+invariant — or silently serialize the host onto the device's critical
+path — BEFORE they land.
+
+A "jit region" is any function this module can see entering a
+`jax.jit` / `shard_map` / `pmap` compilation boundary:
+
+- decorated with ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``
+  (or the shard_map/pmap equivalents);
+- passed BY NAME to a jit-ish call anywhere in the same file (the
+  ``step_fn`` → ``jax.jit(step_fn, ...)`` pattern in
+  parallel/train_step.py, including across function scopes — matching
+  is by name, deliberately, since the builder functions return the
+  callable for a different scope to wrap);
+- carrying an explicit ``# graftlint: jit-region`` comment on its `def`
+  line (for helpers only ever CALLED from inside a jit, which no static
+  name analysis can prove).
+
+Nested defs inside a jit region are traced too and inherit the region.
+
+JAX001 (error) — host syncs inside a jit region: ``.item()``,
+``.tolist()``, ``.block_until_ready()``, ``np.asarray``/``np.array``,
+``jax.device_get``, ``print``, and ``float()``/``int()``/``bool()`` on
+a non-literal (on a tracer these force a blocking device transfer at
+best and a ConcretizationTypeError at worst). Shape arithmetic is
+exempt: an argument that only touches ``.shape``/``.ndim``/``.dtype``/
+``len()``/constants is static at trace time.
+
+JAX002 (warning) — tracer-dependent Python branch: an ``if``/``while``
+whose test reads a DATA parameter of the jit region. Python control
+flow on a tracer raises at trace time or — when the value sneaks in
+concretely — recompiles per distinct value. Tests on shapes/dtypes,
+``is None``, ``isinstance``, or declared static args are exempt.
+
+JAX003 (warning) — unstable static args: a call to a known-jitted
+function passing a list/dict/set/lambda literal in a position declared
+``static_argnums``/``static_argnames`` (unhashable → TypeError;
+fresh-lambda-per-call → a new cache entry per call, the unbounded-
+recompile failure the sentinel counts).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from dotaclient_tpu.analysis.core import (
+    Finding,
+    ModuleUnit,
+    RepoContext,
+    Rule,
+    register,
+)
+
+_JIT_WRAPPERS = {"jit", "shard_map", "pmap"}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+_JIT_REGION_MARK = re.compile(r"#\s*graftlint:\s*jit-region")
+
+
+def _call_name(fn: ast.expr) -> str:
+    """Trailing name of a (possibly dotted) callable expression."""
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return _call_name(call.func) in _JIT_WRAPPERS
+
+
+def _static_decl(call: ast.Call) -> Tuple[List[int], List[str]]:
+    """static_argnums/static_argnames literals from a jit call."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            nums.extend(
+                v.value
+                for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, int)
+            )
+        elif kw.arg == "static_argnames":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            names.extend(
+                v.value
+                for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)
+            )
+    return nums, names
+
+
+def _jit_index(module: ModuleUnit) -> "_JitIndex":
+    """One _JitIndex per ModuleUnit, shared by all three rules (building
+    it walks the whole tree — doing that 3x per file tripled lint
+    wall time)."""
+    cached = getattr(module, "_jit_index_cache", None)
+    if cached is None:
+        cached = module._jit_index_cache = _JitIndex(module)
+    return cached
+
+
+class _JitIndex:
+    """Per-module map of jit regions and jitted-callable names."""
+
+    def __init__(self, module: ModuleUnit):
+        self.module = module
+        # name → (static_argnums, static_argnames) for names wrapped by a
+        # jit call; used both to mark regions and to check call sites.
+        self.jitted_names: Dict[str, Tuple[List[int], List[str]]] = {}
+        # assigned alias → wrapped function name (w = jax.jit(fn, ...))
+        self.alias_of: Dict[str, str] = {}
+        # names whose CALLS run jitted (alias targets, @jit decorators,
+        # fn = jax.jit(fn) rebinds) — as opposed to raw inner functions
+        # that merely got wrapped somewhere and stay callable eagerly
+        self.callable_jitted: Set[str] = set()
+        self.regions: List[ast.FunctionDef] = []
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, ast.Call) and _is_jit_call(node):
+                decl = _static_decl(node)
+                # only the FIRST positional is the wrapped callable —
+                # later positionals (shard_map's mesh, legacy jit's
+                # device) must not mint jit regions for same-named
+                # functions elsewhere in the file
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        self.jitted_names.setdefault(arg.id, decl)
+                # x = jax.jit(fn); calls to x are calls to a jitted fn
+                parent = self.module.parents.get(node)
+                if isinstance(parent, ast.Assign):
+                    for tgt in parent.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.jitted_names.setdefault(tgt.id, decl)
+                            self.callable_jitted.add(tgt.id)
+                            if node.args and isinstance(node.args[0], ast.Name):
+                                self.alias_of.setdefault(tgt.id, node.args[0].id)
+        lines = self.module.source.splitlines()
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            is_region = node.name in self.jitted_names
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _call_name(target) in _JIT_WRAPPERS | {"partial"}:
+                    inner = (
+                        dec.args[0]
+                        if isinstance(dec, ast.Call)
+                        and _call_name(target) == "partial"
+                        and dec.args
+                        else target
+                    )
+                    if _call_name(inner) in _JIT_WRAPPERS or _call_name(
+                        target
+                    ) in _JIT_WRAPPERS:
+                        is_region = True
+                        self.callable_jitted.add(node.name)
+                        if isinstance(dec, ast.Call):
+                            nums, names = _static_decl(dec)
+                            self.jitted_names.setdefault(node.name, (nums, names))
+            if 0 < node.lineno <= len(lines) and _JIT_REGION_MARK.search(
+                lines[node.lineno - 1]
+            ):
+                is_region = True
+            if is_region:
+                self.regions.append(node)
+
+    def static_params(self, region: ast.FunctionDef) -> Set[str]:
+        nums, names = self.jitted_names.get(region.name, ([], []))
+        params = [a.arg for a in region.args.args]
+        out = set(names)
+        for i in nums:
+            if 0 <= i < len(params):
+                out.add(params[i])
+        return out
+
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "maxlen", "itemsize"}
+_MODULE_ALIASES = {"np", "numpy", "onp", "jnp", "jax", "lax", "math"}
+_STATIC_BUILTINS = {
+    "len",
+    "isinstance",
+    "hasattr",
+    "getattr",
+    "min",
+    "max",
+    "abs",
+    "sum",
+    "round",
+    "int",
+    "float",
+    "bool",
+    "tuple",
+    "prod",
+}
+
+
+def _is_shapey(node: Optional[ast.AST], static_names: frozenset = frozenset()) -> bool:
+    """True when EVERY leaf of the expression is static at trace time:
+    constants, .shape/.ndim/.dtype reads, len()/isinstance(), module
+    aliases, and names in `static_names` (locals assigned from shapey
+    expressions). A mixed expression like ``loss * x.shape[0]`` is NOT
+    shapey — one traced leaf poisons the whole thing."""
+    if node is None:
+        return True
+
+    def rec(n: ast.AST) -> bool:
+        if isinstance(n, ast.Constant):
+            return True
+        if isinstance(n, ast.Attribute):
+            # x.shape is static whatever x is; np.float32 via the alias
+            return n.attr in _STATIC_ATTRS or rec(n.value)
+        if isinstance(n, ast.Name):
+            return n.id in _MODULE_ALIASES or n.id in static_names
+        if isinstance(n, ast.Call):
+            fname = _call_name(n.func)
+            if fname in _STATIC_BUILTINS:
+                return all(rec(a) for a in n.args)
+            if isinstance(n.func, ast.Attribute):
+                # method chain on a static value: np.asarray(x.shape).prod()
+                return rec(n.func) and all(rec(a) for a in n.args)
+            return False
+        if isinstance(n, ast.BinOp):
+            return rec(n.left) and rec(n.right)
+        if isinstance(n, ast.UnaryOp):
+            return rec(n.operand)
+        if isinstance(n, ast.BoolOp):
+            return all(rec(v) for v in n.values)
+        if isinstance(n, ast.Compare):
+            return rec(n.left) and all(rec(c) for c in n.comparators)
+        if isinstance(n, ast.Subscript):
+            return rec(n.value) and rec(n.slice)
+        if isinstance(n, ast.Slice):
+            return all(
+                rec(part)
+                for part in (n.lower, n.upper, n.step)
+                if part is not None
+            )
+        if isinstance(n, (ast.Tuple, ast.List)):
+            return all(rec(e) for e in n.elts)
+        if isinstance(n, ast.IfExp):
+            return rec(n.test) and rec(n.body) and rec(n.orelse)
+        return False
+
+    return rec(node)
+
+
+def _static_locals(region: ast.AST, seed: frozenset = frozenset()) -> frozenset:
+    """Names assigned from shapey expressions inside the region, to a
+    small fixpoint (rows = int(x.shape[0]); cols = rows * 2)."""
+    static = set(seed)
+    for _ in range(3):
+        grew = False
+        for sub in ast.walk(region):
+            if isinstance(sub, ast.Assign) and _is_shapey(
+                sub.value, frozenset(static)
+            ):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id not in static:
+                        static.add(tgt.id)
+                        grew = True
+        if not grew:
+            break
+    return frozenset(static)
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "JAX001"
+    severity = "error"
+    doc = "host sync / device_get / print inside a jit region"
+
+    def run(self, module: ModuleUnit, ctx: RepoContext) -> List[Finding]:
+        index = _jit_index(module)
+        findings: List[Finding] = []
+        for region in index.regions:
+            qual = module.qualname_at(region)
+            statics = _static_locals(region, seed=index.static_params(region))
+            for sub in ast.walk(region):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                name = _call_name(fn)
+                hit = None
+                if isinstance(fn, ast.Attribute):
+                    if name in _HOST_SYNC_METHODS:
+                        hit = f".{name}() forces a blocking device→host sync"
+                    elif (
+                        isinstance(fn.value, ast.Name)
+                        and fn.value.id in _NUMPY_ALIASES
+                        and name in ("asarray", "array")
+                    ):
+                        if not all(_is_shapey(a, statics) for a in sub.args):
+                            hit = (
+                                f"{fn.value.id}.{name}() on traced data "
+                                f"materializes on the host"
+                            )
+                    elif name == "device_get":
+                        hit = "jax.device_get() is a blocking transfer"
+                elif isinstance(fn, ast.Name):
+                    if name == "print":
+                        hit = (
+                            "print() in a jit region runs at trace time only "
+                            "(silent in steady state) or forces a callback"
+                        )
+                    elif name in _CAST_BUILTINS and sub.args:
+                        if not all(_is_shapey(a, statics) for a in sub.args):
+                            hit = (
+                                f"{name}() on a tracer forces concretization "
+                                f"(host sync or ConcretizationTypeError)"
+                            )
+                if hit is not None:
+                    findings.append(
+                        self.make(
+                            module,
+                            sub.lineno,
+                            f"{hit} — inside jit region {qual!r}; hoist to "
+                            f"the host side or keep it in jnp",
+                            context=qual,
+                        )
+                    )
+        return findings
+
+
+@register
+class TracerBranch(Rule):
+    id = "JAX002"
+    severity = "warning"
+    doc = "Python control flow on a jit-region data parameter"
+
+    def run(self, module: ModuleUnit, ctx: RepoContext) -> List[Finding]:
+        index = _jit_index(module)
+        findings: List[Finding] = []
+        for region in index.regions:
+            statics = index.static_params(region)
+            params = {a.arg for a in region.args.args} - statics - {"self", "cfg"}
+            if not params:
+                continue
+            qual = module.qualname_at(region)
+            statics_local = _static_locals(region, seed=frozenset(statics))
+            for sub in ast.walk(region):
+                if not isinstance(sub, (ast.If, ast.While)):
+                    continue
+                test = sub.test
+                if _is_shapey(test, statics_local):
+                    continue
+                if self._is_none_check(test):
+                    continue
+                used = {
+                    n.id
+                    for n in ast.walk(test)
+                    if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                }
+                hot = sorted(used & params)
+                if not hot:
+                    continue
+                kind = "if" if isinstance(sub, ast.If) else "while"
+                findings.append(
+                    self.make(
+                        module,
+                        sub.lineno,
+                        f"`{kind}` on data parameter(s) {', '.join(hot)} of "
+                        f"jit region {qual!r} — a tracer here raises at "
+                        f"trace time or recompiles per value; use lax.cond/"
+                        f"lax.select, or declare the arg static",
+                        context=qual,
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _is_none_check(test: ast.AST) -> bool:
+        return isinstance(test, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        )
+
+
+@register
+class UnstableStaticArg(Rule):
+    id = "JAX003"
+    severity = "warning"
+    doc = "unhashable/unstable literal passed in a static jit arg position"
+
+    _BAD = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.Lambda)
+
+    def run(self, module: ModuleUnit, ctx: RepoContext) -> List[Finding]:
+        index = _jit_index(module)
+        findings: List[Finding] = []
+        regions_by_name = {r.name: r for r in index.regions}
+        for sub in ast.walk(module.tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _call_name(sub.func)
+            decl = index.jitted_names.get(name)
+            if decl is None or _call_name(sub.func) in _JIT_WRAPPERS:
+                continue
+            # the raw inner fn of `jfn = jax.jit(fn, ...)` stays callable
+            # eagerly (tests/debugging) — a direct call never enters jit,
+            # so static-arg hygiene does not apply to it
+            if name not in index.callable_jitted:
+                continue
+            nums, names = decl
+            if not nums and not names:
+                continue
+            qual = module.qualname_at(sub)
+            region = regions_by_name.get(name) or regions_by_name.get(
+                index.alias_of.get(name, "")
+            )
+            params = [a.arg for a in region.args.args] if region is not None else []
+            for i, arg in enumerate(sub.args):
+                static = i in nums or (i < len(params) and params[i] in names)
+                if static and isinstance(arg, self._BAD):
+                    findings.append(self._finding(module, arg, name, qual))
+            for kw in sub.keywords:
+                if kw.arg in names and isinstance(kw.value, self._BAD):
+                    findings.append(self._finding(module, kw.value, name, qual))
+        return findings
+
+    def _finding(self, module: ModuleUnit, arg: ast.AST, name: str, qual: str):
+        what = type(arg).__name__.lower()
+        return self.make(
+            module,
+            arg.lineno,
+            f"{what} literal passed in a static arg position of jitted "
+            f"{name!r} — unhashable statics TypeError; a fresh lambda/"
+            f"container per call is a new cache entry per call (unbounded "
+            f"recompiles); pass a module-level tuple/function instead",
+            context=qual,
+        )

@@ -156,11 +156,17 @@ class ObsRuntime:
         if self.cfg.metrics_port <= 0:
             return None
         sources = list(sources) + [self.tracer.scalars]
+
         # Late-bound: a watchdog attached AFTER the server starts (no
-        # ordering contract on callers) still appears on the scrape.
-        sources.append(
-            lambda: self.watchdog.scalars() if self.watchdog is not None else {}
-        )
+        # ordering contract on callers) still appears on the scrape. The
+        # local rebind inside the closure makes the None-check and the
+        # call one atomic observation — close() nulls self.watchdog from
+        # another thread while scrape handlers run this.
+        def _watchdog_scalars() -> Dict[str, float]:
+            wd = self.watchdog
+            return wd.scalars() if wd is not None else {}
+
+        sources.append(_watchdog_scalars)
         if self.profiler is None:
             self.profiler = ProfileCapture(
                 self.cfg.profile_dir or self.cfg.dump_dir,

@@ -110,10 +110,11 @@ class MetricsHTTPServer:
         """The /healthz body: provider's dict, or the serving-only
         default. A provider that throws reads as unhealthy — a broken
         health source must fail the probe, not mask it."""
-        if self.health_provider is None:
+        provider = self.health_provider  # one read: rebindable attribute
+        if provider is None:
             return {"ok": True}
         try:
-            body = dict(self.health_provider())
+            body = dict(provider())
         except Exception as e:
             _log.exception("health provider failed")
             return {"ok": False, "error": f"health provider failed: {type(e).__name__}"}
@@ -122,7 +123,9 @@ class MetricsHTTPServer:
 
     def collect(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
-        for source in self._sources:
+        # Snapshot: handler threads iterate while the owner may still
+        # add_source; tuple() is one GIL-atomic copy of the list.
+        for source in tuple(self._sources):
             try:
                 out.update(source())
             except Exception:

@@ -17,6 +17,16 @@ Two name classes:
   stats, per-stage trace scalars). A family prefix documents the whole
   family; keep these FEW and specific — a catch-all prefix would defeat
   the drift guard.
+
+This contract is enforced TWICE, and both guards parse THIS file:
+- runtime: the tier-1 drift guard above catches any name a real learner
+  window emits that isn't registered;
+- lint time: graftlint's OBS001 (dotaclient_tpu/analysis/obs_rules.py)
+  AST-checks every STRING-LITERAL scalar name passed to
+  MetricsLogger.log against SCALARS/PREFIXES before the code ever runs
+  (it reads the two dicts below by AST, never by import — keep them
+  literal dicts of constant string keys). Dynamic keys (f-strings,
+  loop-forwarded stats) are the runtime guard's half of the contract.
 """
 
 from __future__ import annotations

@@ -98,7 +98,7 @@ def _sp_shard_map(body_factory, mesh: Mesh, axis_name: str, q):
     ), n
 
 
-def _ring_body(q, k, v, q_pos, k_pos, *, axis_name: str, n: int):
+def _ring_body(q, k, v, q_pos, k_pos, *, axis_name: str, n: int):  # graftlint: jit-region
     """Runs inside shard_map: all arrays are the local shards."""
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -144,7 +144,7 @@ def ring_causal_attention(
     return mapped(q, k, v, q_pos, k_pos)
 
 
-def _ulysses_body(q, k, v, q_pos, k_pos, *, axis_name: str, kv_block: int):
+def _ulysses_body(q, k, v, q_pos, k_pos, *, axis_name: str, kv_block: int):  # graftlint: jit-region
     """Runs inside shard_map: time-sharded inputs → head-sharded
     attention → time-sharded output, via two all_to_alls."""
     # [B, T/n, N, Dh] → [B, T, N/n, Dh]: every device trades its time

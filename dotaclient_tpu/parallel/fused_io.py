@@ -261,7 +261,7 @@ class FusedBatchIO:
 
     # --------------------------------------------------------- device side
 
-    def unpack(self, groups: Dict[str, jnp.ndarray]):
+    def unpack(self, groups: Dict[str, jnp.ndarray]):  # graftlint: jit-region
         """{group: [B, cols]} → TrainBatch, inside jit. Slices + reshapes
         only — XLA fuses them into the first consumers."""
         leaves: List[Any] = [None] * sum(len(s) for s in self.slots.values())
@@ -275,7 +275,7 @@ class FusedBatchIO:
                 leaves[s.index] = x
         return jax.tree.unflatten(self.treedef, leaves)
 
-    def unpack_single(self, buf: jnp.ndarray):
+    def unpack_single(self, buf: jnp.ndarray):  # graftlint: jit-region
         """[B, row_bytes] u8 → TrainBatch, inside jit: slice each group's
         byte segment, bitcast u8[..., k] to the group dtype, then the
         same per-leaf slicing as unpack. Bitcasts are free on device

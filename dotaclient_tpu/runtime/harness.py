@@ -48,6 +48,10 @@ class ActorPool:
         self._on_episode = on_episode
         self._stop = threading.Event()
         self.actors: List[object] = []
+        # `dead` is incremented from N worker threads — a bare += is a
+        # read-modify-write that loses updates when two actors die in the
+        # same tick, so the counter is lock-guarded on both sides.
+        self._lock = threading.Lock()
         self.dead = 0
         self._threads = [
             threading.Thread(target=self._run, args=(i,), daemon=True, name=f"actor-{i}")
@@ -68,7 +72,8 @@ class ActorPool:
 
             loop.run_until_complete(go())
         except Exception:
-            self.dead += 1
+            with self._lock:
+                self.dead += 1
             _log.exception("actor thread %d died", i)
         finally:
             loop.close()
@@ -89,8 +94,10 @@ class ActorPool:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=timeout)
-        if raise_on_dead and self.dead:
+        with self._lock:
+            dead = self.dead
+        if raise_on_dead and dead:
             raise RuntimeError(
-                f"{self.dead} actor thread(s) died during the run "
+                f"{dead} actor thread(s) died during the run "
                 f"(tracebacks in the log) — results would be degraded"
             )

@@ -144,8 +144,19 @@ class WeightPublisher:
             if self._thread is not None and self._thread.is_alive():
                 self._cond.notify()
                 return self
-        self._thread = threading.Thread(target=self._run, daemon=True, name="weight-publisher")
-        self._thread.start()
+            # Publish the new handle under the SAME lock hold that decided
+            # a new thread is needed — an old thread's exit path nulls
+            # _thread under this lock, so assigning outside it could let
+            # that late null clobber the fresh handle.
+            t = threading.Thread(target=self._run, daemon=True, name="weight-publisher")
+            self._thread = t
+            # start under the same hold: a stop() sneaking in after the
+            # release would otherwise join an unstarted thread
+            # (RuntimeError), and a second start() would see
+            # is_alive()==False and spawn a duplicate publisher. The
+            # worker's first act is acquiring this cond, so it simply
+            # blocks until we release.
+            t.start()
         return self
 
     def submit(self, np_params, version: int) -> None:
@@ -428,9 +439,14 @@ class Learner:
         """The /healthz body (obs/http.py contract: "ok" selects the
         status code). A learner without a watchdog is healthy by virtue
         of serving; with one, the watchdog verdict decides."""
+        # Runs on scrape handler threads while close() may null
+        # obs.watchdog — bind once so the None-check and the verdict()
+        # call observe the same object.
+        obs = self.obs
+        watchdog = obs.watchdog if obs is not None else None
         wd = (
-            self.obs.watchdog.verdict()
-            if self.obs is not None and self.obs.watchdog is not None
+            watchdog.verdict()
+            if watchdog is not None
             else {"enabled": False, "ok": True}
         )
         return {

@@ -570,11 +570,15 @@ class StagingBuffer:
     # -- learner side ----------------------------------------------------
 
     def _check_fatal(self) -> None:
-        if self._fatal is not None:
+        # Single atomic read: _fatal is rebound once by the dying consumer
+        # thread; binding it to a local means the check and the raise can
+        # never observe two different values of the attribute.
+        fatal = self._fatal
+        if fatal is not None:
             raise RuntimeError(
                 "staging consumer died on a layout/config mismatch — every "
                 "batch would fail; fix the builder/staging config disagreement"
-            ) from self._fatal
+            ) from fatal
 
     def _get_ready(self, timeout: Optional[float]):
         """queue.get that stays responsive to a consumer death: waits in
@@ -625,11 +629,18 @@ class StagingBuffer:
         with self._stats_lock:
             out = dict(self._stats)
         out["ready_batches"] = self._ready.qsize()
-        out["pending_rollouts"] = len(self._pending)
+        # len() of a list the consumer thread appends/deletes is one
+        # GIL-atomic C call; a gauge that drifts by one in-flight frame
+        # is acceptable and a lock here would serialize every scrape
+        # against the packer.
+        out["pending_rollouts"] = len(self._pending)  # graftlint: disable=THR001(one GIL-atomic len read; gauge may drift by one in-flight frame)
         # heartbeat gauge: actors heard from within the window (dict reads
         # are atomic enough; values drift by at most one frame)
         cutoff = time.monotonic() - self.heartbeat_window_s
-        seen = dict(self._actor_seen)  # snapshot; pruning lives in _ingest
+        # dict() of the consumer-written heartbeat map is a single
+        # GIL-atomic snapshot copy; item writes land entirely before or
+        # entirely after it.
+        seen = dict(self._actor_seen)  # graftlint: disable=THR001(one GIL-atomic dict-copy snapshot; pruning lives in _ingest on the sole writer thread)
         out["active_actors"] = sum(1 for t in seen.values() if t >= cutoff)
         if self._reservoir is not None:
             for k, v in self._reservoir.stats().items():

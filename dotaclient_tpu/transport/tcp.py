@@ -165,8 +165,12 @@ class BrokerServer:
         self._thread.start()
         if not self._started.wait(10):
             raise RuntimeError("broker server failed to start (timeout)")
-        if self._boot_error is not None:
-            raise RuntimeError(f"broker server failed to start: {self._boot_error}") from self._boot_error
+        # Single atomic read of the worker-written error: the _started
+        # wait above orders the write before this load, and the local
+        # binding means the check and the raise see one value.
+        boot_error = self._boot_error
+        if boot_error is not None:
+            raise RuntimeError(f"broker server failed to start: {boot_error}") from boot_error
         return self
 
     def _run(self):
@@ -189,9 +193,13 @@ class BrokerServer:
             loop.close()
 
     def stop(self):
-        if self._loop is not None and not self._loop.is_closed():
+        # Single atomic read: the loop thread rebinds _loop once at boot;
+        # a local ref keeps the aliveness check and the call_soon from
+        # racing a concurrent rebind observation.
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
             try:
-                self._loop.call_soon_threadsafe(self._stop_ev.set)
+                loop.call_soon_threadsafe(self._stop_ev.set)
             except RuntimeError:
                 pass  # loop exited between the check and the call
         if self._thread:

@@ -69,3 +69,27 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+# --------------------------------------------------------------- lockcheck
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def lockcheck():
+    """Instrumented-lock race harness (dotaclient_tpu/analysis/lockcheck):
+    patches threading.Lock/RLock for the duration of the test — but only
+    locks CREATED by repo code are instrumented; stdlib/JAX internals
+    keep native locks. Yields the LockMonitor; assert on
+    monitor.inversions / monitor.over_held / monitor.report() in the
+    test. Production code never imports the module — this fixture is the
+    only enablement path, so shipping binaries stay inert."""
+    from dotaclient_tpu.analysis.lockcheck import LockMonitor
+
+    monitor = LockMonitor()
+    monitor.install()
+    try:
+        yield monitor
+    finally:
+        monitor.uninstall()

@@ -1,0 +1,19 @@
+"""Mini config for the OBS-rule fixtures (mirrors the real config.py
+shape: flat dataclasses, nested via default_factory). Never imported."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ObsMini:
+    enabled: bool = False
+    metrics_port: int = 0
+
+
+@dataclass
+class LearnerConfig:
+    batch_size: int = 8
+    seq_len: int = 4
+    # OBS003: defined, exposed as --dead_flag, consumed nowhere
+    dead_flag: int = 0
+    obs: ObsMini = field(default_factory=ObsMini)

@@ -1,0 +1,52 @@
+"""Known-BAD corpus for the JAX rules. Never imported — AST only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def host_sync_step(state, batch):
+    loss = jnp.mean(batch)
+    # JAX001: .item() forces a device→host sync inside the jit
+    scale = loss.item()
+    # JAX001: float() on a tracer concretizes
+    bias = float(loss)
+    # JAX001: np.asarray materializes traced data on the host
+    host = np.asarray(batch)
+    # JAX001: print runs at trace time only / forces a callback
+    print("loss", loss)
+    # JAX001: device_get is a blocking transfer
+    pulled = jax.device_get(loss)
+    # JAX001: one traced leaf poisons a mixed shape expression — the
+    # .shape factor must not exempt the float() on `loss`
+    mixed = float(loss * batch.shape[0])
+    return state + scale + bias + host.sum() + pulled + mixed
+
+
+@jax.jit
+def tracer_branch(x, threshold):
+    # JAX002: Python `if` on a data parameter — trace-time error or
+    # per-value recompile
+    if threshold > 0:
+        return x * 2
+    return x
+
+
+def sharded_body(x):  # graftlint: jit-region
+    # JAX001 via the explicit marker: helpers only reachable through a
+    # shard_map callable still get linted
+    return int(x)
+
+
+def _impl(params, mode, x):
+    return x if mode == "train" else x * 0.5
+
+
+wrapped = jax.jit(_impl, static_argnames=("mode",))
+
+
+def caller(params, x):
+    # JAX003: a lambda literal in a static position is a fresh cache
+    # entry per call — unbounded recompiles
+    return wrapped(params, lambda: "train", x)

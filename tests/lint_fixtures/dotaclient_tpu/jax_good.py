@@ -1,0 +1,38 @@
+"""Known-GOOD corpus for the JAX rules: shape arithmetic, lax control
+flow, hashable statics. Never imported — AST only. Zero findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_step(state, batch):
+    # shape/dtype reads are static at trace time — exempt
+    if batch.shape[0] > 1:
+        batch = batch.reshape(batch.shape[0], -1)
+    rows = int(batch.shape[0])
+    cols = float(np.asarray(batch.shape).prod() // max(rows, 1))
+    loss = jnp.mean(batch) * cols
+    # data-dependent control flow the sanctioned way
+    scaled = jax.lax.cond(loss > 0, lambda x: x * 2.0, lambda x: x, loss)
+    return state + scaled
+
+
+def _impl(params, mode, x):
+    return x if mode == "train" else x * 0.5
+
+
+wrapped = jax.jit(_impl, static_argnames=("mode",))
+
+
+def caller(params, x):
+    # hashable static (a str literal): stable cache key
+    return wrapped(params, "train", x)
+
+
+def host_side(batch):
+    # host code may sync freely — no jit region here
+    arr = np.asarray(batch)
+    print("rows", arr.shape[0])
+    return float(arr.sum())

@@ -1,0 +1,10 @@
+"""Mini scalar registry for the OBS001 fixtures. Never imported."""
+
+SCALARS = {
+    "good_scalar": "a documented scalar",
+    "loss": "a documented loss",
+}
+
+PREFIXES = {
+    "fam_": "a documented dynamic family",
+}

@@ -1,0 +1,431 @@
+"""Instrumented-lock race harness tests (dotaclient_tpu/analysis/
+lockcheck.py): the dynamic half of the THR rules.
+
+The deterministic tests drive inversions/holds directly — an order
+violation is a property of the acquisition GRAPH, so it is detectable
+from one thread without ever constructing the actual deadlock. The
+nightly soak runs a real StagingBuffer + WeightPublisher + Watchdog
+composition under instrumentation and asserts the production lock graph
+stays clean (marked nightly AND slow: the `-m 'not slow'` quick filter
+overrides the addopts nightly exclusion).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from dotaclient_tpu.analysis.lockcheck import LockMonitor
+
+
+def test_deliberately_inverted_pair_is_detected(lockcheck):
+    """Acceptance bar: the fixture detects an A→B / B→A inversion."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    assert lockcheck.inversions == []  # one order seen: no verdict yet
+    with b:
+        with a:
+            pass
+    assert len(lockcheck.inversions) == 1
+    inv = lockcheck.inversions[0]
+    assert inv["first"] != inv["then"]
+    assert "test_lockcheck.py" in inv["first"]
+
+
+def test_repeated_inversion_reports_once(lockcheck):
+    """A hot loop re-nesting a known-inverted pair mints ONE report, not
+    one per iteration — a real inversion in the 3 s production soak
+    would otherwise bury its single distinct cycle in thousands of
+    duplicate entries."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    for _ in range(100):
+        with b:
+            with a:
+                pass
+    assert len(lockcheck.inversions) == 1
+
+
+def test_inversion_detected_across_threads(lockcheck):
+    """The cross-thread shape of the same bug: worker takes A→B, main
+    takes B→A (sequenced by an event so the test can never deadlock)."""
+    a = threading.Lock()
+    b = threading.Lock()
+    done = threading.Event()
+
+    def worker():
+        with a:
+            with b:
+                pass
+        done.set()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert done.wait(5)
+    t.join(5)
+    with b:
+        with a:
+            pass
+    assert len(lockcheck.inversions) == 1
+    assert lockcheck.inversions[0]["conflicts_with"]["thread"] != threading.current_thread().name
+
+
+def test_three_lock_cycle_is_detected(lockcheck):
+    """No pair is ever reversed, but A→B, B→C, C→A closes a cycle that
+    deadlocks under a 3-way interleave — the detector must find general
+    cycles, not just reversed pairs."""
+    a = threading.Lock()
+    b = threading.Lock()
+    c = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    assert lockcheck.inversions == []  # still acyclic
+    with c:
+        with a:
+            pass
+    assert len(lockcheck.inversions) == 1, lockcheck.inversions
+    cycle = lockcheck.inversions[0]["cycle"]
+    assert cycle[0] == cycle[-1] or len(set(cycle)) == 3, cycle
+    assert len(set(cycle)) == 3  # the three distinct creation sites
+
+
+def test_consistent_order_is_clean(lockcheck):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockcheck.inversions == []
+    assert lockcheck.acquisitions >= 6
+
+
+def test_over_held_lock_is_recorded():
+    monitor = LockMonitor(hold_threshold_s=0.02)
+    with monitor:
+        lock = threading.Lock()
+        with lock:
+            time.sleep(0.05)
+        with lock:
+            pass  # short hold: normally not recorded
+    # >= not ==: the "short" hold only needs a >20ms scheduler stall on
+    # a loaded box to be recorded too — the deliberate one must be.
+    assert any(o["held_s"] >= 0.05 for o in monitor.over_held), monitor.over_held
+    assert all("test_lockcheck.py" in o["site"] for o in monitor.over_held)
+
+
+def test_condition_on_instrumented_lock_roundtrips(lockcheck):
+    """threading.Condition built on an instrumented lock must work — the
+    WeightPublisher/checkpoint mirror pattern."""
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    box = []
+
+    def producer():
+        with cond:
+            box.append(1)
+            cond.notify()
+
+    t = threading.Thread(target=producer)
+    with cond:
+        t.start()
+        assert cond.wait_for(lambda: box, timeout=5)
+    t.join(5)
+    assert box == [1]
+    assert lockcheck.inversions == []
+
+
+def test_default_condition_lock_is_instrumented(lockcheck):
+    """threading.Condition() with no lock (the WeightPublisher/_mirror
+    pattern): its backing RLock would be created inside threading.py and
+    escape the scope filter — the patched Condition factory attributes
+    it to the Condition() call site instead."""
+    cond = threading.Condition()
+    assert hasattr(cond._lock, "site")
+    assert "test_lockcheck.py" in cond._lock.site
+    with cond:
+        cond.notify_all()
+    assert lockcheck.acquisitions >= 1
+
+
+def test_condition_wait_is_not_counted_as_holding():
+    """waiting is not holding: a long cond.wait must not produce an
+    over_held record, but a long hold WITHOUT waiting must."""
+    monitor = LockMonitor(hold_threshold_s=0.05)
+    with monitor:
+        cond = threading.Condition()
+        with cond:
+            cond.wait(timeout=0.25)  # releases the lock for the wait
+        # if waiting counted as holding, held_s would be >= the 0.25s
+        # wait; threshold-scale entries from a scheduler stall are not
+        # the bug this test is about
+        waited = [o for o in monitor.over_held if o["held_s"] >= 0.2]
+        assert waited == [], monitor.over_held
+        with cond:
+            time.sleep(0.1)  # genuinely held past the threshold
+    assert any(o["held_s"] >= 0.1 for o in monitor.over_held), monitor.over_held
+
+
+def test_cross_thread_release_leaves_no_phantom(lockcheck):
+    """threading.Lock legally allows acquire-in-A/release-in-B handoff;
+    the releasing thread must strip the entry from the ACQUIRING
+    thread's held-stack, or every later acquisition on A records a
+    false phantom→X order edge."""
+    handoff = threading.Lock()
+    other = threading.Lock()
+    handoff.acquire()
+    t = threading.Thread(target=handoff.release)
+    t.start()
+    t.join(5)
+    with other:  # a phantom would mint a handoff→other edge here
+        pass
+    report = lockcheck.report()
+    assert report["edges"] == 0, report
+    assert lockcheck.inversions == []
+
+
+def test_handoff_stale_timestamp_does_not_inflate_later_hold():
+    """Acquire timestamps ride in the holder entries, not a per-thread
+    clock: after an acquire-in-A/release-in-B handoff, a stale A-side
+    timestamp would make A's NEXT release of the same lock compute
+    held_s from the long-gone original acquire — a false over_held from
+    the harness that exists to report real ones."""
+    monitor = LockMonitor(hold_threshold_s=0.2)
+    with monitor:
+        lock = threading.Lock()
+        lock.acquire()  # main acquires...
+        t = threading.Thread(target=lock.release)
+        t.start()
+        t.join(5)  # ...worker releases (handoff out)
+        time.sleep(0.25)  # a stale main-side timestamp now exceeds the threshold
+        got = threading.Event()
+        done = threading.Event()
+
+        def reacquire():
+            lock.acquire()
+            got.set()
+            done.wait(5)
+
+        t2 = threading.Thread(target=reacquire, daemon=True)
+        t2.start()
+        assert got.wait(5)
+        lock.release()  # handoff back: main releases the worker's ~0ms hold
+        done.set()
+        t2.join(5)
+    fake = [o for o in monitor.over_held if o["held_s"] >= 0.2]
+    assert fake == [], monitor.over_held
+
+
+def test_handoff_gap_reacquire_keeps_the_live_hold():
+    """The race inside a handoff release: A holds, B releases, and A
+    re-acquires in the gap between B's real release and B's bookkeeping
+    callback. B's release must consume A's OLDEST entry (the phantom
+    from the original acquire), not the live re-acquire — eating the
+    live timestamp leaves the stale phantom to inflate A's real release
+    into a false over_held. The gap is reproduced deterministically by
+    running B's two release steps (real release, then bookkeeping)
+    around A's re-acquire."""
+    monitor = LockMonitor(hold_threshold_s=0.05)
+    with monitor:
+        lock = threading.Lock()
+        lock.acquire()  # A (main): holders = [(A, t0)]
+        now = time.monotonic()
+        lock._real.release()  # B's step 1: the real handoff release
+        time.sleep(0.1)  # t0 goes stale past the threshold
+        lock.acquire()  # A re-acquires in the gap: [(A, t0), (A, t1)]
+        t = threading.Thread(target=monitor.on_released, args=(lock, now))
+        t.start()  # B's step 2: bookkeeping must strip the (A, t0) phantom
+        t.join(5)
+        lock.release()  # A's real release of the ~0ms live hold
+    fake = [o for o in monitor.over_held if o["held_s"] >= 0.05]
+    assert fake == [], monitor.over_held
+
+
+def test_handoff_over_held_blames_the_holder():
+    """On a handoff release the current thread is just the messenger —
+    the over_held report must name the thread that HELD the lock."""
+    monitor = LockMonitor(hold_threshold_s=0.05)
+    with monitor:
+        lock = threading.Lock()
+        lock.acquire()  # MainThread holds...
+        time.sleep(0.1)  # ...past the threshold
+        t = threading.Thread(target=lock.release, name="releaser")
+        t.start()
+        t.join(5)
+    blamed = [o["thread"] for o in monitor.over_held if o["held_s"] >= 0.1]
+    assert blamed == ["MainThread"], monitor.over_held
+
+
+def test_nested_condition_wait_restores_all_hold_levels():
+    """A depth-2 `with cond:` hold around a wait(): _release_save drops
+    both recorded levels, so _acquire_restore must mirror both back —
+    restoring one entry would starve the OUTER release's bookkeeping
+    (its hold time and order edges silently vanish)."""
+    monitor = LockMonitor(hold_threshold_s=0.05)
+    with monitor:
+        cond = threading.Condition()
+        with cond:
+            with cond:
+                cond.wait(timeout=0.02)
+                time.sleep(0.1)  # genuinely held past the threshold, post-wait
+        assert cond._lock._holders == []  # fully released, no leftovers
+    # BOTH releases must see the restore timestamp: inner ~0.1s,
+    # outer ~0.1s+ε — a single restored entry yields only one report
+    long_holds = [o for o in monitor.over_held if o["held_s"] >= 0.1]
+    assert len(long_holds) == 2, monitor.over_held
+
+
+def test_scope_root_none_instruments_everything(tmp_path):
+    """scope_root=None disables the creation-site filter — the fixture
+    corpus use case, where lint fixtures live under a tmp path far from
+    the repo checkout."""
+    src = "import threading\nlock = threading.Lock()\n"
+    corpus = tmp_path / "corpus_mod.py"
+    corpus.write_text(src)
+    with LockMonitor(scope_root=None) as monitor:
+        ns = {}
+        exec(compile(src, str(corpus), "exec"), ns)
+        # thread bootstrap under instrument-everything: a new thread's
+        # Event/Condition are instrumented too, and mid-bootstrap
+        # current_thread() would mint a _DummyThread whose own Event
+        # re-enters the monitor — must not recurse (see _thread_name)
+        ran = []
+        t = threading.Thread(target=lambda: ran.append(1))
+        t.start()
+        t.join(5)
+        assert ran == [1]
+    assert hasattr(ns["lock"], "site")
+    assert str(corpus) in ns["lock"].site
+
+
+def test_out_of_scope_locks_stay_native(lockcheck):
+    """stdlib/queue/JAX locks must not be instrumented — only locks
+    created by repo files are wrapped."""
+    import queue
+
+    q = queue.Queue()
+    q.put(1)
+    assert q.get() == 1
+    # queue's internal mutex was created inside the stdlib → native type
+    assert not hasattr(q.mutex, "site")
+
+
+def test_uninstalled_monitor_locks_go_inert():
+    """A lock that outlives its monitor in module/registry state (a
+    broker hub, a cached transport) must stop feeding the dead graph
+    after uninstall — no acquisition counting, no over_held growth, no
+    phantom holder entries — while still working as the wrapped
+    native."""
+    monitor = LockMonitor(hold_threshold_s=0.01)
+    with monitor:
+        lock = threading.Lock()
+    base = monitor.acquisitions
+    lock.acquire()
+    time.sleep(0.05)  # would exceed the threshold if still instrumented
+    lock.release()
+    assert monitor.acquisitions == base
+    assert monitor.over_held == []
+    assert lock._holders == []
+    assert lock.acquire(False)  # still a functioning lock
+    lock.release()
+
+
+def test_uninstall_restores_native_factory():
+    monitor = LockMonitor()
+    native = threading.Lock
+    monitor.install()
+    try:
+        assert threading.Lock is not native
+    finally:
+        monitor.uninstall()
+    assert threading.Lock is native
+    lock = threading.Lock()
+    assert not hasattr(lock, "site")
+
+
+def test_nonblocking_and_timeout_acquire(lockcheck):
+    lock = threading.Lock()
+    assert lock.acquire(blocking=False)
+    assert not lock.acquire(blocking=False)  # failed acquire: no record
+    lock.release()
+    assert lock.acquire(timeout=1)
+    lock.release()
+    assert lockcheck.inversions == []
+
+
+@pytest.mark.nightly
+@pytest.mark.slow
+def test_production_lock_graph_soak(lockcheck):
+    """Run the real staging+publisher+watchdog thread composition under
+    instrumentation for a few hundred frames and assert the production
+    lock graph has no inversions and no over-held locks (threshold is
+    the monitor default, far above any snapshot-sized critical
+    section)."""
+    import numpy as np
+
+    from dotaclient_tpu.config import LearnerConfig, WatchdogConfig
+    from dotaclient_tpu.obs.watchdog import Watchdog
+    from dotaclient_tpu.runtime.learner import WeightPublisher
+    from dotaclient_tpu.runtime.staging import StagingBuffer
+    from dotaclient_tpu.transport.base import connect
+    from dotaclient_tpu.transport.serialize import serialize_rollout
+    from tests.test_transport import make_rollout
+
+    L, H = 4, 8
+    cfg = LearnerConfig(batch_size=4, seq_len=L, native_packer=False)
+    cfg.policy.lstm_hidden = H
+    broker = connect("mem://lockcheck-soak")
+    version = {"v": 0}
+    staging = StagingBuffer(cfg, broker, version_fn=lambda: version["v"]).start()
+    publisher = WeightPublisher(broker).start()
+    latest = {"loss": 1.0}
+    watchdog = Watchdog(
+        WatchdogConfig(enabled=True, interval_s=0.01),
+        lambda: dict(latest),
+        lambda: version["v"],
+    ).start()
+
+    frames = [
+        serialize_rollout(make_rollout(L=L, H=H, version=v, actor_id=v % 3, seed=v))
+        for v in range(4)
+    ]
+    try:
+        deadline = time.monotonic() + 3.0
+        i = 0
+        while time.monotonic() < deadline:
+            broker.publish_experience(frames[i % len(frames)])
+            publisher.submit({"w": np.ones(4, np.float32)}, i)
+            if i % 10 == 0:
+                staging.stats()
+                watchdog.verdict()
+                version["v"] = min(version["v"] + 1, 3)
+                staging.get_batch(timeout=0.01)
+            i += 1
+            if i % 50 == 0:
+                time.sleep(0.01)
+    finally:
+        watchdog.stop()
+        staging.stop()
+        publisher.stop()
+
+    report = lockcheck.report()
+    assert report["inversions"] == [], report
+    # the 0.2s default threshold is within reach of a GC pause or
+    # scheduler stall on a loaded 1-core CI box; a REAL over-held
+    # production lock (I/O or compute under a snapshot lock) shows up
+    # as a second-scale hold
+    stuck = [o for o in report["over_held"] if o["held_s"] > 1.0]
+    assert stuck == [], report["over_held"]
+    assert report["acquisitions"] > 100
