@@ -365,6 +365,23 @@ class ActorConfig:
     # usage is ADVANTAGEOUS (scripts/ab_cast.py trains with and without);
     # never set in production.
     disable_cast: bool = False
+    # Vectorized actor fleet (runtime/actor.py VectorActor): one process
+    # drives this many env sessions on a single asyncio loop, gathering
+    # their observations into ONE batched jit inference call per tick
+    # (lax.map over rows — bit-identical to stepping each env alone) so
+    # per-dispatch framework overhead amortizes across envs. 1 = the
+    # classic one-env-per-process path, byte-for-byte unchanged.
+    # ACTOR_FLEET.json holds the measured offered-rate curve that picks
+    # the production default. Scripted opponents batch across envs;
+    # self/league actors run envs_per_process concurrent sessions per
+    # loop instead (each already batches its own heroes per jit call).
+    envs_per_process: int = 1
+    # Bounded gather window for the batched inference tick, seconds: the
+    # batcher fires as soon as every env slot has submitted, and no later
+    # than this after the FIRST submission of the tick — a slow gRPC
+    # observe() can stall its own env, never the whole batch (partial
+    # batches are padded to capacity and the pad rows' results dropped).
+    gather_window_s: float = 0.005
     obs: ObsConfig = field(default_factory=ObsConfig)
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     seed: int = 0

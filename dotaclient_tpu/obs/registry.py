@@ -85,6 +85,14 @@ SCALARS: Dict[str, str] = {
     "compute_last_compile_s": "wall seconds of the most recent compile",
     "compute_flops_per_sec": "achieved model FLOP/s (ops/flops.py analytic count)",
     "compute_mfu": "cumulative model-FLOPs utilization vs platform peak (TPU only)",
+    # --- vector actor fleet (runtime/actor.py InferenceBatcher) --------
+    # Emitted by InferenceBatcher.stats() / VectorActor.stats():
+    # bench_actors.py commits them into ACTOR_FLEET.json, and a
+    # metrics-serving actor exports them as scrape gauges.
+    "actor_offered_steps_per_sec": "real env steps offered by this process per second",
+    "actor_batch_occupancy": "mean real-rows / capacity of the batched inference tick",
+    "actor_gather_wait_s": "mean per-tick wait assembling the batch (bounded by --gather_window_s)",
+    "actor_jit_step_s": "mean per-tick batched jit inference latency (incl. the one device_get)",
     # --- obs watchdog (dotaclient_tpu/obs/watchdog.py) -----------------
     "watchdog_ok": "1 while /healthz serves 200, 0 once tripped",
     "watchdog_strikes": (

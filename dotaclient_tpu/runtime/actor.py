@@ -18,6 +18,19 @@ TPU-first differences from the reference:
   first weight broadcast (the reference downloads a pretrained
   state_dict or waits);
 - rollouts go out in the pickle-free wire format (transport/serialize).
+
+Vectorized fleet mode (`--envs_per_process M`, the SEED RL / Sample
+Factory inference-server move): one process drives M env sessions on a
+single asyncio loop. Each env runs the SAME episode loop as the classic
+actor, but its per-tick policy step is submitted to a shared
+`InferenceBatcher` that gathers up to M requests (bounded by
+`--gather_window_s` so one slow observe() can't stall the batch), pads
+partial batches to capacity, and runs ONE jit call per tick — the
+batch-1 dispatch overhead that dominates the classic path amortizes
+across all M envs. Per-env rng streams and a lax.map row layout keep
+the batched step bit-identical to stepping each env alone
+(tests/test_actor_fleet.py); scripts/bench_actors.py measures the
+offered-rate curve into ACTOR_FLEET.json.
 """
 
 from __future__ import annotations
@@ -160,14 +173,8 @@ async def reset_env_stub(actor) -> None:
             pass
 
 
-def make_actor_step(cfg: ActorConfig):
-    """jit'd single-step inference: sampling stays on device.
-
-    The rng split happens INSIDE the compiled program and the advanced
-    rng is returned as a carry — a host-side jax.random.split per tick
-    is a second compiled dispatch that costs ~35% of the whole actor
-    step at B=1 (measured r3: 925 → 1,424 steps/s fused, 1 CPU core).
-    """
+def _check_actor_policy(cfg: ActorConfig) -> None:
+    """Shared validation for both actor-step builders."""
     if cfg.policy.arch == "transformer" and cfg.policy.tf_context < cfg.rollout_len:
         # The cache is reset every chunk (next_chunk), so a capacity >=
         # rollout_len means it never wraps mid-chunk. A wrap would slide
@@ -178,15 +185,57 @@ def make_actor_step(cfg: ActorConfig):
             f"the KV cache would wrap mid-chunk and acting context would no "
             f"longer match the learner's chunk-local re-eval"
         )
-    net = P.PolicyNet(cfg.policy)
 
-    @jax.jit
-    def step(params, state, obs, rng):
+
+def _actor_step_row(net):
+    """The per-tick inference body shared by the B=1 step and the
+    vectorized fleet's batched step: rng split + policy apply + masked
+    sample + joint log-prob, all inside the compiled program."""
+
+    def row(params, state, obs, rng):
         rng, key = jax.random.split(rng)
         new_state, out = net.apply(params, state, obs)
         action = ad.sample(key, out.dist)
         logp = ad.log_prob(out.dist, action)
         return new_state, action, logp, out.value, rng
+
+    return row
+
+
+def make_actor_step(cfg: ActorConfig):
+    """jit'd single-step inference: sampling stays on device.
+
+    The rng split happens INSIDE the compiled program and the advanced
+    rng is returned as a carry — a host-side jax.random.split per tick
+    is a second compiled dispatch that costs ~35% of the whole actor
+    step at B=1 (measured r3: 925 → 1,424 steps/s fused, 1 CPU core).
+    """
+    _check_actor_policy(cfg)
+    step = jax.jit(_actor_step_row(P.PolicyNet(cfg.policy)))
+    return step
+
+
+def make_batched_actor_step(cfg: ActorConfig):
+    """jit'd M-row inference tick for the vectorized fleet: stacked
+    per-env (state, obs, rng) rows in, per-row (state', action, logp,
+    value, rng') out, ONE dispatch for the whole fleet.
+
+    Rows keep the single-path's exact [1, ...] inner shapes and run
+    through `lax.map` — sequentially INSIDE one compiled program — so
+    every row is bit-identical to make_actor_step's B=1 call on the same
+    inputs regardless of which other envs share the tick (the
+    occupancy-invariance partial batches rely on). vmap was measured
+    ~25% faster at M=8 but shifts f32 matmul accumulation by last-ULP
+    per batch size on CPU, breaking that contract; the dominant win —
+    amortizing the batch-1 dispatch overhead M× — survives lax.map
+    (539 → 3,512 steps/s at flagship shapes, M=8, 1 CPU core).
+    """
+    _check_actor_policy(cfg)
+    row = _actor_step_row(P.PolicyNet(cfg.policy))
+
+    @jax.jit
+    def step(params, state, obs, rngs):
+        return jax.lax.map(lambda sor: row(params, *sor), (state, obs, rngs))
 
     return step
 
@@ -312,6 +361,7 @@ class Actor:
         broker: Broker,
         actor_id: int = 0,
         stub: Optional[AsyncDotaServiceStub] = None,
+        params=None,
     ):
         self.cfg = cfg
         self.broker = broker
@@ -319,7 +369,11 @@ class Actor:
         # grpc.aio channels bind to the running event loop — create lazily
         # inside run_episode, not here (__init__ runs outside the loop).
         self._stub = stub
-        self.params = P.init_params(cfg.policy, jax.random.PRNGKey(cfg.seed))
+        # `params` lets an owning VectorActor share one param tree across
+        # its env workers instead of re-tracing init_params per env.
+        self.params = (
+            params if params is not None else P.init_params(cfg.policy, jax.random.PRNGKey(cfg.seed))
+        )
         self.version = 0
         self.step_fn = make_actor_step(cfg)
         self.rng = jax.random.PRNGKey(cfg.seed * 9973 + actor_id)
@@ -331,13 +385,7 @@ class Actor:
         self.steps_done = 0
         self.episodes_done = 0
         self.rollouts_published = 0
-        # Observability (--obs.*, dotaclient_tpu/obs/): when enabled the
-        # actor trace-stamps each published chunk (DTR2 wire extension)
-        # and keeps a flight-recorder ring; None = byte-identical legacy
-        # DTR1 frames and zero extra work.
-        from dotaclient_tpu.obs import ObsRuntime
-
-        self.obs = ObsRuntime.create(cfg.obs, role=f"actor{actor_id}")
+        self.obs = self._make_obs_runtime()
         # ±1 result of the last finished episode, 0.0 for a decided draw
         # (episode ended with no winning team), None while in flight or
         # after an abandoned episode — read by the evaluator and the
@@ -346,6 +394,17 @@ class Actor:
         # kill-switch clock: boot counts as "fresh" so a learner that is
         # still compiling doesn't kill its actors
         self.last_weight_time = time.monotonic()
+
+    def _make_obs_runtime(self):
+        """Observability (--obs.*, dotaclient_tpu/obs/): when enabled the
+        actor trace-stamps each published chunk (DTR2 wire extension)
+        and keeps a flight-recorder ring; None = byte-identical legacy
+        DTR1 frames and zero extra work. The vector fleet's env workers
+        override this to share their owner's single runtime (one ring,
+        one set of process handlers — not M)."""
+        from dotaclient_tpu.obs import ObsRuntime
+
+        return ObsRuntime.create(self.cfg.obs, role=f"actor{self.actor_id}")
 
     # ------------------------------------------------------------- weights
 
@@ -378,6 +437,17 @@ class Actor:
             obs.action_mask[F.ACT_CAST] = False
         return obs, handles
 
+    async def _policy_step(self, state, obs: F.Observation):
+        """ONE policy inference for the current (unbatched) obs →
+        (state', action, logp, value), each with the [1, ...] batch axis
+        the chunk format stores. The base actor dispatches its own B=1
+        jit call and advances its own rng carry; the vector fleet's env
+        workers override this to await the shared InferenceBatcher —
+        run_episode is otherwise identical in both modes."""
+        obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
+        state, action, logp, value, self.rng = self.step_fn(self.params, state, obs_b, self.rng)
+        return state, action, logp, value
+
     async def run_episode(self) -> float:
         cfg = self.cfg
         self.last_win = None
@@ -409,8 +479,7 @@ class Actor:
         obs, handles = self._featurize(world)
 
         while not done:
-            obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
-            state, action, logp, value, self.rng = self.step_fn(self.params, state, obs_b, self.rng)
+            state, action, logp, value = await self._policy_step(state, obs)
 
             hero = F.find_hero(world, self.player_id)
             if hero is not None:
@@ -504,6 +573,368 @@ class Actor:
             )
 
 
+class InferenceBatcher:
+    """Per-process batched inference server for the vector fleet.
+
+    Env coroutines submit one (state, obs, rng) step request each via
+    `step()`; the `run()` driver coroutine gathers requests into a tick:
+    it fires as soon as `capacity` requests are pending, and no later
+    than `window_s` after the tick's FIRST request — a slow gRPC
+    observe() stalls only its own env, never the batch. Partial ticks
+    are padded to capacity (ONE jit signature, zero recompiles) with the
+    pad rows masked out of the scatter; occupancy, gather wait, and jit
+    latency are metered into the `actor_*` scalars (obs/registry.py).
+
+    Everything here runs on one asyncio loop (requests, gather, the jit
+    call itself), so there is no locking; `stats()` may be read from
+    another thread and takes single-read snapshots of the counters.
+    """
+
+    # Queue sentinel: stop() pushes it so a driver blocked on get() wakes
+    # even when its Task.cancel is swallowed by the Python 3.10 wait_for
+    # race (inner future completing concurrently with the cancel leaves
+    # the task "un-cancelled" — observed as a teardown deadlock here).
+    _SENTINEL = object()
+
+    def __init__(self, cfg: ActorConfig, params_fn, capacity: int, window_s: Optional[float] = None):
+        if capacity < 1:
+            raise ValueError(f"InferenceBatcher capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.window_s = cfg.gather_window_s if window_s is None else window_s
+        self._params_fn = params_fn
+        self._step = make_batched_actor_step(cfg)
+        self._queue: "asyncio.Queue" = asyncio.Queue()
+        self._stopped = False
+        # Fixed pad row: zero obs/state and a constant rng whose advanced
+        # value is never written back anywhere — pad rows burn compute
+        # (lax.map walks them too) but cannot perturb any real row.
+        self._pad_state = jax.tree.map(np.asarray, P.initial_state(cfg.policy, (1,)))
+        self._pad_obs = F.zeros_observation()
+        self._pad_rng = np.asarray(jax.random.PRNGKey(0))
+        # Meters (driver-coroutine-written; stats() snapshots).
+        self._ticks = 0
+        self._rows = 0
+        self._gather_wait_s = 0.0
+        self._jit_s = 0.0
+        self._first_tick_t: Optional[float] = None
+        self._last_tick_t: Optional[float] = None
+
+    async def step(self, state, obs: F.Observation, rng):
+        """Submit one env's tick → (state', action, logp, value, rng'),
+        shaped exactly like make_actor_step's return for that env alone
+        (bit-identical to it, by the lax.map row contract)."""
+        if self._stopped:
+            # after stop() nothing will ever serve the queue — failing
+            # loudly beats an await that can never resolve
+            raise RuntimeError("InferenceBatcher is stopped")
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait((state, obs, rng, fut))
+        return await fut
+
+    def stop(self) -> None:
+        """Flag the driver down and wake it if it's blocked on the queue.
+        Cancellation alone is NOT sufficient: Python 3.10's wait_for can
+        swallow a Task.cancel that races an arriving request, leaving the
+        driver live forever and deadlocking the caller's teardown join."""
+        self._stopped = True
+        self._queue.put_nowait(self._SENTINEL)
+
+    async def run(self) -> None:
+        """Driver loop: gather → pad → ONE jit call → scatter. Stop via
+        stop() (or task cancellation); in-flight futures are failed so no
+        env worker can await a result that will never come."""
+        reqs: list = []
+        try:
+            while not self._stopped:
+                first = await self._queue.get()
+                if first is self._SENTINEL:
+                    break
+                reqs = [first]
+                t0 = time.monotonic()
+                deadline = t0 + self.window_s
+                while len(reqs) < self.capacity:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        item = await asyncio.wait_for(self._queue.get(), remaining)
+                    except asyncio.TimeoutError:
+                        break
+                    if item is self._SENTINEL:
+                        self._stopped = True
+                        break
+                    reqs.append(item)
+                if self._stopped:
+                    break
+                t1 = time.monotonic()
+                self._run_tick(reqs, gather_wait=t1 - t0)
+                reqs = []
+        finally:
+            exc = RuntimeError("InferenceBatcher driver stopped")
+            for _, _, _, fut in reqs:
+                if not fut.done():
+                    fut.set_exception(exc)
+            self._fail_pending(exc)
+
+    def _run_tick(self, reqs, gather_wait: float) -> None:
+        K = len(reqs)
+        M = self.capacity
+        pad = M - K
+        states = [r[0] for r in reqs] + [self._pad_state] * pad
+        rngs = [r[2] for r in reqs] + [self._pad_rng] * pad
+        obs_rows = [r[1] for r in reqs] + [self._pad_obs] * pad
+        # Stack M unbatched rows leaf-wise, then restore the [1, ...]
+        # inner batch axis the single-env path uses — row i of the
+        # compiled program sees byte-identical shapes to a B=1 call.
+        obs_b = jax.tree.map(lambda *xs: np.stack(xs)[:, None], *obs_rows)
+        state_b = jax.tree.map(lambda *xs: np.stack(xs), *states)
+        rng_b = np.stack([np.asarray(r) for r in rngs])
+        t1 = time.monotonic()
+        out = self._step(self._params_fn(), state_b, obs_b, rng_b)
+        # ONE transfer for the whole tick; per-env slices are then cheap
+        # numpy views (the env loop re-device_gets them as no-ops).
+        out = jax.device_get(out)
+        t2 = time.monotonic()
+        for i, (_, _, _, fut) in enumerate(reqs):
+            if not fut.cancelled():
+                fut.set_result(jax.tree.map(lambda x: x[i], out))
+        self._ticks += 1
+        self._rows += K
+        self._gather_wait_s += gather_wait
+        self._jit_s += t2 - t1
+        if self._first_tick_t is None:
+            self._first_tick_t = t1
+        self._last_tick_t = t2
+
+    def reset_meters(self) -> None:
+        """Zero the meters (bench use: exclude the compile/warmup ticks
+        from the measured window). Driver-loop-thread only."""
+        self._ticks = 0
+        self._rows = 0
+        self._gather_wait_s = 0.0
+        self._jit_s = 0.0
+        self._first_tick_t = None
+        self._last_tick_t = None
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        while not self._queue.empty():
+            item = self._queue.get_nowait()
+            if item is self._SENTINEL:
+                continue
+            fut = item[3]
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def stats(self) -> dict:
+        """The actor_* scalar family (obs/registry.py): offered rate,
+        mean occupancy, mean gather wait, mean jit tick latency. Single
+        reads of driver-written counters — a gauge that drifts by one
+        in-flight tick is fine, a lock on the tick path is not."""
+        ticks, rows = self._ticks, self._rows
+        first, last = self._first_tick_t, self._last_tick_t
+        elapsed = (last - first) if (first is not None and last is not None and last > first) else 0.0
+        return {
+            "actor_offered_steps_per_sec": rows / elapsed if elapsed > 0 else 0.0,
+            "actor_batch_occupancy": rows / float(max(ticks, 1) * self.capacity),
+            "actor_gather_wait_s": self._gather_wait_s / max(ticks, 1),
+            "actor_jit_step_s": self._jit_s / max(ticks, 1),
+        }
+
+
+class _BatchedEnvActor(Actor):
+    """One env slot of a VectorActor: the classic Actor episode loop with
+    its per-tick inference routed through the owner's InferenceBatcher
+    and its weight/freshness state delegated to the owner (ONE broker
+    poll and ONE param tree per process, not M)."""
+
+    def __init__(self, owner: "VectorActor", actor_id: int):
+        self.owner = owner  # before super().__init__: _make_obs_runtime reads it
+        super().__init__(owner.cfg, owner.broker, actor_id=actor_id, params=owner.params)
+
+    def _make_obs_runtime(self):
+        return self.owner.obs
+
+    async def _policy_step(self, state, obs: F.Observation):
+        state, action, logp, value, self.rng = await self.owner.batcher.step(state, obs, self.rng)
+        return state, action, logp, value
+
+    def maybe_update_weights(self) -> bool:
+        """One poll for the whole fleet — but each env syncs its OWN
+        stamped version here, i.e. only at its own chunk boundaries
+        (run_episode calls this right after each publish). The shared
+        params swap immediately for every env's next tick, so an env
+        mid-chunk samples its tail under the new policy while still
+        stamping the version its chunk STARTED under — staleness is
+        over-estimated for those rows, never under-aged (the stamp feeds
+        max_staleness drops and the ACER truncated importance weights)."""
+        updated = self.owner.maybe_update_weights()
+        self.version = self.owner.version
+        return updated
+
+    def check_weight_freshness(self) -> None:
+        check_weight_freshness(self.owner)
+
+
+class VectorActor:
+    """M env sessions, one process, one batched jit inference per tick.
+
+    Construction mirrors Actor (cfg, broker, actor_id); `envs` defaults
+    to cfg.envs_per_process. Env slot j runs with actor_id
+    `actor_id * M + j`, so its rng / env-seed streams (and therefore its
+    episodes and published frames) are exactly those of a standalone
+    Actor with that id — the property the fleet bit-equivalence test
+    pins. Drive it with `run()` (actor binary) or `episode_stream()`
+    (ActorPool envs-per-actor mode).
+    """
+
+    def __init__(
+        self,
+        cfg: ActorConfig,
+        broker: Broker,
+        actor_id: int = 0,
+        envs: Optional[int] = None,
+        params=None,
+        obs_runtime=None,
+    ):
+        M = int(envs if envs is not None else getattr(cfg, "envs_per_process", 1))
+        if M < 1:
+            raise ValueError(f"envs_per_process must be >= 1, got {M}")
+        self.cfg = cfg
+        self.broker = broker
+        self.actor_id = actor_id
+        self.params = (
+            params if params is not None else P.init_params(cfg.policy, jax.random.PRNGKey(cfg.seed))
+        )
+        self.version = 0
+        self.last_weight_time = time.monotonic()
+        self.last_win: Optional[float] = None
+        if obs_runtime is not None:
+            self.obs = obs_runtime
+        else:
+            from dotaclient_tpu.obs import ObsRuntime
+
+            self.obs = ObsRuntime.create(cfg.obs, role=f"vector{actor_id}")
+        self.batcher = InferenceBatcher(cfg, lambda: self.params, capacity=M)
+        self.envs = [_BatchedEnvActor(self, actor_id * M + j) for j in range(M)]
+
+    @classmethod
+    def from_actor(cls, actor: Actor, envs: Optional[int] = None) -> "VectorActor":
+        """Wrap a constructed classic Actor (ActorPool's envs-per-actor
+        mode): same cfg/broker/actor_id/params, M env slots. The actor's
+        ObsRuntime rides along too — it already installed the
+        process-wide crash handlers when obs is enabled, and creating a
+        second runtime would chain a duplicate recorder into them."""
+        return cls(
+            actor.cfg,
+            actor.broker,
+            actor_id=actor.actor_id,
+            envs=envs,
+            params=actor.params,
+            obs_runtime=actor.obs,
+        )
+
+    # aggregate counters, so drivers' on_episode callbacks keep working
+    @property
+    def steps_done(self) -> int:
+        return sum(e.steps_done for e in self.envs)
+
+    @property
+    def episodes_done(self) -> int:
+        return sum(e.episodes_done for e in self.envs)
+
+    @property
+    def rollouts_published(self) -> int:
+        return sum(e.rollouts_published for e in self.envs)
+
+    def stats(self) -> dict:
+        return self.batcher.stats()
+
+    def maybe_update_weights(self) -> bool:
+        """Apply a pending weight frame to the SHARED param tree (the
+        batcher serves it to every env's next tick). Env slots pick the
+        new version stamp up individually at their own chunk boundaries
+        (_BatchedEnvActor.maybe_update_weights) — pushing it here would
+        mis-stamp chunks whose early steps were sampled under the old
+        params."""
+        frame = self.broker.poll_weights()
+        if frame is None:
+            return False
+        return apply_weight_frame(self, frame, f"vector actor {self.actor_id}")
+
+    def check_weight_freshness(self) -> None:
+        check_weight_freshness(self)
+
+    async def _env_loop(self, env: _BatchedEnvActor, results: "asyncio.Queue") -> None:
+        """Per-env worker: the same episode/retry/backoff shape as
+        Actor.run, reporting completed episodes (or a fatal error) to
+        the stream queue instead of logging-and-looping."""
+        backoff = 1.0
+        while True:
+            try:
+                self.check_weight_freshness()
+                ret = await env.run_episode()
+                backoff = 1.0
+            except grpc.aio.AioRpcError as e:
+                _log.warning(
+                    "vector env %d: env rpc failed (%s); retrying in %.1fs",
+                    env.actor_id,
+                    e.code(),
+                    backoff,
+                )
+                await reset_env_stub(env)  # drop the dead subchannel
+                self.maybe_update_weights()  # stay fresh while waiting
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2.0, 30.0)
+                continue
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # incl. StaleWeightsError: surface it
+                await results.put((env, e))
+                return
+            await results.put((env, float(ret)))
+
+    async def episode_stream(self):
+        """Async generator yielding each completed episode's return (any
+        env). Starts the batcher driver + M env workers on the current
+        loop; closing the generator tears them all down."""
+        results: "asyncio.Queue" = asyncio.Queue()
+        driver = asyncio.create_task(self.batcher.run())
+        workers = [asyncio.create_task(self._env_loop(e, results)) for e in self.envs]
+        try:
+            while True:
+                env, ret = await results.get()
+                if isinstance(ret, BaseException):
+                    raise ret
+                self.last_win = env.last_win
+                yield ret
+        finally:
+            # stop() BEFORE cancel: a cancel swallowed by the 3.10
+            # wait_for race would otherwise leave the driver looping and
+            # this gather waiting on it forever.
+            self.batcher.stop()
+            for t in workers:
+                t.cancel()
+            driver.cancel()
+            await asyncio.gather(*workers, driver, return_exceptions=True)
+
+    async def run(self, num_episodes: Optional[int] = None) -> None:
+        """Run the fleet; `num_episodes` bounds TOTAL completed episodes
+        across all envs (None = forever). With --obs.enabled and a
+        metrics_port, the actor_* batcher gauges (offered rate,
+        occupancy, gather wait, jit latency) export on /metrics."""
+        if self.obs is not None:
+            self.obs.serve_metrics([self.stats])
+        try:
+            done = 0
+            async for _ in self.episode_stream():
+                done += 1
+                if num_episodes is not None and done >= num_episodes:
+                    return
+        finally:
+            if self.obs is not None:
+                self.obs.close()
+
+
 def main(argv=None):
     from dotaclient_tpu.config import parse_config
     from dotaclient_tpu.transport.base import connect as broker_connect
@@ -513,10 +944,26 @@ def main(argv=None):
     if cfg.platform:
         jax.config.update("jax_platforms", cfg.platform)
     broker = broker_connect(cfg.broker_url)
+    M = max(int(cfg.envs_per_process), 1)
     if cfg.opponent in ("self", "league"):
         from dotaclient_tpu.runtime.selfplay import SelfPlayActor
 
+        if M > 1:
+            # Self-play already batches all of a session's heroes into
+            # one jit call per tick; envs_per_process here consolidates M
+            # such sessions onto one loop (their env RPC waits overlap),
+            # without cross-session batching — sessions step different
+            # param sets (league snapshots), which can't share one call.
+            actors = [SelfPlayActor(cfg, broker, actor_id=cfg.actor_id * M + j) for j in range(M)]
+
+            async def run_all():
+                await asyncio.gather(*(a.run() for a in actors))
+
+            asyncio.run(run_all())
+            return
         actor = SelfPlayActor(cfg, broker, actor_id=cfg.actor_id)
+    elif M > 1:
+        actor = VectorActor(cfg, broker, actor_id=cfg.actor_id)
     else:
         actor = Actor(cfg, broker, actor_id=cfg.actor_id)
     asyncio.run(actor.run())

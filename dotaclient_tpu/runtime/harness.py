@@ -43,9 +43,17 @@ class ActorPool:
         make_actor: Callable[[int], object],
         n_actors: int,
         on_episode: Optional[Callable[[int, object, float], None]] = None,
+        envs_per_actor: Optional[int] = None,
     ):
         self._make_actor = make_actor
         self._on_episode = on_episode
+        # Vectorized fleet mode (runtime/actor.py VectorActor): when the
+        # built actor's cfg carries envs_per_process > 1 (or the driver
+        # passes envs_per_actor explicitly), each worker thread wraps its
+        # classic Actor into a VectorActor driving that many envs through
+        # one batched jit call per tick — every existing driver inherits
+        # batching from the --envs_per_process flag with no code change.
+        self._envs_per_actor = envs_per_actor
         self._stop = threading.Event()
         self.actors: List[object] = []
         # `dead` is incremented from N worker threads — a bare += is a
@@ -58,13 +66,43 @@ class ActorPool:
             for i in range(n_actors)
         ]
 
+    def _maybe_vectorize(self, actor):
+        """Wrap a classic Actor into a VectorActor when envs-per-actor is
+        in play. Exact-type check: SelfPlayActor (not an Actor subclass)
+        already batches its own heroes, and a VectorActor / env worker
+        must never be double-wrapped."""
+        M = self._envs_per_actor
+        if M is None:
+            M = int(getattr(getattr(actor, "cfg", None), "envs_per_process", 1) or 1)
+        if M <= 1:
+            return actor
+        from dotaclient_tpu.runtime.actor import Actor, VectorActor
+
+        if type(actor) is not Actor:
+            _log.warning(
+                "envs_per_actor=%d ignored for %s (only the scripted Actor batches across envs)",
+                M,
+                type(actor).__name__,
+            )
+            return actor
+        return VectorActor.from_actor(actor, envs=M)
+
     def _run(self, i: int) -> None:
         loop = asyncio.new_event_loop()
         try:
-            actor = self._make_actor(i)
+            actor = self._maybe_vectorize(self._make_actor(i))
             self.actors.append(actor)
 
             async def go():
+                if hasattr(actor, "episode_stream"):
+                    # VectorActor: episodes complete per-env inside one
+                    # process; the stream yields each as it lands.
+                    async for ret in actor.episode_stream():
+                        if self._on_episode is not None:
+                            self._on_episode(i, actor, float(ret))
+                        if self._stop.is_set():
+                            return
+                    return
                 while not self._stop.is_set():
                     ret = await actor.run_episode()
                     if self._on_episode is not None:

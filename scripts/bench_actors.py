@@ -1,0 +1,295 @@
+"""Offered-rate curve for the vectorized actor fleet (ACTOR_FLEET.json).
+
+VERDICT r5 directive 5: whether a host core can carry its share of the
+256-actor / 50k-offered-steps topology is measurable on CPU while the
+chip stays dark. This bench drives GENUINE actors (jit inference +
+featurize + gRPC against an in-process fake_dotaservice + wire
+serialization to a mem:// broker) and measures offered env-steps/s for
+three topologies at matched env counts N in {1, 2, 4, 8, 16}:
+
+- baseline_single: ONE classic Actor on one thread (batch-1 jit per
+  tick) — the per-process reference the dispatch-amortization story is
+  told against;
+- thread_fleet:    N classic Actors on N threads, one env each — the
+  pre-vectorization in-repo topology (ActorPool, every driver). On a
+  small host this arm exposes the real fleet pathology: GIL-serialized
+  per-step jax dispatch plus grpc-aio pollers thrashing across N event
+  loops;
+- vector:          ONE VectorActor driving N envs on one asyncio loop,
+  one batched lax.map jit call per tick (runtime/actor.py
+  InferenceBatcher).
+
+The headline ratio is vector vs thread_fleet at the SAME N — same host,
+same cores, same env server, same total envs; that is the
+"offered steps per core" question the 256-actor topology asks. The
+artifact commits the curve, the batcher meters (occupancy, gather wait,
+jit tick latency), both speedups, and the extrapolated actors-per-core
+budget.
+
+Run: python scripts/bench_actors.py [--out ACTOR_FLEET.json]
+     [--seconds 5] [--envs 1,2,4,8,16] [--policy flagship|small]
+(CI: tests/test_actor_fleet.py wraps a short curve nightly.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _policy(name: str):
+    from dotaclient_tpu.config import PolicyConfig
+
+    if name == "small":
+        return PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+    return PolicyConfig()  # flagship shapes (the production actor)
+
+
+def _cfg(env_addr: str, pol, seed: int = 1):
+    from dotaclient_tpu.config import ActorConfig
+
+    return ActorConfig(
+        env_addr=env_addr,
+        rollout_len=16,
+        max_dota_time=120.0,
+        policy=pol,
+        seed=seed,
+    )
+
+
+async def _measure_async(run_coro_fn, warmup_s, seconds, steps_fn, reset_fn=None):
+    """Start the actor coroutine, warm up (compile + first episodes),
+    optionally reset meters, then count offered steps over `seconds`."""
+    task = asyncio.ensure_future(run_coro_fn())
+    try:
+        await asyncio.sleep(warmup_s)
+        if reset_fn is not None:
+            reset_fn()
+        s0 = steps_fn()
+        t0 = time.perf_counter()
+        await asyncio.sleep(seconds)
+        steps = steps_fn() - s0
+        elapsed = time.perf_counter() - t0
+    finally:
+        task.cancel()
+        try:
+            await task
+        except BaseException:
+            pass
+    return steps, elapsed
+
+
+def bench_single(env_addr: str, pol, seconds: float, warmup_s: float) -> dict:
+    """One classic Actor, one thread, one env: batch-1 jit per tick."""
+    from dotaclient_tpu.runtime.actor import Actor
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+
+    mem.reset("bench_actors_base")
+    actor = Actor(_cfg(env_addr, pol), connect("mem://bench_actors_base"), actor_id=0)
+    steps, elapsed = asyncio.new_event_loop().run_until_complete(
+        _measure_async(actor.run, warmup_s, seconds, lambda: actor.steps_done)
+    )
+    rate = steps / elapsed if elapsed > 0 else 0.0
+    return {
+        "mode": "single_thread_single_env",
+        "offered_steps_per_sec": round(rate, 1),
+        "steps": steps,
+        "seconds": round(elapsed, 3),
+    }
+
+
+def bench_thread_fleet(env_addr: str, pol, n: int, seconds: float, warmup_s: float) -> dict:
+    """N classic Actors on N threads (ActorPool) — the one-env-per-thread
+    topology every pre-vectorization driver runs."""
+    from dotaclient_tpu.runtime.actor import Actor
+    from dotaclient_tpu.runtime.harness import ActorPool
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+
+    name = f"bench_actors_thr{n}"
+    mem.reset(name)
+
+    def make(i):
+        return Actor(_cfg(env_addr, pol), connect(f"mem://{name}"), actor_id=i)
+
+    pool = ActorPool(make, n).start()
+    # warm until every thread has built its actor and stepped (compiled)
+    deadline = time.time() + max(warmup_s * n, 60.0)
+    while time.time() < deadline:
+        if len(pool.actors) == n and all(a.steps_done > 0 for a in list(pool.actors)):
+            break
+        time.sleep(0.2)
+    s0 = sum(a.steps_done for a in list(pool.actors))
+    t0 = time.perf_counter()
+    time.sleep(seconds)
+    steps = sum(a.steps_done for a in list(pool.actors)) - s0
+    elapsed = time.perf_counter() - t0
+    pool.stop(timeout=10)
+    rate = steps / elapsed if elapsed > 0 else 0.0
+    return {
+        "threads": n,
+        "offered_steps_per_sec": round(rate, 1),
+        "steps": steps,
+        "seconds": round(elapsed, 3),
+        "dead_threads": pool.dead,
+    }
+
+
+def bench_vector(env_addr: str, pol, n: int, seconds: float, warmup_s: float) -> dict:
+    """One VectorActor at N envs/process: one batched jit call per tick."""
+    from dotaclient_tpu.runtime.actor import VectorActor
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+
+    name = f"bench_actors_v{n}"
+    mem.reset(name)
+    vec = VectorActor(_cfg(env_addr, pol), connect(f"mem://{name}"), actor_id=0, envs=n)
+    steps, elapsed = asyncio.new_event_loop().run_until_complete(
+        _measure_async(
+            vec.run, warmup_s, seconds, lambda: vec.steps_done, reset_fn=vec.batcher.reset_meters
+        )
+    )
+    rate = steps / elapsed if elapsed > 0 else 0.0
+    stats = vec.stats()
+    return {
+        "envs_per_process": n,
+        "offered_steps_per_sec": round(rate, 1),
+        "steps": steps,
+        "seconds": round(elapsed, 3),
+        "batch_occupancy": round(stats["actor_batch_occupancy"], 4),
+        "gather_wait_ms": round(stats["actor_gather_wait_s"] * 1e3, 4),
+        "jit_step_ms": round(stats["actor_jit_step_s"] * 1e3, 4),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="ACTOR_FLEET.json")
+    p.add_argument("--seconds", type=float, default=5.0, help="measured window per config")
+    p.add_argument("--warmup_seconds", type=float, default=0.0, help="0 = auto (max(3, seconds/2))")
+    p.add_argument("--envs", default="1,2,4,8,16", help="comma list of env counts to sweep")
+    p.add_argument("--policy", choices=("flagship", "small"), default="flagship")
+    p.add_argument(
+        "--skip_thread_fleet",
+        action="store_true",
+        help="skip the N-thread baseline arms (CI smoke: they are the slowest part)",
+    )
+    args = p.parse_args(argv)
+    warmup_s = args.warmup_seconds or max(3.0, args.seconds / 2.0)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # actors are CPU processes
+
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.env.service import serve
+
+    server, port = serve(FakeDotaService())
+    env_addr = f"127.0.0.1:{port}"
+    pol = _policy(args.policy)
+
+    print(f"baseline: single thread, single env ({args.policy} policy) ...", flush=True)
+    baseline = bench_single(env_addr, pol, args.seconds, warmup_s)
+    print(f"  {baseline['offered_steps_per_sec']:.0f} steps/s", flush=True)
+    base_rate = baseline["offered_steps_per_sec"] or 1.0
+
+    curve = []
+    for n in [int(x) for x in args.envs.split(",") if x.strip()]:
+        fleet = None
+        if not args.skip_thread_fleet:
+            print(f"thread fleet: {n} threads x 1 env ...", flush=True)
+            fleet = bench_thread_fleet(env_addr, pol, n, args.seconds, warmup_s)
+            print(f"  {fleet['offered_steps_per_sec']:.0f} steps/s", flush=True)
+        print(f"vector: {n} envs/process ...", flush=True)
+        row = bench_vector(env_addr, pol, n, args.seconds, warmup_s)
+        row["speedup_vs_single"] = round(row["offered_steps_per_sec"] / base_rate, 3)
+        if fleet is not None:
+            row["thread_fleet_steps_per_sec"] = fleet["offered_steps_per_sec"]
+            row["thread_fleet_dead_threads"] = fleet["dead_threads"]
+            row["speedup_vs_thread_fleet"] = round(
+                row["offered_steps_per_sec"] / (fleet["offered_steps_per_sec"] or 1.0), 3
+            )
+        print(
+            f"  {row['offered_steps_per_sec']:.0f} steps/s "
+            f"(occupancy {row['batch_occupancy']:.2f}"
+            + (
+                f", {row['speedup_vs_thread_fleet']:.2f}x vs thread fleet"
+                if fleet is not None
+                else ""
+            )
+            + ")",
+            flush=True,
+        )
+        curve.append(row)
+    server.stop(0)
+
+    # Chosen operating point: the highest-throughput N on the sweep —
+    # per-process rate keeps rising while batching amortizes dispatch,
+    # and flattens once the loop saturates on serial host work
+    # (featurize, protos); that knee is the budget a one-core pod runs.
+    best = max(curve, key=lambda r: r["offered_steps_per_sec"]) if curve else None
+    target = 50_000.0
+    extrapolation = None
+    if best is not None and best["offered_steps_per_sec"] > 0:
+        rate = best["offered_steps_per_sec"]
+        n = best["envs_per_process"]
+        extrapolation = {
+            "chosen_envs_per_process": n,
+            "per_process_offered_steps_per_sec": rate,
+            # one vector process ~= one actor core (single actor thread);
+            # the budget the 256-actor topology should plan with:
+            "actors_per_core": n,
+            "cores_for_256_actors": math.ceil(256 / n),
+            "offered_steps_per_sec_at_256_actors": round(256 / n * rate, 1),
+            "target_offered_steps_per_sec": target,
+            "processes_for_target": math.ceil(target / rate),
+            "envs_for_target": math.ceil(target / rate) * n,
+        }
+
+    out = {
+        "generated_by": "scripts/bench_actors.py",
+        "host": {
+            "cpus": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "jax": jax.__version__,
+        },
+        "policy": args.policy,
+        "seconds_per_config": args.seconds,
+        "baseline_single": baseline,
+        "curve": curve,
+        "meets_2x_bar_at_8_envs": any(
+            r["envs_per_process"] >= 8 and r.get("speedup_vs_thread_fleet", 0.0) >= 2.0
+            for r in curve
+        ),
+        "extrapolation": extrapolation,
+        "notes": (
+            "All arms share this host (actor thread(s) + in-process fake env "
+            "server + XLA intra-op pool), so rates are comparable within the "
+            "file, not across hosts. The headline ratio is vector vs the "
+            "N-thread one-env-per-thread fleet at matched N: same cores, same "
+            "env server, same total envs. The env server + featurize host "
+            "work is serial per step and does not batch — the vector curve "
+            "flattens where that share dominates; the thread fleet "
+            "additionally pays GIL-serialized batch-1 jax dispatch and "
+            "per-thread grpc-aio poller thrash."
+        ),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,359 @@
+"""Vectorized actor fleet (runtime/actor.py VectorActor/InferenceBatcher).
+
+The load-bearing contract is BITWISE occupancy-invariance: a batched
+tick must produce, for every real row, exactly the bytes the classic
+B=1 single-env path produces for that env alone — same per-env rng,
+same carries, same sampled actions, same published frames — no matter
+which other envs share the tick or how starved the gather window is.
+That is what makes `--envs_per_process` a pure topology knob rather
+than a training-semantics change.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import ActorConfig, PolicyConfig
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+from dotaclient_tpu.env.service import serve
+from dotaclient_tpu.models.policy import init_params, initial_state
+from dotaclient_tpu.runtime.actor import (
+    Actor,
+    InferenceBatcher,
+    VectorActor,
+    make_actor_step,
+)
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect as broker_connect
+from dotaclient_tpu.transport.serialize import deserialize_rollout
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+M = 3  # envs per process in the end-to-end fixture
+EPISODES_PER_ENV = 2
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture(scope="module")
+def env():
+    server, port = serve(FakeDotaService())
+    yield f"127.0.0.1:{port}"
+    server.stop(0)
+
+
+def _cfg(env_addr, **kw):
+    return ActorConfig(
+        env_addr=env_addr,
+        rollout_len=8,
+        max_dota_time=30.0,
+        policy=SMALL,
+        seed=1,
+        **kw,
+    )
+
+
+def _rand_obs(rs: np.random.RandomState) -> F.Observation:
+    """A synthetic featurized observation with plausible masks."""
+    o = F.zeros_observation()
+    return o._replace(
+        unit_feats=np.asarray(rs.randn(*o.unit_feats.shape), np.float32),
+        hero_feats=np.asarray(rs.randn(*o.hero_feats.shape), np.float32),
+        global_feats=np.asarray(rs.randn(*o.global_feats.shape), np.float32),
+        unit_mask=np.asarray(rs.rand(*o.unit_mask.shape) > 0.3),
+        action_mask=np.ones_like(o.action_mask),
+        target_mask=np.asarray(rs.rand(*o.target_mask.shape) > 0.3),
+    )
+
+
+def _assert_rows_equal(batched_row, single_row):
+    for b, s in zip(jax.tree.leaves(batched_row), jax.tree.leaves(single_row)):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(s))
+
+
+def _drive_batcher(batcher, requests):
+    """Run the driver, submit `requests` concurrently, stop, return results."""
+
+    async def go():
+        driver = asyncio.create_task(batcher.run())
+        try:
+            return await asyncio.gather(
+                *(batcher.step(s, o, r) for (s, o, r) in requests)
+            )
+        finally:
+            batcher.stop()
+            driver.cancel()
+            await asyncio.gather(driver, return_exceptions=True)
+
+    return run(go())
+
+
+# ---------------------------------------------------------------- jit level
+
+
+def test_full_batch_rows_bit_identical_to_single_path():
+    """Capacity-4 tick at full occupancy: every row's (state', action,
+    logp, value, rng') is bitwise equal to make_actor_step's B=1 call on
+    the same inputs — the tier-1 half of the acceptance criterion."""
+    cfg = ActorConfig(policy=SMALL, seed=1)
+    params = init_params(cfg.policy, jax.random.PRNGKey(1))
+    single = make_actor_step(cfg)
+    batcher = InferenceBatcher(cfg, lambda: params, capacity=4)
+    rs = np.random.RandomState(0)
+    reqs = []
+    for i in range(4):
+        state = jax.tree.map(np.asarray, initial_state(cfg.policy, (1,)))
+        # advance one real step so carries are nonzero (harder target)
+        state = jax.tree.map(
+            lambda x: np.asarray(rs.randn(*x.shape), np.float32), state
+        )
+        reqs.append((state, _rand_obs(rs), np.asarray(jax.random.PRNGKey(100 + i))))
+    results = _drive_batcher(batcher, reqs)
+    for (state, obs, rng), got in zip(reqs, results):
+        obs_b = jax.tree.map(lambda x: np.asarray(x)[None], obs)
+        want = single(params, state, obs_b, rng)
+        _assert_rows_equal(got, want)
+    assert batcher.stats()["actor_batch_occupancy"] == 1.0
+
+
+def test_partial_batch_bit_identical_and_metered():
+    """A starved gather window (2 of 4 slots submit) pads the tick; the
+    pad rows must not perturb the real rows (still bitwise equal to the
+    single path) and occupancy must meter the starvation."""
+    cfg = ActorConfig(policy=SMALL, seed=1, gather_window_s=0.01)
+    params = init_params(cfg.policy, jax.random.PRNGKey(1))
+    single = make_actor_step(cfg)
+    batcher = InferenceBatcher(cfg, lambda: params, capacity=4)
+    rs = np.random.RandomState(7)
+    reqs = [
+        (
+            jax.tree.map(lambda x: np.asarray(rs.randn(*x.shape), np.float32),
+                         initial_state(cfg.policy, (1,))),
+            _rand_obs(rs),
+            np.asarray(jax.random.PRNGKey(200 + i)),
+        )
+        for i in range(2)
+    ]
+    results = _drive_batcher(batcher, reqs)
+    for (state, obs, rng), got in zip(reqs, results):
+        obs_b = jax.tree.map(lambda x: np.asarray(x)[None], obs)
+        want = single(params, state, obs_b, rng)
+        _assert_rows_equal(got, want)
+    st = batcher.stats()
+    assert st["actor_batch_occupancy"] == pytest.approx(0.5)
+    assert st["actor_jit_step_s"] > 0.0
+
+
+# ------------------------------------------------------------- end to end
+
+
+def _run_vector_exact(vec: VectorActor, episodes_per_env: int) -> None:
+    """Run exactly `episodes_per_env` episodes on EVERY env slot (unlike
+    run(), whose total-episode budget can land unevenly across envs).
+    Envs that finish early drop out, so the tail ticks run partial —
+    deliberately exercising pad-row isolation mid-comparison."""
+
+    async def go():
+        driver = asyncio.create_task(vec.batcher.run())
+
+        async def worker(env):
+            for _ in range(episodes_per_env):
+                await env.run_episode()
+
+        try:
+            await asyncio.gather(*(worker(e) for e in vec.envs))
+        finally:
+            vec.batcher.stop()
+            driver.cancel()
+            await asyncio.gather(driver, return_exceptions=True)
+
+    run(go())
+
+
+@pytest.fixture(scope="module")
+def fleet_frames(env):
+    """(vector frames, sequential frames) for M envs x 2 episodes, keyed
+    by actor id. Vector env slot j runs actor_id 0*M+j = j, matching the
+    standalone actors."""
+    mem.reset("fleet_vec")
+    vbroker = broker_connect("mem://fleet_vec")
+    vec = VectorActor(_cfg(env), vbroker, actor_id=0, envs=M)
+    _run_vector_exact(vec, EPISODES_PER_ENV)
+    vec_frames = vbroker.consume_experience(100000, timeout=0.2)
+
+    mem.reset("fleet_seq")
+    sbroker = broker_connect("mem://fleet_seq")
+    for j in range(M):
+        actor = Actor(_cfg(env), sbroker, actor_id=j)
+        run(actor.run(num_episodes=EPISODES_PER_ENV))
+    seq_frames = sbroker.consume_experience(100000, timeout=0.2)
+
+    def by_actor(frames):
+        out = {}
+        for f in frames:
+            out.setdefault(deserialize_rollout(f).actor_id, []).append(f)
+        return out
+
+    return by_actor(vec_frames), by_actor(seq_frames)
+
+
+def test_vector_fleet_frames_byte_identical_to_sequential_actors(fleet_frames):
+    """The whole-system acceptance check: every frame a 3-env VectorActor
+    publishes over 2 episodes per env is byte-identical to what three
+    standalone single-env Actors (same actor ids, same seeds) publish —
+    featurize, batched inference, sampling, rewards, chunking and wire
+    serialization all included."""
+    vec, seq = fleet_frames
+    assert sorted(vec) == sorted(seq) == list(range(M))
+    for aid in range(M):
+        assert len(vec[aid]) == len(seq[aid]) and len(vec[aid]) > 0
+        for fv, fs in zip(vec[aid], seq[aid]):
+            assert fv == fs, f"frame bytes diverged for actor {aid}"
+
+
+def test_lstm_carry_resets_per_row_on_episode_boundary(fleet_frames):
+    """Episode boundaries are per-row: a chunk that follows a done chunk
+    (same env) restarts from the zero carry while OTHER rows' carries
+    keep flowing — visible in the wire initial_state of each chunk."""
+    vec, _ = fleet_frames
+    carried = 0
+    for aid in range(M):
+        rollouts = [deserialize_rollout(f) for f in vec[aid]]
+        fresh = True  # first chunk of the stream starts an episode
+        for r in rollouts:
+            c0, h0 = r.initial_state
+            if fresh:
+                assert not np.any(c0) and not np.any(h0), (
+                    f"actor {aid}: episode-start chunk carried a stale LSTM state"
+                )
+            elif np.any(c0) or np.any(h0):
+                carried += 1
+            fresh = bool(r.dones[-1] > 0)
+    # episodes are ~30 dota-seconds at rollout_len 8, so mid-episode
+    # chunks exist and their carries must actually flow
+    assert carried > 0, "no chunk ever carried LSTM state across a boundary"
+
+
+def test_actor_pool_vectorizes_from_config(env):
+    """ActorPool's envs-per-actor mode: a driver that only sets
+    --envs_per_process inherits the vector engine — the built Actor is
+    wrapped into a VectorActor and episodes stream to on_episode."""
+    import threading
+
+    from dotaclient_tpu.runtime.harness import ActorPool
+
+    mem.reset("fleet_pool")
+    seen, lock = [], threading.Lock()
+
+    def make(i):
+        cfg = _cfg(env, envs_per_process=2)
+        return Actor(cfg, broker_connect("mem://fleet_pool"), actor_id=i)
+
+    def on_episode(i, actor, ret):
+        with lock:
+            seen.append((i, ret))
+
+    pool = ActorPool(make, 1, on_episode).start()
+    import time
+
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        with lock:
+            if len(seen) >= 2:
+                break
+        time.sleep(0.1)
+    pool.stop(timeout=30)
+    assert pool.dead == 0
+    assert len(pool.actors) == 1 and isinstance(pool.actors[0], VectorActor)
+    assert len(pool.actors[0].envs) == 2
+    with lock:
+        assert len(seen) >= 2
+
+
+def test_vector_actor_weight_version_syncs_at_each_envs_own_boundary(env):
+    """One broker poll per fleet swaps the SHARED params immediately, but
+    each env slot picks the new version stamp up only at its OWN chunk
+    boundary — an env mid-chunk keeps stamping the version its chunk
+    started under (staleness over-estimated for the mixed tail rows,
+    never under-aged)."""
+    from dotaclient_tpu.transport.serialize import flatten_params, serialize_weights
+
+    mem.reset("fleet_w")
+    broker = broker_connect("mem://fleet_w")
+    vec = VectorActor(_cfg(env), broker, actor_id=0, envs=2)
+    new_params = init_params(SMALL, jax.random.PRNGKey(42))
+    broker.publish_weights(serialize_weights(flatten_params(new_params), version=11))
+    # env 0 hits its chunk boundary: params swap fleet-wide, stamp local
+    assert vec.envs[0].maybe_update_weights()
+    assert vec.version == 11
+    assert vec.envs[0].version == 11
+    assert vec.envs[1].version == 0, "mid-chunk env must keep its chunk-start stamp"
+    for a, b in zip(jax.tree.leaves(vec.params), jax.tree.leaves(new_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # env 1 reaches its own boundary: no new frame, but the stamp syncs
+    assert not vec.envs[1].maybe_update_weights()
+    assert vec.envs[1].version == 11
+
+
+# ------------------------------------------------------------ bench wrapper
+
+
+@pytest.mark.nightly
+@pytest.mark.slow  # tier-1 runs -m 'not slow', which would override the
+# nightly exclusion and pull this multi-minute bench into the gate
+def test_bench_actors_short_curve_schema(tmp_path):
+    """Nightly: scripts/bench_actors.py produces a schema-complete
+    ACTOR_FLEET artifact on a short curve."""
+    out = tmp_path / "fleet.json"
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "bench_actors.py"),
+            "--out",
+            str(out),
+            "--seconds",
+            "1",
+            "--envs",
+            "1,2",
+            "--policy",
+            "small",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    data = json.loads(out.read_text())
+    assert data["baseline_single"]["offered_steps_per_sec"] > 0
+    assert [r["envs_per_process"] for r in data["curve"]] == [1, 2]
+    for row in data["curve"]:
+        for key in (
+            "offered_steps_per_sec",
+            "batch_occupancy",
+            "gather_wait_ms",
+            "jit_step_ms",
+            "speedup_vs_single",
+            "thread_fleet_steps_per_sec",
+            "speedup_vs_thread_fleet",
+        ):
+            assert key in row, f"curve row missing {key}"
+        assert row["offered_steps_per_sec"] > 0
+    ex = data["extrapolation"]
+    for key in (
+        "chosen_envs_per_process",
+        "actors_per_core",
+        "cores_for_256_actors",
+        "processes_for_target",
+    ):
+        assert key in ex, f"extrapolation missing {key}"

@@ -392,6 +392,31 @@ def test_watchdog_scalars_are_registered():
     assert not missing, f"watchdog scalars not in obs/registry.py: {missing}"
 
 
+def test_actor_fleet_scalars_are_registered():
+    """The actor_* family (vector fleet batcher meters) is scrape-only
+    like watchdog_* — it never passes through MetricsLogger, so the
+    JSONL drift guard can't see it; pin the stats() names against the
+    registry directly (bench_actors.py and the actor /metrics surface
+    both emit exactly these)."""
+    from dotaclient_tpu.config import ActorConfig, PolicyConfig
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.runtime.actor import InferenceBatcher
+
+    cfg = ActorConfig(
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+    )
+    batcher = InferenceBatcher(cfg, lambda: None, capacity=2)
+    stats = batcher.stats()
+    missing = registry.unregistered(stats.keys())
+    assert not missing, f"actor fleet scalars not in obs/registry.py: {missing}"
+    assert set(stats) == {
+        "actor_offered_steps_per_sec",
+        "actor_batch_occupancy",
+        "actor_gather_wait_s",
+        "actor_jit_step_s",
+    }
+
+
 # --------------------------------------------------- scrape surface
 
 
