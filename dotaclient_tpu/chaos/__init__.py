@@ -1,0 +1,61 @@
+"""Seeded fault injection for the actors→broker→staging→learner pipe.
+
+The ROADMAP's broker-sharding item needs load-shed and backpressure
+that have actually been PROVEN against faults, and the only way to
+trust recovery code is to run it — on purpose, reproducibly. This
+package wraps the production plugin boundaries (the Broker interface,
+the env stub) in deterministic scheduled faults:
+
+- chaos/schedule.py   the `--chaos.spec` grammar + per-op-index seeded
+                      decisions (same seed+spec ⇒ same faults at the
+                      same op indices);
+- chaos/broker.py     ChaosBroker: corrupt/truncate/dup/reset/shed/
+                      latency/stall around any Broker;
+- chaos/env.py        ChaosEnvStub: env latency + session-loss faults
+                      inside the protocol the actor already handles;
+- chaos/controller.py broker kill/restart execution + exact per-
+                      incarnation conservation ledgers.
+
+Production inertness is a hard contract: binaries import this package
+ONLY under `--chaos.enabled` (k8s manifests pin it false), so the off
+path has zero new imports and byte-identical wire behavior — asserted
+by tests/test_chaos.py::test_chaos_off_is_import_free_and_wire_identical.
+
+    from dotaclient_tpu.chaos import wrap_broker
+    broker = wrap_broker(broker, cfg.chaos)   # cfg.chaos.enabled is True
+
+scripts/chaos_soak.py composes all of it into the closed-loop
+degradation proof (CHAOS_SOAK.json).
+"""
+
+from __future__ import annotations
+
+from dotaclient_tpu.chaos.broker import ChaosBroker
+from dotaclient_tpu.chaos.controller import BrokerIncarnations, ScheduleRunner
+from dotaclient_tpu.chaos.env import ChaosEnvStub
+from dotaclient_tpu.chaos.schedule import FaultSchedule, OpFaults, TimedEvent
+
+__all__ = [
+    "BrokerIncarnations",
+    "ChaosBroker",
+    "ChaosEnvStub",
+    "FaultSchedule",
+    "OpFaults",
+    "ScheduleRunner",
+    "TimedEvent",
+    "wrap_broker",
+    "wrap_env_stub",
+]
+
+
+def wrap_broker(broker, chaos_cfg, t0=None):
+    """Broker decorator factory for the binaries: parse the spec once,
+    wrap. Callers gate on cfg.chaos.enabled BEFORE importing this
+    package (the inertness contract)."""
+    schedule = FaultSchedule.parse(chaos_cfg.spec, seed=chaos_cfg.seed)
+    return ChaosBroker(broker, schedule, t0=t0)
+
+
+def wrap_env_stub(stub, chaos_cfg):
+    schedule = FaultSchedule.parse(chaos_cfg.spec, seed=chaos_cfg.seed)
+    return ChaosEnvStub(stub, schedule)

@@ -1,0 +1,156 @@
+"""Broker kill/restart execution + post-mortem ledger harvesting.
+
+The one fault a client-side wrapper cannot inject is the broker DYING:
+that belongs to whoever owns the server process. `BrokerIncarnations`
+owns a sequence of in-process tcp BrokerServer incarnations on ONE port
+and harvests each incarnation's conservation ledger at kill time —
+exact, because the counters are read AFTER stop() joined the server
+loop. `ScheduleRunner` executes a FaultSchedule's kill events against
+it on a side thread.
+
+Recovery-time probe: each incarnation records the monotonic time of its
+first post-boot enqueue (transport/tcp.py `first_enqueue_t`); recovery
+after a kill = that minus the restart completion time — i.e. how long
+the fleet's jittered reconnect/backoff took to actually land a frame in
+the reborn broker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from dotaclient_tpu.chaos.schedule import FaultSchedule
+from dotaclient_tpu.transport.tcp import BrokerServer
+
+
+class BrokerIncarnations:
+    """N sequential BrokerServer lives on one port, ledgers kept."""
+
+    def __init__(self, port: int = 0, maxlen: int = 4096, shed_high: int = 0, shed_low: int = 0):
+        self.maxlen, self.shed_high, self.shed_low = maxlen, shed_high, shed_low
+        self.server = BrokerServer(
+            port=port, maxlen=maxlen, shed_high=shed_high, shed_low=shed_low
+        ).start()
+        self.port = self.server.port
+        self.ledgers: List[dict] = []  # one per DEAD incarnation
+        self.kill_times: List[float] = []
+        self.restart_times: List[float] = []
+        self._lock = threading.Lock()
+
+    def kill(self) -> dict:
+        """Stop the live server and harvest its exact ledger. The dead
+        incarnation is unbound immediately so a final_ledger() landing
+        before any restart (runner stopped mid-down-window, restart
+        raised) can never harvest — and double-count — the same life."""
+        with self._lock:
+            if self.server is None:
+                raise RuntimeError("kill() with no live incarnation")
+            self.server.stop()
+            led = self.server.ledger()
+            self.server = None
+            led["killed_at"] = time.monotonic()
+            self.ledgers.append(led)
+            self.kill_times.append(led["killed_at"])
+            return led
+
+    def restart(self) -> None:
+        """Bring a fresh incarnation up on the SAME port. Bounded retry:
+        the dead server's socket can linger briefly."""
+        with self._lock:
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    self.server = BrokerServer(
+                        port=self.port,
+                        maxlen=self.maxlen,
+                        shed_high=self.shed_high,
+                        shed_low=self.shed_low,
+                    ).start()
+                    break
+                except (RuntimeError, OSError):
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.1)
+            self.restart_times.append(time.monotonic())
+
+    def final_ledger(self) -> dict:
+        """Stop the last incarnation (if live) and sum every life's
+        counters into one run ledger."""
+        with self._lock:
+            if self.server is not None:
+                self.server.stop()
+                led = self.server.ledger()
+                led["killed_at"] = None  # run end, not a chaos kill
+                self.ledgers.append(led)
+                self.server = None
+            total = {
+                k: sum(l[k] for l in self.ledgers)
+                for k in (
+                    "enqueued", "popped", "dropped_oldest", "shed",
+                    "shed_closes", "reply_lost", "resident",
+                )
+            }
+            total["incarnations"] = len(self.ledgers)
+            return total
+
+class ScheduleRunner:
+    """Execute a schedule's kill events against BrokerIncarnations on a
+    daemon thread, relative to a shared epoch `t0`."""
+
+    def __init__(self, schedule: FaultSchedule, broker: BrokerIncarnations, t0: float):
+        self.schedule = schedule
+        self.broker = broker
+        self.t0 = t0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (kill_index, restart_monotonic, first_enqueue_monotonic | None)
+        self.recovery: List[dict] = []
+
+    def start(self) -> "ScheduleRunner":
+        self._thread = threading.Thread(target=self._run, daemon=True, name="chaos-kills")
+        self._thread.start()
+        return self
+
+    def _sleep_until(self, at_s: float) -> bool:
+        """Sleep to schedule-offset at_s; False if stopped first."""
+        while not self._stop.is_set():
+            remaining = (self.t0 + at_s) - time.monotonic()
+            if remaining <= 0:
+                return True
+            self._stop.wait(min(remaining, 0.2))
+        return False
+
+    def _run(self) -> None:
+        for k, ev in enumerate(self.schedule.kills()):
+            if not self._sleep_until(ev.at_s):
+                return
+            self.broker.kill()
+            if not self._sleep_until(ev.at_s + ev.duration_s):
+                return
+            self.broker.restart()
+            restarted = time.monotonic()
+            # recovery probe: poll the reborn incarnation's first-enqueue
+            # stamp for up to 30s (clients are backing off with jitter)
+            first = None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not self._stop.is_set():
+                t = self.broker.server.first_enqueue_t
+                if t is not None:
+                    first = t
+                    break
+                time.sleep(0.05)
+            self.recovery.append(
+                {
+                    "kill_index": k,
+                    "at_s": ev.at_s,
+                    "down_s": round(ev.duration_s, 3),
+                    "recovery_s": None if first is None else round(first - restarted, 3),
+                }
+            )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

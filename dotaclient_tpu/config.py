@@ -148,6 +148,47 @@ class ReplayConfig:
 
 
 @dataclass
+class RetryConfig:
+    """Broker-client retry policy (transport/base.py RetryPolicy): one
+    policy shared by the tcp transport's reconnect loop and the actor's
+    SHED throttle, so a fleet tunes its backpressure behavior in ONE
+    place. The jitter exists for the thundering-herd case: 256 actors
+    whose broker restarts must not reconnect (or resume publishing after
+    a shed) in lockstep."""
+
+    # Seconds a failed broker request keeps reconnect-retrying before
+    # giving up and raising (the old hardcoded _Conn retry_window).
+    window_s: float = 60.0
+    # First backoff sleep; doubles per attempt up to cap_s.
+    backoff_base_s: float = 0.1
+    backoff_cap_s: float = 2.0
+    # Uniform jitter fraction: each sleep is drawn from
+    # [b*(1-jitter), b*(1+jitter)]. 0 = the old deterministic lockstep.
+    jitter: float = 0.5
+
+
+@dataclass
+class ChaosConfig:
+    """Seeded fault injection (dotaclient_tpu/chaos/). Default OFF and
+    import-free: with enabled=False no chaos module is ever imported and
+    the broker/env objects are exactly the production ones —
+    byte-identical wire behavior (asserted in tests/test_chaos.py)."""
+
+    # Master switch: wrap this binary's broker in a ChaosBroker driving
+    # the schedule below. NEVER set in production manifests (k8s pins it
+    # false explicitly so a copy-pasted soak flag can't leak in).
+    enabled: bool = False
+    # Seed for every fault decision: same seed + spec -> the same faults
+    # at the same operation indices (reproducible failure hunts).
+    seed: int = 0
+    # Fault schedule spec, e.g.
+    # "latency:0.002~0.001,corrupt:0.01,dup:0.02,reset:0.005,
+    #  stall@8:1.5,kill@10:2,kill@25:2" (chaos/schedule.py docstring is
+    # the grammar). Empty = no faults even when enabled.
+    spec: str = ""
+
+
+@dataclass
 class WatchdogConfig:
     """Learner liveness watchdog (dotaclient_tpu/obs/watchdog.py): a
     side thread that reads MetricsLogger.latest() + live gauges and
@@ -252,6 +293,8 @@ class LearnerConfig:
     replay: ReplayConfig = field(default_factory=ReplayConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     policy: PolicyConfig = field(default_factory=PolicyConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     broker_url: str = "mem://"
     checkpoint_dir: str = ""
     # Remote checkpoint mirror (reference behavior: upload finished
@@ -384,6 +427,8 @@ class ActorConfig:
     gather_window_s: float = 0.005
     obs: ObsConfig = field(default_factory=ObsConfig)
     policy: PolicyConfig = field(default_factory=PolicyConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     seed: int = 0
     actor_id: int = 0
     # Actors are CPU processes (reference architecture: the accelerator

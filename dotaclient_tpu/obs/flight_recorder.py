@@ -42,6 +42,10 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=max(int(ring_size), 1))
         self._lock = threading.Lock()
         self._dumped_reasons = set()  # one artifact per distinct reason
+        # name -> provider() of extra dump payload (e.g. the staging
+        # quarantine ring): state that is too bulky to mirror into the
+        # event ring per occurrence but essential in a post-mortem.
+        self._sections: dict = {}
         self.events_recorded = 0
         self.last_dump_path: Optional[str] = None
 
@@ -55,6 +59,15 @@ class FlightRecorder:
             self._ring.append(rec)
             self.events_recorded += 1
 
+    def add_section(self, name: str, provider) -> None:
+        """Register a named dump section: `provider()` is called at dump
+        time and its (JSON-serializable) return lands under
+        payload["sections"][name]. Used by owners of bounded evidence
+        rings — the staging quarantine — whose full contents belong in a
+        post-mortem but not in the per-event ring."""
+        with self._lock:
+            self._sections[name] = provider
+
     # -------------------------------------------------------------- dump
 
     def dump(self, reason: str, once: bool = True) -> Optional[str]:
@@ -66,6 +79,13 @@ class FlightRecorder:
                 return None
             self._dumped_reasons.add(reason)
             events = list(self._ring)
+            providers = list(self._sections.items())
+        sections = {}
+        for name, provider in providers:
+            try:
+                sections[name] = provider()
+            except Exception:  # a recorder must never add a second failure
+                sections[name] = "<section provider failed>"
         stamp = time.strftime("%Y%m%dT%H%M%S")
         safe_reason = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)[:64]
         directory = self.dump_dir or os.getcwd()
@@ -82,6 +102,8 @@ class FlightRecorder:
                 "events_recorded": self.events_recorded,
                 "events": events,
             }
+            if sections:
+                payload["sections"] = sections
             tmp = f"{path}.tmp"
             with open(tmp, "w") as f:
                 json.dump(payload, f)

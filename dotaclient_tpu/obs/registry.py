@@ -58,6 +58,10 @@ SCALARS: Dict[str, str] = {
     "time_step_s": "per-step residual (device step + dispatch)",
     "active_actors": "actors heard from within the heartbeat window",
     "staleness_dropped": "rollouts dropped for version staleness (cumulative)",
+    "staging_quarantined": (
+        "frames filed in the staging dead-letter ring (parse/layout "
+        "poison — evidence kept, dumped by the flight recorder)"
+    ),
     "queue_ready": "packed batches waiting in the staging queue",
     "episodes": "episodes completed (cumulative, from done frames)",
     "weights_published": "weight fanout frames actually sent",
@@ -119,6 +123,16 @@ PREFIXES: Dict[str, str] = {
     # obs gauges exported only on the scrape surface (not JSONL):
     # obs_broker_experience_depth, obs_staging_*, ...
     "obs_": "live scrape-surface gauges (obs/__init__.py sources)",
+    # broker admission control + actor publish degradation:
+    # broker_shed_observed_total, broker_shed_publish_failed_total,
+    # broker_shed_throttle_s (runtime/actor.py ShedThrottle /
+    # VectorActor.stats; transport/tcp.py watermarks are the source)
+    "broker_shed_": "broker load-shed observability (admission refusals + actor throttle)",
+    # seeded fault-injection meters (dotaclient_tpu/chaos/ ChaosBroker):
+    # chaos_ops, chaos_corrupted, chaos_truncated, chaos_duplicated,
+    # chaos_resets, chaos_sheds, chaos_stall_s, chaos_latency_s —
+    # emitted only when --chaos.enabled (never in production)
+    "chaos_": "fault-injection layer meters (dotaclient_tpu/chaos/)",
 }
 
 

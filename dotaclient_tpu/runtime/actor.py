@@ -54,7 +54,7 @@ from dotaclient_tpu.models import policy as P
 from dotaclient_tpu.ops import action_dist as ad
 from dotaclient_tpu.protos import dotaservice_pb2 as ds
 from dotaclient_tpu.protos import worldstate_pb2 as ws
-from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.base import Broker, BrokerShedError, RetryPolicy
 from dotaclient_tpu.transport.serialize import (
     Rollout,
     RolloutAux,
@@ -141,6 +141,65 @@ def check_weight_freshness(actor) -> None:
             f"actor {actor.actor_id}: no weight update for {age:.0f}s "
             f"(limit {actor.cfg.max_weight_age_s:.0f}s) — exiting for restart"
         )
+
+
+class ShedThrottle:
+    """Adaptive publish throttle: honor broker admission control
+    (BrokerShedError — transport/tcp.py watermarks) and survive transient
+    broker failures with jittered exponential backoff instead of either
+    crashing the actor or hammering an overloaded broker in lockstep
+    with 255 siblings.
+
+    Policy on refusal/failure: the CHUNK IS DROPPED, not queued for
+    retry — by the time an overloaded broker would accept it the chunk
+    is staler (and the learner's staleness filter or the drop-oldest
+    eviction would eat it anyway); what matters is that the PRODUCER
+    slows down, which the awaited backoff does. Backoff resets on the
+    first accepted publish. One instance per publishing agent; counters
+    feed the broker_shed_* scalars (obs/registry.py).
+    """
+
+    def __init__(self, retry: Optional[RetryPolicy] = None):
+        self.retry = retry if retry is not None else RetryPolicy()
+        self._backoff = self.retry.backoff_base_s
+        self.published = 0
+        self.shed = 0
+        self.failed = 0
+        self.throttle_s = 0.0
+
+    async def publish(self, broker: Broker, data: bytes) -> bool:
+        """True = accepted; False = shed/failed (chunk dropped, backoff
+        paid). Raising is reserved for programming errors — transport
+        failure must degrade the actor, not kill it (the broker outlives
+        no one in the k8s model; an actor that dies on every broker
+        hiccup turns one restart into a fleet crashloop)."""
+        try:
+            broker.publish_experience(data)
+        except BrokerShedError:
+            self.shed += 1
+            await self._pay_backoff()
+            return False
+        except (ConnectionError, OSError) as e:
+            self.failed += 1
+            _log.warning("publish failed (%s: %s); dropping chunk and backing off", type(e).__name__, e)
+            await self._pay_backoff()
+            return False
+        self.published += 1
+        self._backoff = self.retry.backoff_base_s
+        return True
+
+    async def _pay_backoff(self) -> None:
+        delay = self.retry.sleep_for(self._backoff)
+        self._backoff = self.retry.next_backoff(self._backoff)
+        self.throttle_s += delay
+        await asyncio.sleep(delay)
+
+    def stats(self) -> dict:
+        return {
+            "broker_shed_observed_total": float(self.shed),
+            "broker_shed_publish_failed_total": float(self.failed),
+            "broker_shed_throttle_s": self.throttle_s,
+        }
 
 
 def connect_env_async(cfg: ActorConfig) -> AsyncDotaServiceStub:
@@ -385,6 +444,12 @@ class Actor:
         self.steps_done = 0
         self.episodes_done = 0
         self.rollouts_published = 0
+        # Publish degradation: honors broker SHED + transient failures
+        # with jittered backoff (config.py RetryConfig is the policy).
+        retry_cfg = getattr(cfg, "retry", None)
+        self.publish_throttle = ShedThrottle(
+            RetryPolicy.from_config(retry_cfg) if retry_cfg is not None else None
+        )
         self.obs = self._make_obs_runtime()
         # ±1 result of the last finished episode, 0.0 for a decided draw
         # (episode ended with no winning team), None while in flight or
@@ -394,6 +459,18 @@ class Actor:
         # kill-switch clock: boot counts as "fresh" so a learner that is
         # still compiling doesn't kill its actors
         self.last_weight_time = time.monotonic()
+
+    @property
+    def rollouts_shed(self) -> int:
+        """Chunks refused by broker admission control (dropped + backoff
+        paid) — the producer side of the conservation ledger."""
+        return self.publish_throttle.shed
+
+    @property
+    def rollouts_failed(self) -> int:
+        """Chunks dropped on transport failure (broker down past the
+        retry window, injected resets)."""
+        return self.publish_throttle.failed
 
     def _make_obs_runtime(self):
         """Observability (--obs.*, dotaclient_tpu/obs/): when enabled the
@@ -529,8 +606,12 @@ class Actor:
                 )
                 if self.obs is not None:
                     rollout = self.obs.stamp(rollout, self.actor_id)
-                self.broker.publish_experience(serialize_rollout(rollout))
-                self.rollouts_published += 1
+                # Shed/failed publishes drop the chunk and pay a jittered
+                # backoff (ShedThrottle docstring); the episode continues.
+                if await self.publish_throttle.publish(
+                    self.broker, serialize_rollout(rollout)
+                ):
+                    self.rollouts_published += 1
                 state, chunk = next_chunk(cfg.policy, state)
                 self.maybe_update_weights()
 
@@ -846,8 +927,29 @@ class VectorActor:
     def rollouts_published(self) -> int:
         return sum(e.rollouts_published for e in self.envs)
 
+    @property
+    def rollouts_shed(self) -> int:
+        return sum(e.publish_throttle.shed for e in self.envs)
+
+    @property
+    def rollouts_failed(self) -> int:
+        return sum(e.publish_throttle.failed for e in self.envs)
+
     def stats(self) -> dict:
-        return self.batcher.stats()
+        out = self.batcher.stats()
+        # Fleet-wide publish-degradation meters (broker_shed_* family):
+        # each env slot throttles itself, the gauges sum the fleet.
+        shed = failed = 0
+        throttle_s = 0.0
+        for e in self.envs:
+            t = e.publish_throttle
+            shed += t.shed
+            failed += t.failed
+            throttle_s += t.throttle_s
+        out["broker_shed_observed_total"] = float(shed)
+        out["broker_shed_publish_failed_total"] = float(failed)
+        out["broker_shed_throttle_s"] = throttle_s
+        return out
 
     def maybe_update_weights(self) -> bool:
         """Apply a pending weight frame to the SHARED param tree (the
@@ -943,7 +1045,14 @@ def main(argv=None):
     cfg = parse_config(ActorConfig(), argv)
     if cfg.platform:
         jax.config.update("jax_platforms", cfg.platform)
-    broker = broker_connect(cfg.broker_url)
+    broker = broker_connect(cfg.broker_url, retry=RetryPolicy.from_config(cfg.retry))
+    if cfg.chaos.enabled:
+        # Gated IMPORT, not just gated construction: with chaos off the
+        # package never loads and the broker object is exactly the
+        # production one (the inertness contract, tests/test_chaos.py).
+        from dotaclient_tpu.chaos import wrap_broker
+
+        broker = wrap_broker(broker, cfg.chaos)
     M = max(int(cfg.envs_per_process), 1)
     if cfg.opponent in ("self", "league"):
         from dotaclient_tpu.runtime.selfplay import SelfPlayActor

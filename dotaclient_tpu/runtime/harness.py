@@ -121,6 +121,26 @@ class ActorPool:
             t.start()
         return self
 
+    def publish_stats(self) -> dict:
+        """Fleet-aggregated publish/degradation counters — what the
+        chaos soak's conservation ledger reads from the producer side.
+        `actors` is appended by worker threads; the list() is one
+        GIL-atomic snapshot and counters may trail by an in-flight
+        publish, which a ledger read after stop() never observes."""
+        published = shed = failed = 0
+        for a in list(self.actors):  # graftlint: disable=THR001(one GIL-atomic list-snapshot; exact after stop() joined the workers)
+            published += int(getattr(a, "rollouts_published", 0))
+            shed += int(getattr(a, "rollouts_shed", 0))
+            failed += int(getattr(a, "rollouts_failed", 0))
+        with self._lock:
+            dead = self.dead
+        return {
+            "published": published,
+            "shed": shed,
+            "failed": failed,
+            "dead_actors": dead,
+        }
+
     def stop(self, timeout: float = 30.0, raise_on_dead: bool = False) -> None:
         """Signal and join with a bounded per-thread timeout — a wedged
         episode must not hang driver teardown (threads are daemons).

@@ -694,6 +694,7 @@ class Learner:
                     scalars["time_step_s"] = max(dt - win_wait - win_put, 0.0) / n
                     scalars["active_actors"] = stats["active_actors"]
                     scalars["staleness_dropped"] = stats["dropped_stale"]
+                    scalars["staging_quarantined"] = stats["quarantined"]
                     scalars["queue_ready"] = stats["ready_batches"]
                     scalars["episodes"] = stats["episodes"]
                     # Replay reservoir health (replay.enabled only):
@@ -766,7 +767,15 @@ def main(argv=None):
         if cfg.process_id >= 0:
             kw["process_id"] = cfg.process_id
         jax.distributed.initialize(**kw)
-    broker = broker_connect(cfg.broker_url)
+    from dotaclient_tpu.transport.base import RetryPolicy
+
+    broker = broker_connect(cfg.broker_url, retry=RetryPolicy.from_config(cfg.retry))
+    if cfg.chaos.enabled:
+        # Gated import — chaos off means the package never loads and the
+        # broker is the production object (tests/test_chaos.py).
+        from dotaclient_tpu.chaos import wrap_broker
+
+        broker = wrap_broker(broker, cfg.chaos)
     learner = Learner(cfg, broker)
     _log.info(
         "learner up: mesh=%s batch=%dx%d devices=%d",

@@ -55,7 +55,7 @@ from dotaclient_tpu.runtime.actor import (
     next_chunk,
     reset_env_stub,
 )
-from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.base import Broker, BrokerShedError
 from dotaclient_tpu.transport.serialize import serialize_rollout, unflatten_params
 
 _log = logging.getLogger(__name__)
@@ -112,6 +112,8 @@ class SelfPlayActor:
         self.steps_done = 0
         self.episodes_done = 0
         self.rollouts_published = 0
+        self.rollouts_shed = 0  # publishes refused at admission, chunk dropped
+        self.rollouts_failed = 0  # publishes lost to transport failure
         self.last_win: Optional[float] = None  # radiant (live) perspective
         self.last_heroes: list = []  # live side's pool draws, last episode
         self.last_weight_time = time.monotonic()  # kill-switch clock
@@ -176,8 +178,28 @@ class SelfPlayActor:
         )
         if self.obs is not None:
             rollout = self.obs.stamp(rollout, self.actor_id)
-        self.broker.publish_experience(serialize_rollout(rollout))
-        self.rollouts_published += 1
+        try:
+            self.broker.publish_experience(serialize_rollout(rollout))
+            self.rollouts_published += 1
+        except BrokerShedError:
+            # Admission refusal: drop the chunk and continue the episode.
+            # _publish is sync (called mid-tick for whichever side's
+            # chunk filled), so the jittered backoff the scripted fleet
+            # awaits (runtime/actor.py ShedThrottle) can't be paid here
+            # without stalling BOTH sides' env session; the shed itself
+            # is already the broker protecting itself, and self-play
+            # actors are a tiny minority of the publish load.
+            self.rollouts_shed += 1
+        except (ConnectionError, OSError) as e:
+            _log.warning(
+                "selfplay actor %d: publish failed (%s); dropping chunk",
+                self.actor_id,
+                type(e).__name__,
+            )
+            # NOT rollouts_shed: a transport failure is no admission
+            # refusal, and the conservation ledger's shed cross-check
+            # (publish_stats "shed" vs broker refusals) must not see one.
+            self.rollouts_failed += 1
         side.state, side.chunk = next_chunk(self.cfg.policy, side.state)
 
     def _batched_step(self, params, group: list) -> None:

@@ -24,16 +24,22 @@ device-boundary note). Structure:
   reference's single-threaded consumers).
 
 Failure split (ADVICE r5 item 1): a malformed FRAME costs its own batch
-at worst (dropped_bad, consumer continues); a batch/template LAYOUT or
-CONFIG mismatch (ops.batch.BatchLayoutError from the native packer or
-the fused transfer pack) is a persistent builder/staging disagreement
-that would fail every batch forever — the consumer thread dies loudly
-and get_batch/get_batch_groups re-raise instead of starving the learner
+at worst (dropped_bad, consumer continues) — and since the chaos era it
+also leaves EVIDENCE: parse/layout failures are filed in a bounded
+dead-letter quarantine ring (reason + size + header prefix, the
+`staging_quarantined` scalar, dumped by the flight recorder as a
+section) so a corrupt wire is distinguishable from a misbuilt actor
+post-mortem. A batch/template LAYOUT or CONFIG mismatch
+(ops.batch.BatchLayoutError from the native packer or the fused
+transfer pack) is a persistent builder/staging disagreement that would
+fail every batch forever — the consumer thread dies loudly and
+get_batch/get_batch_groups re-raise instead of starving the learner
 behind per-batch warnings.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
 import queue
 import threading
@@ -245,11 +251,23 @@ class StagingBuffer:
         # (written only by the consumer thread; stats() reads a snapshot)
         self._actor_seen: Dict[int, float] = {}
         self.heartbeat_window_s = 60.0
+        # Poison-frame quarantine: a bounded dead-letter ring of frames
+        # that failed parse or per-frame layout validation. Before this
+        # ring, a poison frame was a `dropped_bad` tick and GONE — no
+        # way to tell a corrupt wire from a misbuilt actor from a fuzzer
+        # after the fact. Entries keep the evidence (reason + length +
+        # header-prefix hex) bounded; the flight recorder dumps the ring
+        # as a section on any fatal. Written only by the consumer
+        # thread, same single-writer discipline as _pending.
+        self._quarantine: collections.deque = collections.deque(maxlen=64)
+        if recorder is not None:
+            recorder.add_section("staging_quarantine", self.quarantine)
         self._stats_lock = threading.Lock()
         self._stats = {
             "consumed": 0,
             "dropped_stale": 0,
             "dropped_bad": 0,
+            "quarantined": 0,
             "batches": 0,
             "rows_packed": 0,
             "rows_replayed": 0,
@@ -481,12 +499,36 @@ class StagingBuffer:
             self._tracer.hop("replay_admit", ref)
         return admitted
 
+    def _quarantine_put(self, frame: bytes, reason: str) -> None:
+        """Consumer-thread-only: file one poison frame in the dead-letter
+        ring. Bounded evidence, not storage: reason + size + the first
+        64 bytes as hex (covers the header of every wire layout) — a
+        whole corrupt frame can be megabytes and the ring must stay
+        O(64) small."""
+        self._quarantine.append(
+            {
+                "t": time.time(),
+                "reason": reason,
+                "bytes": len(frame),
+                "head": bytes(frame[:64]).hex(),
+            }
+        )
+        if self._recorder is not None:
+            self._recorder.record(
+                "staging_quarantine", reason=reason, size=len(frame)
+            )
+
+    def quarantine(self) -> List[dict]:
+        """Snapshot of the dead-letter ring (newest last). One GIL-atomic
+        deque copy; the flight recorder dumps this as a section."""
+        return list(self._quarantine)  # graftlint: disable=THR001(one GIL-atomic deque-snapshot copy; appends live in _ingest on the sole writer thread)
+
     def _ingest(self, frames: List[bytes]) -> None:
         version_now = self.version_fn()
         min_version = version_now - self.cfg.ppo.max_staleness
         H = self.cfg.policy.lstm_hidden
         consumed = len(frames)
-        dropped_stale = dropped_bad = episodes = 0
+        dropped_stale = dropped_bad = quarantined = episodes = 0
         ep_ret = 0.0
         now = time.monotonic()
         tr = self._tracer
@@ -528,7 +570,12 @@ class StagingBuffer:
             parsed_iter = (self._parse(f) for f in frames)
         for i, parsed in enumerate(parsed_iter):
             if parsed is None:
+                # Poison frame (bad magic, truncated arrays, corrupt
+                # header): dead-letter it WITH evidence instead of only
+                # ticking a counter.
                 dropped_bad += 1
+                quarantined += 1
+                self._quarantine_put(frames[i], "parse")
                 continue
             item, version, L, frame_h, actor_id, frame_ret, last_done = parsed
             self._actor_seen[actor_id] = now  # heartbeat (consumer thread only)
@@ -543,6 +590,8 @@ class StagingBuffer:
             # actor can only ever cost its own frames, never the pack step.
             if L > self.cfg.seq_len or frame_h != H:
                 dropped_bad += 1
+                quarantined += 1
+                self._quarantine_put(frames[i], "layout")
                 continue
             ref = None
             if tr is not None:
@@ -577,6 +626,7 @@ class StagingBuffer:
             self._stats["consumed"] += consumed
             self._stats["dropped_stale"] += dropped_stale
             self._stats["dropped_bad"] += dropped_bad
+            self._stats["quarantined"] += quarantined
             self._stats["episodes"] += episodes
             self._stats["episode_return_sum"] += ep_ret
 

@@ -22,7 +22,64 @@ Semantics all implementations honor:
 from __future__ import annotations
 
 import abc
+import random
+from dataclasses import dataclass
 from typing import List, Optional
+
+
+class BrokerShedError(RuntimeError):
+    """A publish was refused at ADMISSION by an overloaded broker (the
+    watermark load-shed in transport/tcp.py, or a chaos-injected shed).
+
+    Deliberately NOT a ConnectionError: the connection is healthy and
+    the broker is alive — reconnecting would add load exactly when the
+    broker asked for less. Callers should drop or delay the frame and
+    back off (runtime/actor.py's jittered throttle); to PPO a shed frame
+    costs the same as the drop-oldest eviction it replaces, except the
+    producer finds out and can stop digging."""
+
+
+@dataclass
+class RetryPolicy:
+    """Capped exponential backoff with uniform jitter — the ONE retry
+    shape shared by the tcp client's reconnect loop and the actor's
+    SHED throttle (config.py RetryConfig is the flag surface).
+
+    Jitter is the point: without it, every client of a restarted broker
+    sleeps the identical 0.1/0.2/0.4... ladder and the whole fleet
+    reconnects in lockstep bursts. `rng` is injectable for deterministic
+    tests; production leaves it None for a per-policy random stream.
+    """
+
+    window_s: float = 60.0
+    backoff_base_s: float = 0.1
+    backoff_cap_s: float = 2.0
+    jitter: float = 0.5
+    rng: Optional[random.Random] = None
+
+    @classmethod
+    def from_config(cls, cfg) -> "RetryPolicy":
+        """Build from a config.py RetryConfig (any object with the four
+        fields)."""
+        return cls(
+            window_s=cfg.window_s,
+            backoff_base_s=cfg.backoff_base_s,
+            backoff_cap_s=cfg.backoff_cap_s,
+            jitter=cfg.jitter,
+        )
+
+    def sleep_for(self, backoff: float) -> float:
+        """The actual sleep for a nominal backoff value: uniform in
+        [b*(1-jitter), b*(1+jitter)], floored at 0."""
+        if self.jitter <= 0:
+            return backoff
+        rng = self.rng if self.rng is not None else random
+        lo = backoff * (1.0 - self.jitter)
+        hi = backoff * (1.0 + self.jitter)
+        return max(0.0, lo + (hi - lo) * rng.random())
+
+    def next_backoff(self, backoff: float) -> float:
+        return min(backoff * 2.0, self.backoff_cap_s)
 
 
 class Broker(abc.ABC):
@@ -50,7 +107,11 @@ class Broker(abc.ABC):
         pass
 
 
-def connect(url: str, **kw) -> Broker:
+def connect(url: str, retry: Optional[RetryPolicy] = None, **kw) -> Broker:
+    """`retry` is the shared RetryPolicy for transports with a reconnect
+    loop (tcp://; rmq uses its window for op-level retries). mem:// has
+    no connection to retry, so the kwarg is accepted-and-ignored there —
+    binaries pass one policy regardless of scheme."""
     if url.startswith("mem://"):
         from dotaclient_tpu.transport.memory import MemoryBroker
 
@@ -59,9 +120,13 @@ def connect(url: str, **kw) -> Broker:
         from dotaclient_tpu.transport.tcp import TcpBroker
 
         host, _, port = url[len("tcp://") :].partition(":")
+        if retry is not None:
+            kw["retry"] = retry
         return TcpBroker(host or "127.0.0.1", int(port or 13370), **kw)
     if url.startswith("amqp://"):
         from dotaclient_tpu.transport.rmq import RmqBroker
 
+        if retry is not None:
+            kw["retry"] = retry
         return RmqBroker(url, **kw)
     raise ValueError(f"unknown broker url scheme: {url!r}")

@@ -8,7 +8,7 @@ import collections
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.base import Broker, BrokerShedError
 
 _REGISTRY: Dict[str, "_Hub"] = {}
 _REGISTRY_LOCK = threading.Lock()
@@ -17,19 +17,28 @@ _REGISTRY_LOCK = threading.Lock()
 class _Hub:
     """Shared state for all MemoryBroker handles with the same name."""
 
-    def __init__(self, maxlen: int):
+    def __init__(self, maxlen: int, shed_high: int = 0, shed_low: int = 0):
+        if shed_high and shed_low >= shed_high:
+            raise ValueError(
+                f"shed_low={shed_low} must be below shed_high={shed_high}"
+            )
         self.lock = threading.Lock()
         self.not_empty = threading.Condition(self.lock)
         self.experience: collections.deque = collections.deque(maxlen=maxlen)
         self.dropped = 0
+        # Same watermark admission control as transport/tcp.py (0 = off),
+        # so the actor SHED throttle is testable in-process.
+        self.shed_high, self.shed_low = shed_high, shed_low
+        self.shedding = False
+        self.shed_total = 0
         self.weights: Optional[Tuple[int, bytes]] = None  # (seq, frame)
         self.weights_seq = 0
 
 
-def _hub(name: str, maxlen: int) -> _Hub:
+def _hub(name: str, maxlen: int, shed_high: int = 0, shed_low: int = 0) -> _Hub:
     with _REGISTRY_LOCK:
         if name not in _REGISTRY:
-            _REGISTRY[name] = _Hub(maxlen)
+            _REGISTRY[name] = _Hub(maxlen, shed_high=shed_high, shed_low=shed_low)
         return _REGISTRY[name]
 
 
@@ -40,13 +49,28 @@ def reset(name: str = "default") -> None:
 
 
 class MemoryBroker(Broker):
-    def __init__(self, name: str = "default", maxlen: int = 4096):
-        self._hub = _hub(name, maxlen)
+    def __init__(
+        self, name: str = "default", maxlen: int = 4096, shed_high: int = 0, shed_low: int = 0
+    ):
+        self._hub = _hub(name, maxlen, shed_high=shed_high, shed_low=shed_low)
         self._seen_weights_seq = 0
+        self.shed_observed = 0
 
     def publish_experience(self, data: bytes) -> None:
         h = self._hub
         with h.lock:
+            if h.shed_high:
+                depth = len(h.experience)
+                if not h.shedding and depth >= h.shed_high:
+                    h.shedding = True
+                elif h.shedding and depth <= h.shed_low:
+                    h.shedding = False
+                if h.shedding:
+                    h.shed_total += 1
+                    self.shed_observed += 1
+                    raise BrokerShedError(
+                        "broker shed the publish (queue above watermark)"
+                    )
             if len(h.experience) == h.experience.maxlen:
                 h.dropped += 1
             h.experience.append(data)

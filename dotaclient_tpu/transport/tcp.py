@@ -14,12 +14,33 @@ Framing: every message is  u32 payload_len | u8 type | payload.
   0x03 PUB_W     payload = weight frame                → 0x81 ack
   0x04 GET_W     payload = u32 last_seen_seq           → 0x84 reply
   0x05 DEPTH     no payload                            → 0x85 reply
+  0x06 STATS     no payload                            → 0x87 reply
+  0x07 PUB_EXP2  payload = experience frame            → 0x81 ack | 0x86 shed
   0x81 ack       empty — publishes are acknowledged so a client can
                  DETECT a dead broker (an unacked sendall can succeed
                  into a dead socket's buffer) and reconnect/resend
   0x82 reply     u16 count, then per frame u32 len + bytes
   0x84 reply     u32 seq (0 = nothing newer), frame bytes
   0x85 reply     u32 depth, u32 dropped
+  0x86 shed      empty — the publish was REFUSED at admission (queue
+                 above the shed watermark); the frame was not enqueued.
+                 The client raises BrokerShedError so the producer can
+                 throttle (runtime/actor.py).
+  0x87 reply     u32 x6: depth, dropped, shed, enqueued, popped,
+                 reply_lost (conservation-ledger counters)
+
+Admission control (--shed_high/--shed_low, 0 = off, the pre-watermark
+behavior): at depth >= shed_high the broker starts REFUSING experience
+publishes instead of letting drop-oldest silently eat the backlog, and
+keeps refusing until depth drains to <= shed_low (hysteresis — no
+flapping at the boundary). New clients publish with PUB_EXP2 and get
+the explicit 0x86 SHED reply; a not-yet-upgraded client publishing with
+legacy PUB_EXP is shed by CLOSING its experience connection — its
+existing reconnect loop already treats that as a retryable error and
+resends with capped (now jittered) backoff, which is exactly the
+throttle we want from a client that cannot parse 0x86 (MIGRATION.md
+"SHED on the TCP wire"; upgrade brokers before clients — an old broker
+kills PUB_EXP2 connections).
 
 The client keeps two independent connections — one for the experience
 path, one for the weight path — so a long blocking consume never stalls
@@ -36,13 +57,17 @@ import threading
 import time
 from typing import List, Optional
 
-from dotaclient_tpu.transport.base import Broker
+from dotaclient_tpu.transport.base import Broker, BrokerShedError, RetryPolicy
 
 _LEN = struct.Struct("<I")
 _TYPE = struct.Struct("<B")
 
-PUB_EXP, CONSUME, PUB_W, GET_W, DEPTH = 0x01, 0x02, 0x03, 0x04, 0x05
-R_ACK, R_CONSUME, R_GET_W, R_DEPTH = 0x81, 0x82, 0x84, 0x85
+PUB_EXP, CONSUME, PUB_W, GET_W, DEPTH, STATS, PUB_EXP2 = (
+    0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07,
+)
+R_ACK, R_CONSUME, R_GET_W, R_DEPTH, R_SHED, R_STATS = (
+    0x81, 0x82, 0x84, 0x85, 0x86, 0x87,
+)
 
 MAX_FRAME = 256 * 1024 * 1024
 _POLL_SLICE = 30.0  # max per-request server-side wait when blocking forever
@@ -54,10 +79,39 @@ _POLL_SLICE = 30.0  # max per-request server-side wait when blocking forever
 class BrokerServer:
     """Asyncio broker server; `start()` runs it in a daemon thread."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 13370, maxlen: int = 4096):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 13370,
+        maxlen: int = 4096,
+        shed_high: int = 0,
+        shed_low: int = 0,
+    ):
+        if shed_high and shed_low >= shed_high:
+            raise ValueError(
+                f"shed_low={shed_low} must be below shed_high={shed_high} "
+                f"(hysteresis band)"
+            )
         self.host, self.port, self.maxlen = host, port, maxlen
+        self.shed_high, self.shed_low = shed_high, shed_low
+        self._shedding = False
         self.experience: collections.deque = collections.deque(maxlen=maxlen)
         self.dropped = 0
+        # Conservation-ledger counters (loop-thread-written; cross-thread
+        # reads see GIL-atomic int loads): every experience frame a
+        # client sent is exactly one of enqueued / shed; every enqueued
+        # frame is exactly one of popped / dropped / still-resident; a
+        # popped frame whose CONSUME reply failed mid-write is
+        # reply_lost (it died with the broker, not silently).
+        self.shed_total = 0  # refusals, both PUB_EXP2 replies and legacy closes
+        self.shed_closes = 0  # the legacy-client (connection-close) subset
+        self.enqueued_total = 0
+        self.popped_total = 0
+        self.reply_lost_frames = 0
+        self.first_enqueue_t: Optional[float] = None  # recovery-time probe
+        # Handlers currently parked in the CONSUME cond-wait (loop-thread
+        # only; tests poll it instead of sleeping and hoping).
+        self.consume_waiters = 0
         self.weights: Optional[bytes] = None
         self.weights_seq = 0
         self._cond: Optional[asyncio.Condition] = None
@@ -87,33 +141,92 @@ class BrokerServer:
             self._conns.discard(writer)
             writer.close()
 
+    def _admit(self) -> bool:
+        """Admission decision for one experience publish (called under
+        the cond). Hysteresis: refuse from depth >= shed_high until the
+        consumer drains depth back to <= shed_low."""
+        if not self.shed_high:
+            return True
+        depth = len(self.experience)
+        if not self._shedding and depth >= self.shed_high:
+            self._shedding = True
+        elif self._shedding and depth <= self.shed_low:
+            self._shedding = False
+        return not self._shedding
+
     async def _dispatch(self, mtype: int, payload: bytes, writer: asyncio.StreamWriter):
         assert self._cond is not None
-        if mtype == PUB_EXP:
+        if mtype in (PUB_EXP, PUB_EXP2):
             async with self._cond:
-                if len(self.experience) == self.experience.maxlen:
-                    self.dropped += 1
-                self.experience.append(payload)
-                self._cond.notify_all()
-            await self._reply(writer, R_ACK, b"")
+                admitted = self._admit()
+                if admitted:
+                    if len(self.experience) == self.experience.maxlen:
+                        self.dropped += 1
+                    self.experience.append(payload)
+                    self.enqueued_total += 1
+                    if self.first_enqueue_t is None:
+                        self.first_enqueue_t = time.monotonic()
+                    self._cond.notify_all()
+                else:
+                    self.shed_total += 1
+            if admitted:
+                await self._reply(writer, R_ACK, b"")
+            elif mtype == PUB_EXP2:
+                await self._reply(writer, R_SHED, b"")
+            else:
+                # Legacy client: it cannot parse 0x86 (its reply
+                # validation would die on the unknown type), but its
+                # reconnect loop DOES handle a closed connection —
+                # close, and its capped-backoff resend becomes the
+                # throttle (module docstring "Admission control").
+                self.shed_closes += 1
+                writer.close()
+                raise ConnectionResetError("shed: legacy publisher connection closed")
         elif mtype == CONSUME:
             max_items, timeout = struct.unpack("<Hf", payload)
             async with self._cond:
                 if not self.experience and timeout > 0:
+                    self.consume_waiters += 1
                     try:
                         await asyncio.wait_for(
                             self._cond.wait_for(lambda: len(self.experience) > 0), timeout
                         )
                     except asyncio.TimeoutError:
                         pass
+                    finally:
+                        self.consume_waiters -= 1
                 frames = []
                 while self.experience and len(frames) < max_items:
                     frames.append(self.experience.popleft())
+                self.popped_total += len(frames)
             out = [struct.pack("<H", len(frames))]
             for f in frames:
                 out.append(_LEN.pack(len(f)))
                 out.append(f)
-            await self._reply(writer, R_CONSUME, b"".join(out))
+            try:
+                await self._reply(writer, R_CONSUME, b"".join(out))
+            except BaseException:
+                # Popped frames whose reply never completed (connection
+                # died / server killed mid-write): they leave with this
+                # broker, and the ledger must say so rather than leak
+                # them as "consumed by nobody" (CancelledError is the
+                # kill path, hence BaseException).
+                self.reply_lost_frames += len(frames)
+                raise
+        elif mtype == STATS:
+            await self._reply(
+                writer,
+                R_STATS,
+                struct.pack(
+                    "<6I",
+                    len(self.experience),
+                    self.dropped,
+                    self.shed_total,
+                    self.enqueued_total,
+                    self.popped_total,
+                    self.reply_lost_frames,
+                ),
+            )
         elif mtype == PUB_W:
             self.weights_seq += 1
             self.weights = payload
@@ -192,6 +305,22 @@ class BrokerServer:
         finally:
             loop.close()
 
+    def ledger(self) -> dict:
+        """Conservation-counter snapshot. Exact only AFTER stop() has
+        joined the loop thread (the soak's post-mortem read); while the
+        server is live it is a monotonic best-effort gauge. The identity
+        `enqueued == popped + dropped + resident` holds at any quiescent
+        point — scripts/chaos_soak.py asserts it per broker incarnation."""
+        return {
+            "enqueued": self.enqueued_total,
+            "popped": self.popped_total,
+            "dropped_oldest": self.dropped,
+            "shed": self.shed_total,
+            "shed_closes": self.shed_closes,
+            "reply_lost": self.reply_lost_frames,
+            "resident": len(self.experience),
+        }
+
     def stop(self):
         # Single atomic read: the loop thread rebinds _loop once at boot;
         # a local ref keeps the aliveness check and the call_soon from
@@ -225,10 +354,19 @@ class _Conn:
     buys nothing this system needs.
     """
 
-    def __init__(self, addr, connect_timeout: float, retry_window: float = 60.0):
+    def __init__(
+        self,
+        addr,
+        connect_timeout: float,
+        retry_window: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
+    ):
         self.addr = addr
         self.connect_timeout = connect_timeout
-        self.retry_window = retry_window
+        self.retry = retry if retry is not None else RetryPolicy()
+        # Kept as a mutable attribute (not read from the policy) because
+        # tests and callers tune the window per-connection.
+        self.retry_window = retry_window if retry_window is not None else self.retry.window_s
         self.lock = threading.Lock()
         self.sock: Optional[socket.socket] = None
         self._connect()  # fail fast at boot — a wrong URL should not retry
@@ -254,12 +392,17 @@ class _Conn:
         """
         with self.lock:
             deadline = time.monotonic() + self.retry_window
-            backoff = 0.1
+            backoff = self.retry.backoff_base_s
             while True:
                 try:
                     if self.sock is None:
                         self._connect()
                     return self._request_once(mtype, payload, expected_reply, read_timeout)
+                except BrokerShedError:
+                    # NOT a connection failure: the broker is alive and
+                    # said "less, please". The socket stays open and the
+                    # caller owns the throttle policy.
+                    raise
                 except (ConnectionError, OSError):
                     if self.sock is not None:
                         try:
@@ -269,8 +412,11 @@ class _Conn:
                         self.sock = None
                     if time.monotonic() >= deadline:
                         raise
-                    time.sleep(backoff)
-                    backoff = min(backoff * 2.0, 2.0)
+                    # Jittered: a broker restart wakes the whole fleet at
+                    # once, and an unjittered ladder has every client
+                    # retry in the same instant forever after.
+                    time.sleep(self.retry.sleep_for(backoff))
+                    backoff = self.retry.next_backoff(backoff)
 
     def _request_once(
         self, mtype: int, payload: bytes, expected_reply: Optional[int], read_timeout: float
@@ -286,6 +432,12 @@ class _Conn:
         hdr = self._recv_exact(_LEN.size + _TYPE.size)
         (n,) = _LEN.unpack_from(hdr)
         (rtype,) = _TYPE.unpack_from(hdr, _LEN.size)
+        if rtype == R_SHED and expected_reply == R_ACK:
+            # Drain the (empty) payload first so the stream stays framed
+            # for the next request on this healthy connection.
+            if n:
+                self._recv_exact(n)
+            raise BrokerShedError("broker shed the publish (queue above watermark)")
         if rtype != expected_reply:
             raise ValueError(f"unexpected reply type {rtype:#x}")
         return self._recv_exact(n) if n else b""
@@ -309,14 +461,27 @@ class _Conn:
 class TcpBroker(Broker):
     """Blocking, thread-safe client of BrokerServer."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 13370, connect_timeout: float = 10.0):
-        self._exp = _Conn((host, port), connect_timeout)
-        self._w = _Conn((host, port), connect_timeout)
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 13370,
+        connect_timeout: float = 10.0,
+        retry: Optional[RetryPolicy] = None,
+    ):
+        self._exp = _Conn((host, port), connect_timeout, retry=retry)
+        self._w = _Conn((host, port), connect_timeout, retry=retry)
         self._seen_weights_seq = 0
         self._w_generation = self._w.generation
+        # Publishes refused at admission (BrokerShedError observed) —
+        # the actor throttle's meter.
+        self.shed_observed = 0
 
     def publish_experience(self, data: bytes) -> None:
-        self._exp.request(PUB_EXP, data, R_ACK)
+        try:
+            self._exp.request(PUB_EXP2, data, R_ACK)
+        except BrokerShedError:
+            self.shed_observed += 1
+            raise
 
     def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -368,6 +533,21 @@ class TcpBroker(Broker):
         assert payload is not None
         depth, _dropped = struct.unpack("<II", payload)
         return depth
+
+    def stats(self) -> dict:
+        """Broker-side counters (R_STATS): the load-shed / conservation
+        gauges the soak and the obs scrape read remotely."""
+        payload = self._w.request(STATS, b"", R_STATS)
+        assert payload is not None
+        depth, dropped, shed, enqueued, popped, reply_lost = struct.unpack("<6I", payload)
+        return {
+            "depth": depth,
+            "dropped_oldest": dropped,
+            "shed": shed,
+            "enqueued": enqueued,
+            "popped": popped,
+            "reply_lost": reply_lost,
+        }
 
     def close(self) -> None:
         self._exp.close()

@@ -17,9 +17,31 @@ def main(argv=None):
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=13370)
     p.add_argument("--maxlen", type=int, default=4096, help="experience queue bound (drop-oldest)")
+    p.add_argument(
+        "--shed_high",
+        type=int,
+        default=0,
+        help="admission-control high watermark: refuse (SHED) experience "
+        "publishes at this queue depth instead of growing toward drop-oldest "
+        "(0 = admission control off)",
+    )
+    p.add_argument(
+        "--shed_low",
+        type=int,
+        default=0,
+        help="low watermark: resume admitting once the queue drains to this "
+        "depth (hysteresis; must be < --shed_high)",
+    )
     args = p.parse_args(argv)
-    server = BrokerServer(args.host, args.port, args.maxlen).start()
-    print(f"broker listening on {args.host}:{server.port} (queue bound {args.maxlen})", flush=True)
+    server = BrokerServer(
+        args.host, args.port, args.maxlen, shed_high=args.shed_high, shed_low=args.shed_low
+    ).start()
+    shed = f", shed {args.shed_high}/{args.shed_low}" if args.shed_high else ""
+    print(
+        f"broker listening on {args.host}:{server.port} "
+        f"(queue bound {args.maxlen}{shed})",
+        flush=True,
+    )
     try:
         while True:
             time.sleep(60)

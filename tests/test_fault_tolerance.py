@@ -24,6 +24,34 @@ from tests.test_transport import make_rollout
 SMALL = PolicyConfig(unit_embed_dim=8, lstm_hidden=8, mlp_hidden=8, dtype="float32")
 
 
+# Bounded polling instead of sleep-and-hope: under CPU contention a
+# fixed sleep is exactly long enough on an idle box and exactly too
+# short on a loaded one.
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+def restart_broker_on(port: int, timeout=10.0, **kw) -> BrokerServer:
+    """Bring a broker back on a just-vacated port: retry until the old
+    socket is actually released (the restart choreography that used to
+    be `time.sleep(0.2)` and prayer)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return BrokerServer(port=port, **kw).start()
+        except (RuntimeError, OSError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
 # --------------------------------------------------------------- tcp chaos
 
 
@@ -38,8 +66,7 @@ def test_tcp_broker_survives_server_restart():
     assert client.poll_weights() == b"w-1"
 
     server.stop()  # ---- the broker dies ----
-    time.sleep(0.2)
-    restarted = BrokerServer(port=port).start()  # ---- and comes back ----
+    restarted = restart_broker_on(port)  # ---- and comes back ----
     try:
         # experience path reconnects (retry window absorbs the gap)
         client.publish_experience(b"frame-2")
@@ -76,7 +103,9 @@ def test_tcp_broker_stop_interrupts_parked_consume():
 
     t = threading.Thread(target=consumer, daemon=True)
     t.start()
-    time.sleep(0.5)  # let the consume park server-side
+    # poll the server's own waiter gauge — the consume is provably
+    # parked in the condition wait, however loaded the box is
+    wait_until(lambda: server.consume_waiters >= 1, what="consume parked server-side")
     t0 = time.monotonic()
     server.stop()
     assert time.monotonic() - t0 < 3.0

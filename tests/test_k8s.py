@@ -179,6 +179,37 @@ def test_learner_manifests_keep_pipelined_loop():
         )
 
 
+def test_broker_ships_admission_watermarks():
+    """The production broker must run with load-shed armed: shed_high
+    below the drop-oldest bound (overload surfaces at producers, not as
+    silent oldest-frame loss) and a real hysteresis band under it."""
+    (_, doc), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "broker" and d["kind"] == "Deployment"
+    ]
+    args = doc["spec"]["template"]["spec"]["containers"][0]["args"]
+    vals = {k: int(args[args.index(k) + 1]) for k in ("--maxlen", "--shed_high", "--shed_low")}
+    assert 0 < vals["--shed_low"] < vals["--shed_high"] < vals["--maxlen"]
+
+
+def test_chaos_pinned_off_in_all_prod_manifests():
+    """Chaos fault injection is a soak-only tool: every production
+    container of this package that HAS the flag must pin it false, so a
+    copy-pasted soak flag can never arm it in a fleet."""
+    checked = 0
+    for fname, c in _our_containers():
+        cmd = c.get("command")
+        if cmd is None or cmd[2] == "dotaclient_tpu.transport.tcp_server":
+            continue  # the broker binary has no chaos surface
+        args = c.get("args", [])
+        flags = [a for a in args if a.endswith("chaos.enabled")]
+        assert flags, f"{fname}: chaos.enabled not pinned"
+        for flag in flags:
+            assert args[args.index(flag) + 1] == "false", f"{fname}: chaos not pinned OFF"
+        checked += 1
+    assert checked >= 4  # learner, learner-multihost, actors, evaluator
+
+
 def test_actor_fleet_scale_and_kill_switch():
     (_, doc), = [(f, d) for f, d in DOCS if d["metadata"]["name"] == "actors"]
     assert doc["spec"]["replicas"] >= 2

@@ -417,6 +417,28 @@ def test_actor_fleet_scalars_are_registered():
     }
 
 
+def test_chaos_and_shed_scalars_are_registered():
+    """Chaos-era names (ISSUE 6): the staging quarantine scalar, the
+    broker_shed_* publish-degradation family (ShedThrottle.stats /
+    VectorActor.stats), and the chaos_* fault-injection meters
+    (ChaosBroker.meters) — pinned against the registry so a rename
+    breaks tier-1, not a dashboard."""
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.runtime.actor import ShedThrottle
+
+    assert registry.is_registered("staging_quarantined")
+    missing = registry.unregistered(ShedThrottle().stats().keys())
+    assert not missing, f"shed-throttle scalars not in obs/registry.py: {missing}"
+    from dotaclient_tpu.chaos import ChaosBroker, FaultSchedule
+    from dotaclient_tpu.transport.memory import MemoryBroker
+    from dotaclient_tpu.transport import memory as mem
+
+    mem.reset("obs-chaos-pin")
+    cb = ChaosBroker(MemoryBroker("obs-chaos-pin"), FaultSchedule.parse("", seed=0))
+    missing = registry.unregistered(k for k in cb.stats() if k.startswith("chaos_"))
+    assert not missing, f"chaos meters not in obs/registry.py: {missing}"
+
+
 # --------------------------------------------------- scrape surface
 
 

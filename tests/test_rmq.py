@@ -156,6 +156,80 @@ def test_unacked_frames_survive_consumer_death(monkeypatch):
     assert consume_all(fresh) == [bytes([i]) for i in range(3, 8)]
 
 
+# ----------------------------------------------------- injected faults
+#
+# The r5 VERDICT flagged transport/rmq.py as never having executed
+# against a mid-stream failure. fake_pika.inject() arms countdown faults
+# (connection stream loss, broker-side channel close, publish return);
+# these pin the reconnect/redelivery contract the hardening added.
+
+
+def test_publish_survives_connection_reset_midstream(rmq):
+    """The 3rd publish hits a TCP-reset-shaped StreamLostError (frame not
+    enqueued): the client must reconnect, resend, and every frame arrive
+    exactly once, in order."""
+    producer, consumer = rmq(), rmq()
+    fake_pika.inject(publish_stream_lost_in=3)
+    for i in range(6):
+        producer.publish_experience(bytes([i]))
+    assert producer.reconnects == 1
+    got = consume_all(consumer)
+    assert got == [bytes([i]) for i in range(6)]
+
+
+def test_consume_survives_channel_close_redelivers_unacked(rmq):
+    """Mid-consume channel close: deliveries sitting unacked client-side
+    must NOT be lost — the broker requeues them and the reconnected
+    consumer sees every frame exactly once (AMQP redelivery)."""
+    from dotaclient_tpu.transport.rmq import RmqBroker
+
+    producer, consumer = rmq(), RmqBroker(URL, prefetch=4)
+    for i in range(8):
+        producer.publish_experience(bytes([i]))
+    # prefetch pulls 4 unacked into _exp_buf; we take/ack 2 of them
+    got = consumer.consume_experience(max_items=2, timeout=0.5)
+    assert got == [bytes([0]), bytes([1])]
+    assert len(consumer._exp_buf) == 2  # delivered, unacked
+    # next pump dies: the channel closes broker-side, requeueing the 2
+    # unacked (and the client must drop its dead-tag buffer, not ack
+    # ghosts on the new channel)
+    fake_pika.inject(channel_close_in=1)
+    rest = consume_all(consumer)
+    assert consumer.reconnects == 1
+    assert rest == [bytes([i]) for i in range(2, 8)]
+
+
+def test_publish_return_redeclares_and_retries(rmq):
+    """An unroutable publish return (topology gone — e.g. a broker that
+    restarted empty) reconnects, re-declares the queue, and resends."""
+    producer, consumer = rmq(), rmq()
+    fake_pika.inject(publish_return_in=1)
+    producer.publish_experience(b"came-back")
+    assert producer.reconnects == 1
+    assert consume_all(consumer) == [b"came-back"]
+
+
+def test_reconnect_gives_up_after_retry_window(monkeypatch):
+    """A broker that stays dead must bound the retry loop: the window
+    expires and the original error surfaces (no infinite reconnect)."""
+    monkeypatch.setitem(sys.modules, "pika", fake_pika)
+    fake_pika.reset()
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.rmq import RmqBroker
+
+    b = RmqBroker(URL, retry=RetryPolicy(window_s=0.3, backoff_base_s=0.02))
+    # every reconnect attempt dies too: patch connect to always raise
+    monkeypatch.setattr(
+        fake_pika.BlockingConnection,
+        "process_data_events",
+        lambda self, time_limit=0: (_ for _ in ()).throw(
+            fake_pika.exceptions.StreamLostError("down")
+        ),
+    )
+    with pytest.raises(fake_pika.exceptions.StreamLostError):
+        b.consume_experience(max_items=1, timeout=2.0)
+
+
 @pytest.mark.skipif(
     "DOTACLIENT_TPU_AMQP_URL" not in __import__("os").environ,
     reason="set DOTACLIENT_TPU_AMQP_URL to a live RabbitMQ to run",

@@ -432,3 +432,106 @@ class TestTcpBroker:
 def test_connect_unknown_scheme():
     with pytest.raises(ValueError):
         connect("bogus://x")
+
+
+# --- admission control: the SHED reply + rolling upgrade ----------------
+
+
+class TestBrokerShed:
+    @pytest.fixture()
+    def shedding(self):
+        s = BrokerServer(port=0, maxlen=16, shed_high=4, shed_low=2).start()
+        yield s
+        s.stop()
+
+    def test_new_client_sheds_with_explicit_reply_and_hysteresis(self, shedding):
+        from dotaclient_tpu.transport.base import BrokerShedError
+
+        c = TcpBroker(port=shedding.port)
+        for i in range(4):
+            c.publish_experience(bytes([i]))
+        with pytest.raises(BrokerShedError):
+            c.publish_experience(b"over")
+        assert c.shed_observed == 1
+        # connection stayed healthy: no reconnect happened, and the
+        # next request on the same socket works
+        c.consume_experience(1, timeout=0.5)  # depth 3: hysteresis holds
+        with pytest.raises(BrokerShedError):
+            c.publish_experience(b"still-shedding")
+        c.consume_experience(10, timeout=0.5)  # drain to <= low
+        c.publish_experience(b"resumed")
+        assert shedding.shed_total == 2 and shedding.dropped == 0
+        c.close()
+
+    def test_legacy_client_sees_shed_as_retryable_and_recovers(self, shedding):
+        """Rolling upgrade (MIGRATION.md): a pre-SHED client publishes
+        with opcode PUB_EXP and cannot parse 0x86 — the broker sheds it
+        by CLOSING the connection, which the old client's existing
+        reconnect loop already treats as a retryable error: it backs
+        off, resends, and succeeds once the queue drains. The old
+        client's own code path (_Conn.request with PUB_EXP) is the
+        emulation."""
+        from dotaclient_tpu.transport.tcp import PUB_EXP, R_ACK, _Conn
+
+        new_client = TcpBroker(port=shedding.port)
+        for i in range(4):
+            new_client.publish_experience(bytes([i]))
+        legacy = _Conn(("127.0.0.1", shedding.port), connect_timeout=5.0, retry_window=20.0)
+
+        # drain the queue after a delay, while the legacy publish is
+        # parked in its reconnect/backoff loop
+        def drain_later():
+            time.sleep(0.8)
+            new_client.consume_experience(100, timeout=0.5)
+
+        t = threading.Thread(target=drain_later, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        legacy.request(PUB_EXP, b"legacy-frame", R_ACK)  # retries through the sheds
+        assert time.monotonic() - t0 > 0.5  # it genuinely waited out the shed
+        t.join(timeout=5)
+        assert shedding.shed_closes >= 1
+        frames = new_client.consume_experience(10, timeout=1.0)
+        assert b"legacy-frame" in frames
+        legacy.close()
+        new_client.close()
+
+    def test_stats_roundtrip_and_ledger(self, shedding):
+        c = TcpBroker(port=shedding.port)
+        c.publish_experience(b"a")
+        c.publish_experience(b"b")
+        c.consume_experience(1, timeout=0.5)
+        st = c.stats()
+        assert st["enqueued"] == 2 and st["popped"] == 1 and st["depth"] == 1
+        assert st["shed"] == 0 and st["reply_lost"] == 0
+        assert st["enqueued"] == st["popped"] + st["dropped_oldest"] + st["depth"]
+        c.close()
+
+
+def test_shed_off_by_default_wire_unchanged():
+    """Without watermarks the admission path is inert: no shed state,
+    publishes ack exactly as before (the golden-bytes tests above pin
+    the frame layouts themselves)."""
+    s = BrokerServer(port=0, maxlen=4).start()
+    c = TcpBroker(port=s.port)
+    for i in range(8):  # past maxlen: drop-oldest, never shed
+        c.publish_experience(bytes([i]))
+    time.sleep(0.1)
+    assert s.shed_total == 0 and s.dropped == 4
+    assert c.shed_observed == 0
+    c.close()
+    s.stop()
+
+
+def test_retry_policy_jitter_bounds():
+    import random
+
+    from dotaclient_tpu.transport.base import RetryPolicy
+
+    p = RetryPolicy(backoff_base_s=0.1, backoff_cap_s=2.0, jitter=0.5, rng=random.Random(1))
+    draws = {p.sleep_for(1.0) for _ in range(200)}
+    assert all(0.5 <= d <= 1.5 for d in draws)
+    assert len(draws) > 100  # actually jittered, not constant
+    assert p.next_backoff(1.5) == 2.0  # capped
+    # jitter 0 = deterministic (the pre-chaos ladder)
+    assert RetryPolicy(jitter=0.0).sleep_for(0.4) == 0.4
