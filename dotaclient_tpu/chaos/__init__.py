@@ -13,8 +13,9 @@ the env stub) in deterministic scheduled faults:
                       latency/stall around any Broker;
 - chaos/env.py        ChaosEnvStub: env latency + session-loss faults
                       inside the protocol the actor already handles;
-- chaos/controller.py broker kill/restart execution + exact per-
-                      incarnation conservation ledgers.
+- chaos/controller.py broker AND learner kill/restart execution
+                      (kill@T:D@broker|learner[:term|kill] routing) +
+                      exact per-incarnation conservation ledgers.
 
 Production inertness is a hard contract: binaries import this package
 ONLY under `--chaos.enabled` (k8s manifests pin it false), so the off
@@ -31,7 +32,11 @@ degradation proof (CHAOS_SOAK.json).
 from __future__ import annotations
 
 from dotaclient_tpu.chaos.broker import ChaosBroker
-from dotaclient_tpu.chaos.controller import BrokerIncarnations, ScheduleRunner
+from dotaclient_tpu.chaos.controller import (
+    BrokerIncarnations,
+    LearnerIncarnations,
+    ScheduleRunner,
+)
 from dotaclient_tpu.chaos.env import ChaosEnvStub
 from dotaclient_tpu.chaos.schedule import FaultSchedule, OpFaults, TimedEvent
 
@@ -40,6 +45,7 @@ __all__ = [
     "ChaosBroker",
     "ChaosEnvStub",
     "FaultSchedule",
+    "LearnerIncarnations",
     "OpFaults",
     "ScheduleRunner",
     "TimedEvent",

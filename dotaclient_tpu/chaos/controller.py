@@ -1,28 +1,41 @@
-"""Broker kill/restart execution + post-mortem ledger harvesting.
+"""Kill/restart execution + post-mortem ledger harvesting, for the two
+stateful processes a client-side fault wrapper cannot kill.
 
-The one fault a client-side wrapper cannot inject is the broker DYING:
-that belongs to whoever owns the server process. `BrokerIncarnations`
-owns a sequence of in-process tcp BrokerServer incarnations on ONE port
-and harvests each incarnation's conservation ledger at kill time —
-exact, because the counters are read AFTER stop() joined the server
-loop. `ScheduleRunner` executes a FaultSchedule's kill events against
-it on a side thread.
+`BrokerIncarnations` owns a sequence of in-process tcp BrokerServer
+incarnations on ONE port and harvests each incarnation's conservation
+ledger at kill time — exact, because the counters are read AFTER stop()
+joined the server loop. `LearnerIncarnations` is its learner-side
+sibling: sequential in-process Learner lives against one broker and one
+checkpoint directory, with SIGTERM (drain: the same request_drain →
+train-out → drain_save path the real signal handler invokes) and
+SIGKILL (abort mid-flight, discard queued saves, nothing persisted
+beyond what already hit disk) variants — in-process for the same reason
+the broker is: a real kill -9 vaporizes the very counters the
+conservation proof needs, while the abandoned object still holds them.
+`ScheduleRunner` executes a FaultSchedule's kill events against either
+controller on a side thread, routed by the spec's kill-target selector
+(`kill@T:D@broker|learner[:sig]`, chaos/schedule.py).
 
-Recovery-time probe: each incarnation records the monotonic time of its
-first post-boot enqueue (transport/tcp.py `first_enqueue_t`); recovery
-after a kill = that minus the restart completion time — i.e. how long
-the fleet's jittered reconnect/backoff took to actually land a frame in
-the reborn broker.
+Recovery-time probes: a broker incarnation records the monotonic time
+of its first post-boot enqueue (transport/tcp.py `first_enqueue_t`);
+recovery after a broker kill = that minus the restart completion time —
+how long the fleet's jittered reconnect/backoff took to actually land a
+frame in the reborn broker. A learner incarnation's recovery = restart
+completion to its first post-restore trained step (the version counter
+advancing past the resumed high-water mark).
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from dotaclient_tpu.chaos.schedule import FaultSchedule
 from dotaclient_tpu.transport.tcp import BrokerServer
+
+_log = logging.getLogger(__name__)
 
 
 class BrokerIncarnations:
@@ -95,14 +108,191 @@ class BrokerIncarnations:
             total["incarnations"] = len(self.ledgers)
             return total
 
-class ScheduleRunner:
-    """Execute a schedule's kill events against BrokerIncarnations on a
-    daemon thread, relative to a shared epoch `t0`."""
+class LearnerIncarnations:
+    """Sequential in-process Learner lives sharing one checkpoint dir.
 
-    def __init__(self, schedule: FaultSchedule, broker: BrokerIncarnations, t0: float):
+    `make_learner` builds (and thereby restores) a fresh Learner; the
+    controller runs each life's `run()` on a daemon thread and executes
+    the two death variants against it:
+
+    - kill(sig="term"): the SIGTERM drain — request_drain(), join the
+      loop (which trains out already-staged batches), drain_save() with
+      wait=True. A clean exit is part of the harvested ledger.
+    - kill(sig="kill"): SIGKILL emulation — abort() the loop mid-flight
+      and DISCARD queued async-checkpoint/aux/mirror work; the next
+      incarnation restores from whatever the periodic cadence already
+      made durable (plus the publisher's version high-water file).
+      Known emulation gap: a save already INSIDE its orbax commit at
+      kill time completes (an in-process emulation cannot abort a
+      mid-write commit, and half-killing it would corrupt the very
+      directory under test) — so the restored step can be at most one
+      save newer than a true kill -9 would allow. The resume soak's
+      SIGKILL claims (bounded divergence, conservation, monotonic hwm)
+      are restore-point-agnostic, and its part-A kill offsets are
+      chosen off the checkpoint cadence so the worker is provably idle.
+
+    Every death harvests the dead life's staging/replay counters EXACTLY
+    (the in-process advantage — see module docstring), so the resume
+    soak's conservation ledger can account each popped frame even for a
+    life that "lost" its in-flight work.
+    """
+
+    def __init__(self, make_learner: Callable[[], object], run_kwargs: Optional[dict] = None):
+        self.make_learner = make_learner
+        self.run_kwargs = dict(run_kwargs or {})
+        self.learner = None
+        self._thread: Optional[threading.Thread] = None
+        self._run_error: Optional[str] = None
+        self.lives: List[dict] = []  # one ledger per DEAD incarnation
+        self.boots: List[dict] = []  # one record per boot (construct/restore)
+        self.kill_times: List[float] = []
+        self.restart_times: List[float] = []
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "LearnerIncarnations":
+        with self._lock:
+            if self.learner is not None:
+                raise RuntimeError("start() with a live incarnation")
+            t0 = time.monotonic()
+            learner = self.make_learner()
+            boot = {
+                "construct_s": round(time.monotonic() - t0, 3),
+                "resume_version": int(learner.version),
+                "resume": learner.resume_info,
+            }
+            self.boots.append(boot)
+            self.learner = learner
+            self._run_error = None
+
+            def _loop():
+                try:
+                    learner.run(**self.run_kwargs)
+                except Exception as e:  # harvested into the life ledger
+                    self._run_error = f"{type(e).__name__}: {e}"
+                    _log.exception("learner incarnation loop died")
+
+            self._thread = threading.Thread(target=_loop, daemon=True, name="learner-life")
+            self._thread.start()
+            self.restart_times.append(time.monotonic())
+        return self
+
+    def kill(self, sig: str = "kill") -> dict:
+        """Execute one death; returns the harvested life ledger."""
+        if sig not in ("kill", "term"):
+            raise ValueError(f"unknown learner kill signal {sig!r}")
+        with self._lock:
+            learner = self.learner
+            if learner is None:
+                raise RuntimeError("kill() with no live incarnation")
+            t0 = time.monotonic()
+            if sig == "term":
+                learner.request_drain()
+            else:
+                learner.abort()
+            self._thread.join(timeout=120)
+            joined = not self._thread.is_alive()
+            if sig == "term" and joined:
+                learner.drain_save()
+            else:
+                learner.discard_unsaved()
+            s = learner.staging.stats()
+            # Single atomic read: the loop thread rebinds _run_error once
+            # on death; a local keeps exit_clean and loop_error coherent.
+            run_error = self._run_error
+            led = {
+                "sig": sig,
+                "exit_clean": bool(joined and run_error is None and sig == "term"),
+                "loop_error": run_error,
+                "death_wall_s": round(time.monotonic() - t0, 3),
+                "version": int(learner.version),
+                "consumed": int(s["consumed"]),
+                "dropped_stale": int(s["dropped_stale"]),
+                "dropped_bad": int(s["dropped_bad"]),
+                "quarantined": int(s["quarantined"]),
+                "rows_packed": int(s["rows_packed"]),
+                "rows_replayed": int(s.get("rows_replayed", 0)),
+                "replay_admitted": int(s.get("replay_admitted", 0)),
+                "pending_at_death": int(s["pending_rollouts"]),
+                "ready_batches_at_death": int(s["ready_batches"]),
+                "reservoir_at_death": int(s.get("replay_occupancy", 0)),
+                "resume_version": self.boots[-1]["resume_version"],
+                "resume_pending": int(self.boots[-1]["resume"].get("resume_pending_frames", 0)),
+                "resume_reservoir": int(
+                    self.boots[-1]["resume"].get("resume_reservoir_entries", 0)
+                ),
+                "killed_at": time.monotonic(),
+            }
+            obs = getattr(learner, "obs", None)
+            if obs is not None and obs.watchdog is not None:
+                led["watchdog"] = obs.watchdog.verdict()
+            learner.close()
+            self.learner = None
+            self._thread = None
+            self.lives.append(led)
+            self.kill_times.append(led["killed_at"])
+            return led
+
+    def restart(self) -> None:
+        """Boot the next incarnation (restores from the shared dir)."""
+        self.start()
+
+    def wait_first_step(self, timeout: float = 30.0, stop: Optional[threading.Event] = None):
+        """Monotonic time when the reborn learner's version counter first
+        advanced past its resumed value (None if it never did) — the
+        learner-side recovery probe."""
+        learner = self.learner
+        if learner is None:
+            return None
+        base = self.boots[-1]["resume_version"]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and (stop is None or not stop.is_set()):
+            if learner.version > base:
+                return time.monotonic()
+            time.sleep(0.02)
+        return None
+
+    def final_ledger(self) -> dict:
+        """Kill any live incarnation cleanly (drain) and sum the lives."""
+        with self._lock:
+            live = self.learner is not None
+        if live:
+            self.kill(sig="term")
+            self.lives[-1]["killed_at"] = None  # run end, not a chaos kill
+        keys = (
+            "consumed", "dropped_stale", "dropped_bad", "quarantined",
+            "rows_packed", "rows_replayed", "replay_admitted",
+            "pending_at_death", "ready_batches_at_death", "reservoir_at_death",
+            "resume_pending", "resume_reservoir",
+        )
+        total = {k: sum(l[k] for l in self.lives) for k in keys}
+        total["incarnations"] = len(self.lives)
+        return total
+
+
+class ScheduleRunner:
+    """Execute a schedule's kill events on a daemon thread, relative to
+    a shared epoch `t0`, routed by each event's kill-target selector:
+    broker kills against a BrokerIncarnations, learner kills (SIGTERM or
+    SIGKILL variant) against a LearnerIncarnations."""
+
+    def __init__(
+        self,
+        schedule: FaultSchedule,
+        broker: Optional[BrokerIncarnations],
+        t0: float,
+        learner: Optional[LearnerIncarnations] = None,
+    ):
         self.schedule = schedule
         self.broker = broker
+        self.learner_inc = learner
         self.t0 = t0
+        for ev in schedule.kills():
+            if ev.target == "learner" and learner is None:
+                raise ValueError("schedule kills the learner but no LearnerIncarnations given")
+            if ev.target == "broker" and broker is None:
+                raise ValueError("schedule kills the broker but no BrokerIncarnations given")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # (kill_index, restart_monotonic, first_enqueue_monotonic | None)
@@ -126,6 +316,24 @@ class ScheduleRunner:
         for k, ev in enumerate(self.schedule.kills()):
             if not self._sleep_until(ev.at_s):
                 return
+            if ev.target == "learner":
+                self.learner_inc.kill(sig=ev.signal)
+                if not self._sleep_until(ev.at_s + ev.duration_s):
+                    return
+                self.learner_inc.restart()
+                restarted = time.monotonic()
+                first = self.learner_inc.wait_first_step(timeout=30.0, stop=self._stop)
+                self.recovery.append(
+                    {
+                        "kill_index": k,
+                        "target": "learner",
+                        "sig": ev.signal,
+                        "at_s": ev.at_s,
+                        "down_s": round(ev.duration_s, 3),
+                        "recovery_s": None if first is None else round(first - restarted, 3),
+                    }
+                )
+                continue
             self.broker.kill()
             if not self._sleep_until(ev.at_s + ev.duration_s):
                 return
@@ -144,6 +352,7 @@ class ScheduleRunner:
             self.recovery.append(
                 {
                     "kill_index": k,
+                    "target": "broker",
                     "at_s": ev.at_s,
                     "down_s": round(ev.duration_s, 3),
                     "recovery_s": None if first is None else round(first - restarted, 3),

@@ -22,6 +22,17 @@ Timed one-shots (wall-clock offsets from the schedule epoch `t0`):
                     by a ScheduleRunner against a controller that owns
                     the broker process (chaos/controller.py), because a
                     client-side wrapper cannot kill a server
+    kill@T:D@TGT    kill-target selector: TGT is `broker` (the default,
+                    identical to the bare form) or `learner[:SIG]`
+                    where SIG is `kill` (SIGKILL semantics: nothing
+                    saved, recovery from the last periodic checkpoint)
+                    or `term` (SIGTERM drain: train out staged batches,
+                    full-state save, clean exit) — executed against a
+                    LearnerIncarnations controller. Timed events never
+                    consume per-op rate draws, so the selector leaves
+                    the canonical draw order of every existing spec
+                    untouched (pinned by the golden decision-sequence
+                    test in tests/test_chaos.py).
 
 Determinism contract: the decision for operation index i draws from
 `random.Random(seed * 1_000_003 + i)` in a FIXED canonical order, for
@@ -45,6 +56,8 @@ class TimedEvent:
     kind: str  # "stall" | "kill"
     at_s: float  # offset from the schedule epoch
     duration_s: float
+    target: str = "broker"  # "broker" | "learner" (kill only)
+    signal: str = "kill"  # "kill" (SIGKILL) | "term" (SIGTERM drain); learner only
 
 
 @dataclass
@@ -79,7 +92,32 @@ class FaultSchedule:
                 kind, _, at = name.partition("@")
                 if kind not in ("stall", "kill"):
                     raise ValueError(f"unknown timed fault {kind!r} in {clause!r}")
-                sched.events.append(TimedEvent(kind, float(at), float(arg)))
+                # kill@T:D@TGT[:SIG] — the kill-target selector. The
+                # selector rides the ARG side of the clause, so existing
+                # bare specs parse byte-identically (target defaults to
+                # broker) and the canonical rate-draw order never moves.
+                dur, _, tail = arg.partition("@")
+                target, sig = "broker", "kill"
+                if tail:
+                    if kind != "kill":
+                        raise ValueError(
+                            f"target selector only applies to kill, not {kind!r} "
+                            f"in {clause!r}"
+                        )
+                    target, _, sig_s = tail.partition(":")
+                    if target not in ("broker", "learner"):
+                        raise ValueError(f"unknown kill target {target!r} in {clause!r}")
+                    if sig_s:
+                        if target != "learner":
+                            raise ValueError(
+                                f"signal selector needs target learner in {clause!r}"
+                            )
+                        if sig_s not in ("kill", "term"):
+                            raise ValueError(f"unknown kill signal {sig_s!r} in {clause!r}")
+                        sig = sig_s
+                sched.events.append(
+                    TimedEvent(kind, float(at), float(dur), target=target, signal=sig)
+                )
             elif name == "latency":
                 mean, _, jit = arg.partition("~")
                 sched.latency_mean_s = float(mean)

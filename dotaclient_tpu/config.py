@@ -168,6 +168,44 @@ class RetryConfig:
 
 
 @dataclass
+class CkptConfig:
+    """Preemption-tolerant checkpointing (runtime/checkpoint.py aux
+    manifest + runtime/learner.py drain). Default OFF on every switch:
+    with the defaults, checkpoint bytes on disk and the step loop are
+    byte-identical to the params/opt/step-only behavior (asserted by the
+    resume soak's inertness proof), so a rolling upgrade can land this
+    build before any deployment opts in."""
+
+    # Transactional full-state checkpoints: alongside the orbax step, an
+    # aux manifest (written tmp + fsync + os.replace, so a crash mid-save
+    # leaves the previous step fully restorable) captures the host RNG
+    # streams, the replay-reservoir contents/priorities/staleness stamps,
+    # staged-but-untrained pending frames, and the weight-publisher
+    # version high-water mark — everything a learner kill would otherwise
+    # lose. Restore re-injects all of it, and bumps the version counter
+    # to the published high-water mark so in-flight rollout staleness
+    # stamps stay monotonic (never under-aged for max_staleness/ACER).
+    full_state: bool = False
+    # Move the checkpoint off the step critical path: the loop thread
+    # only dispatches an on-device state copy (async, donation-safe —
+    # same stream-ordering argument as the weight publisher's
+    # ParamFlattener); a dedicated worker thread pays the blocking host
+    # read + reservoir snapshot + orbax/aux write, latest-wins coalesced.
+    async_save: bool = False
+    # Install a SIGTERM handler (learner main only): stop fetching,
+    # finish the in-flight step, train out already-staged batches, save
+    # full state with wait=True, exit 0 — the k8s preemption drain. The
+    # matching manifests pair terminationGracePeriodSeconds/preStop with
+    # drain_budget_s.
+    drain_on_sigterm: bool = False
+    # Hard wall-clock budget for the SIGTERM drain: a watchdog timer
+    # force-exits (nonzero) if the drain has not completed by then, so a
+    # wedged save can never outlive the pod's grace period into SIGKILL
+    # with a half-written step.
+    drain_budget_s: float = 45.0
+
+
+@dataclass
 class ChaosConfig:
     """Seeded fault injection (dotaclient_tpu/chaos/). Default OFF and
     import-free: with enabled=False no chaos module is ever imported and
@@ -304,6 +342,9 @@ class LearnerConfig:
     # complete remote step back down (runtime/checkpoint.py).
     checkpoint_remote_dir: str = ""
     checkpoint_every: int = 100  # steps between durable checkpoints
+    # Preemption tolerance (--ckpt.*): transactional full-state
+    # checkpoints, async save, SIGTERM drain. All default off.
+    ckpt: CkptConfig = field(default_factory=CkptConfig)
     publish_every: int = 1  # steps between weight fanout publishes
     # Rolling-upgrade transition flag (ADVICE r4): emit legacy DTW1
     # weight frames (no boot_epoch) so not-yet-upgraded subscribers keep

@@ -97,6 +97,26 @@ SCALARS: Dict[str, str] = {
     "actor_batch_occupancy": "mean real-rows / capacity of the batched inference tick",
     "actor_gather_wait_s": "mean per-tick wait assembling the batch (bounded by --gather_window_s)",
     "actor_jit_step_s": "mean per-tick batched jit inference latency (incl. the one device_get)",
+    # --- full-state checkpointing (runtime/checkpoint.py aux manifests,
+    #     runtime/learner.py CheckpointWorker) — emitted only when
+    #     --ckpt.full_state / --ckpt.async_save are on -----------------
+    "ckpt_aux_written": "full-state aux manifests written (cumulative)",
+    "ckpt_aux_superseded": "aux manifests coalesced away before writing (latest-wins)",
+    "ckpt_aux_failures": "aux manifest writes that failed (prior step stays restorable)",
+    "ckpt_last_aux_bytes": "size of the newest aux manifest (reservoir + pending + RNG)",
+    "ckpt_last_aux_step": "step label of the newest durable aux manifest",
+    "ckpt_async_saves_total": "checkpoints written by the off-critical-path saver",
+    "ckpt_async_coalesced_total": "async checkpoints superseded before writing",
+    # --- resume provenance (runtime/learner.py _restore_full_state):
+    #     merged into the FIRST metrics window after a restore ----------
+    "resume_restored_step": "checkpoint step label this boot restored (-1 = none)",
+    "resume_version_hwm_bump": (
+        "versions the counter jumped past the restored step to the "
+        "published high-water mark (staleness stamps stay monotonic)"
+    ),
+    "resume_reservoir_entries": "replay-reservoir entries rehydrated from the aux manifest",
+    "resume_pending_frames": "staged-but-untrained frames re-injected from the aux manifest",
+    "resume_restore_wall_s": "wall seconds from restore start to full-state rehydration",
     # --- obs watchdog (dotaclient_tpu/obs/watchdog.py) -----------------
     "watchdog_ok": "1 while /healthz serves 200, 0 once tripped",
     "watchdog_strikes": (

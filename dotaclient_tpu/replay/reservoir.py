@@ -305,6 +305,64 @@ class ReplayReservoir:
                 self._stats["spilled_entries"] += spilled
                 self._stats["bytes_spilled"] += bytes_spilled
 
+    # ------------------------------------------------- checkpoint support
+
+    def snapshot(self) -> dict:
+        """Consumer-thread-only (single-writer contract): a serializable
+        image of the reservoir for the full-state checkpoint — encoded
+        payload bytes with their compression state, ABSOLUTE behavior
+        versions (so restored staleness stamps are exact, not re-aged),
+        priorities and use counts, plus the sampling RNG's bit-generator
+        state so the post-restore draw sequence continues the pre-kill
+        stream bit-for-bit (the resume soak's bit-exactness depends on
+        it). Entry `meta` (obs TraceRefs) is process-local and
+        deliberately NOT captured — a restored entry re-enters the trace
+        pipeline as untraced."""
+        entries = []
+        for bucket in self._buckets.values():
+            for e in bucket.values():
+                payload = e.payload if e.compressed else self._encode(e.payload)
+                entries.append(
+                    {
+                        "payload": bytes(payload),
+                        "compressed": e.compressed,
+                        "version": int(e.version),
+                        "priority": float(e.priority),
+                        "uses": int(e.uses),
+                        "raw_nbytes": int(e.raw_nbytes),
+                        "spill_exempt": bool(e.spill_exempt),
+                    }
+                )
+        return {"entries": entries, "rng_state": self._rng.bit_generator.state}
+
+    def restore(self, snap: dict) -> int:
+        """Rebuild entries + RNG stream from a snapshot(). PRE-START
+        only: must run before the staging consumer thread exists (the
+        learner restores in __init__), so there is no concurrent writer
+        to race. Returns the number of entries restored."""
+        n = 0
+        for rec in snap.get("entries", []):
+            if rec["compressed"]:
+                payload, nbytes = rec["payload"], len(rec["payload"])
+            else:
+                payload, nbytes = self._decode(rec["payload"]), rec["raw_nbytes"]
+            e = _Entry(
+                self._next_id, payload, rec["version"], rec["priority"], nbytes, meta=None
+            )
+            e.uses = rec["uses"]
+            e.compressed = rec["compressed"]
+            e.raw_nbytes = rec["raw_nbytes"]
+            e.spill_exempt = rec.get("spill_exempt", False)
+            self._next_id += 1
+            self._buckets.setdefault(e.version, {})[e.eid] = e
+            self._bytes += e.nbytes
+            self._count += 1
+            n += 1
+        rng_state = snap.get("rng_state")
+        if rng_state is not None:
+            self._rng.bit_generator.state = rng_state
+        return n
+
     # ------------------------------------------------------------- stats
 
     def stats(self) -> Dict[str, float]:

@@ -23,8 +23,10 @@ after wait_until_finished, and the step marker file lands last).
 from __future__ import annotations
 
 import concurrent.futures
+import io
 import json
 import logging
+import os
 import threading
 from typing import Optional
 
@@ -41,6 +43,35 @@ class SchemaMismatchError(RuntimeError):
 
 
 _STEP_DONE = "MIRROR_COMPLETE"  # marker file, written LAST per mirrored step
+# Aux sidecar per step (full-state manifests: RNG streams, replay
+# reservoir, pending frames, publisher high-water mark — the learner
+# builds/consumes the payload, this module only stores it durably).
+_AUX_FMT = "aux_{}.bin"
+# Weight-publisher version high-water mark: a tiny file the publisher
+# thread refreshes on every successful fanout, so a SIGKILL between
+# periodic checkpoints cannot roll the restored version counter back
+# below versions the fleet has already seen (staleness stamps must stay
+# monotonic — never under-aged for max_staleness/ACER).
+_HWM_FILE = "version_hwm"
+
+
+def _atomic_write(dst: epath.Path, data: bytes) -> None:
+    """tmp + fsync + replace: the destination either holds the previous
+    complete contents or the new complete contents, never a torn write —
+    the same pattern as the PR-1 native ISA fingerprint publish. The
+    dot-prefixed tmp name keeps partials invisible to orbax's step scan
+    and to the mirror's digit-named listing walks. fsync is best-effort:
+    non-local epath backends (gs://, the in-memory test fs) expose no
+    fd, and there the backend's replace/mv is the atomicity boundary."""
+    tmp = dst.parent / f".{dst.name}.tmp"
+    with tmp.open("wb") as f:
+        f.write(data)
+        try:
+            f.flush()
+            os.fsync(f.fileno())
+        except (AttributeError, OSError, io.UnsupportedOperation):
+            pass
+    tmp.replace(dst)
 
 
 class Checkpointer:
@@ -97,6 +128,34 @@ class Checkpointer:
         }
         self._last_saved_step: Optional[int] = None
         self._last_mirrored_step: Optional[int] = None
+        # Aux finalize worker (full-state checkpoints only; None until the
+        # first save(aux=...) so the plain params/opt/step path constructs
+        # nothing new). Same single-worker latest-wins coalescing as the
+        # mirror: the aux write must FOLLOW wait_until_finished (aux
+        # present ⇒ the orbax step is complete — the transactional
+        # contract), and that wait must never run on the train loop.
+        self._aux_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._aux_cond = threading.Condition()
+        self._aux_pending: Optional[tuple] = None  # (step, payload bytes)
+        self._aux_inflight = False
+        self._aux_counts = {"aux_written": 0, "aux_superseded": 0, "aux_failures": 0}
+        self._last_aux_step: Optional[int] = None
+        self._last_aux_bytes = 0
+        self._hwm_lock = threading.Lock()
+        self._hwm: Optional[int] = None
+        # ALL orbax save dispatch funnels through one dedicated thread:
+        # CheckpointManager only clears its finalize-thread handle when
+        # wait_until_finished runs on the SAME thread that called save()
+        # — a save from any other thread then hits orbax's
+        # `assert self._finalize_thread is None`. One owner thread makes
+        # every (wait-for-previous → save) pair self-clearing, so saves
+        # may originate from the loop thread (sync path), the
+        # CheckpointWorker (async path), and a SIGTERM drain without
+        # tripping it. The submit lock keeps step order = call order.
+        self._save_lock = threading.Lock()
+        self._orbax_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="orbax-save"
+        )
         self._mngr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
@@ -105,19 +164,48 @@ class Checkpointer:
     def _schema_path(self) -> epath.Path:
         return self._dir / "feature_schema.json"
 
-    def save(self, state, step: int, wait: bool = False) -> None:
-        self._mngr.save(step, args=ocp.args.StandardSave(state))
+    def save(self, state, step: int, wait: bool = False, aux: Optional[bytes] = None) -> None:
+        """`aux` (full-state manifests) rides a per-step sidecar written
+        by a finalize worker AFTER orbax commits the step, via tmp +
+        fsync + os.replace — so a crash anywhere mid-save leaves the
+        previous step (and ITS aux) fully restorable, and an aux file's
+        existence certifies its step is complete. With a remote mirror,
+        the aux path hands the mirror submit to the finalize worker so
+        the upload always includes the sidecar; aux=None is the
+        pre-existing params/opt/step path, byte-identical on disk."""
+        with self._save_lock:
+            # Blocks (like a direct save call would) until orbax has
+            # staged the arrays; the commit itself stays async.
+            self._orbax_pool.submit(self._orbax_save, state, step).result()
         # stamp the CURRENT build's schema unconditionally: the newest
         # checkpoints are always this version, and a stale stamp left in a
         # reused directory would false-positive the restore guard after
         # max_to_keep GC removes the old-era checkpoints
-        self._schema_path().write_text(
-            json.dumps({"feature_schema_version": FEATURE_SCHEMA_VERSION})
+        _atomic_write(
+            self._schema_path(),
+            json.dumps({"feature_schema_version": FEATURE_SCHEMA_VERSION}).encode(),
         )
         if wait:
             self._mngr.wait_until_finished()
         self._last_saved_step = step
-        if self._mirror_pool is not None:
+        if aux is not None:
+            with self._aux_cond:
+                if self._aux_pool is None:
+                    self._aux_pool = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="ckpt-aux"
+                    )
+                if self._aux_pending is not None:
+                    self._aux_counts["aux_superseded"] += 1
+                self._aux_pending = (step, aux)
+                if not self._aux_inflight:
+                    self._aux_inflight = True
+                    self._aux_pool.submit(self._aux_worker)
+            if wait:
+                with self._aux_cond:
+                    self._aux_cond.wait_for(
+                        lambda: self._aux_pending is None and not self._aux_inflight
+                    )
+        elif self._mirror_pool is not None:
             with self._mirror_cond:
                 if self._mirror_pending is not None:
                     # Slow-upload backpressure: the older pending step is
@@ -128,11 +216,132 @@ class Checkpointer:
                 if not self._mirror_inflight:
                     self._mirror_inflight = True
                     self._mirror_pool.submit(self._mirror_worker)
-            if wait:
+        if wait and self._mirror_pool is not None:
+            with self._mirror_cond:
+                self._mirror_cond.wait_for(
+                    lambda: self._mirror_pending is None and not self._mirror_inflight
+                )
+
+    def _orbax_save(self, state, step: int) -> None:
+        """Owner-thread half of save(): waiting here (same thread as the
+        previous save) lets orbax clear its finalize handle before the
+        next dispatch — see the _orbax_pool comment in __init__."""
+        self._mngr.wait_until_finished()
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+
+    def _aux_worker(self) -> None:
+        """Drain the coalesced aux queue on the single finalize thread:
+        wait for orbax to commit the step, land the sidecar atomically,
+        sweep sidecars orphaned by orbax's max_to_keep GC, then (mirror
+        configured) hand the COMPLETE step to the mirror queue."""
+        while True:
+            with self._aux_cond:
+                item = self._aux_pending
+                self._aux_pending = None
+                if item is None:
+                    self._aux_inflight = False
+                    self._aux_cond.notify_all()
+                    return
+            step, payload = item
+            self._mngr.wait_until_finished()
+            try:
+                _atomic_write(self._dir / _AUX_FMT.format(step), payload)
+                with self._aux_cond:
+                    self._aux_counts["aux_written"] += 1
+                    self._last_aux_step = step
+                    self._last_aux_bytes = len(payload)
+            except Exception:
+                with self._aux_cond:
+                    self._aux_counts["aux_failures"] += 1
+                _log.exception("aux manifest write for step %d failed; continuing", step)
+            self._gc_aux(keep=step)
+            if self._mirror_pool is not None:
                 with self._mirror_cond:
-                    self._mirror_cond.wait_for(
-                        lambda: self._mirror_pending is None and not self._mirror_inflight
-                    )
+                    if self._mirror_pending is not None:
+                        self._mirror_counts["superseded"] += 1
+                    self._mirror_pending = step
+                    if not self._mirror_inflight:
+                        self._mirror_inflight = True
+                        self._mirror_pool.submit(self._mirror_worker)
+
+    def _gc_aux(self, keep: int) -> None:
+        """Drop aux sidecars whose orbax step is gone (max_to_keep GC) —
+        an aux file must never outlive (or predate) its step, or restore
+        could pair one step's reservoir with another step's params."""
+        try:
+            live = set(self._mngr.all_steps())
+        except Exception:
+            return
+        live.add(keep)
+        for child in self._dir.iterdir():
+            name = child.name
+            if name.startswith("aux_") and name.endswith(".bin"):
+                stem = name[4:-4]
+                if stem.isdigit() and int(stem) not in live:
+                    try:
+                        child.unlink()
+                    except OSError:
+                        pass
+
+    def load_aux(self, step: Optional[int]) -> Optional[bytes]:
+        """The aux manifest for `step`, or None (no full-state save for
+        that step, or a legacy checkpoint). Atomic-replace publishing
+        guarantees complete-or-absent — never a torn read."""
+        if step is None:
+            return None
+        p = self._dir / _AUX_FMT.format(step)
+        if not p.exists():
+            return None
+        return p.read_bytes()
+
+    # ------------------------------------------------- publish high-water
+
+    def record_published_version(self, version: int) -> None:
+        """Publisher-thread hook: persist the highest version ever fanned
+        out to the fleet (monotonic; tmp + os.replace so the file is
+        always a complete int). Off the train loop by construction — the
+        WeightPublisher calls this after each successful send."""
+        with self._hwm_lock:
+            if self._hwm is not None and version <= self._hwm:
+                return
+            self._hwm = version
+        try:
+            _atomic_write(self._dir / _HWM_FILE, str(version).encode())
+        except Exception:
+            _log.exception("version high-water write failed; continuing")
+
+    def published_hwm(self) -> Optional[int]:
+        """Highest version the fleet has seen from this checkpoint dir
+        (None before any full-state publish). Restore takes
+        max(checkpoint step, aux hwm, this) as the resume version."""
+        p = self._dir / _HWM_FILE
+        if not p.exists():
+            return None
+        try:
+            return int(p.read_text().strip())
+        except (ValueError, OSError):
+            return None
+
+    def discard_pending(self) -> None:
+        """SIGKILL emulation support (chaos controller): drop queued
+        aux/mirror work as a real kill -9 would — the durable state is
+        whatever already hit the disk, nothing in flight completes."""
+        with self._aux_cond:
+            self._aux_pending = None
+        with self._mirror_cond:
+            self._mirror_pending = None
+
+    def save_stats(self) -> dict:
+        """Full-state save-health snapshot for the learner's metrics
+        stream (ckpt_* scalars). Empty until the first save(aux=...)."""
+        with self._aux_cond:
+            if self._aux_pool is None and self._aux_counts["aux_written"] == 0:
+                return {}
+            out = dict(self._aux_counts)
+            out["last_aux_bytes"] = self._last_aux_bytes
+            if self._last_aux_step is not None:
+                out["last_aux_step"] = self._last_aux_step
+            return out
 
     def _mirror_worker(self) -> None:
         """Drain the coalesced queue: mirror the newest pending step,
@@ -213,10 +422,29 @@ class Checkpointer:
             return
         remote_step = self._remote / str(step)
         self._copy_tree(local_step, remote_step)
-        (self._remote / "feature_schema.json").write_text(
-            json.dumps({"feature_schema_version": FEATURE_SCHEMA_VERSION})
+        # Full-state aux sidecar rides the mirror BEFORE the marker, so a
+        # marked remote step always has its complete manifest alongside.
+        local_aux = self._dir / _AUX_FMT.format(step)
+        if local_aux.exists():
+            _atomic_write(self._remote / _AUX_FMT.format(step), local_aux.read_bytes())
+        # Version high-water rides every mirror (as-of-mirror-time): a
+        # fresh pod restoring from the mirror alone must not under-bump
+        # its counter below versions the fleet has already seen.
+        # Best-effort by construction — publishes between the last
+        # mirror and a kill are only in the LOCAL hwm file — but the
+        # boot-epoch resync bounds the residual window: actors re-stamp
+        # against the reborn learner as soon as its first fanout lands.
+        hwm = self.published_hwm()
+        if hwm is not None:
+            _atomic_write(self._remote / _HWM_FILE, str(hwm).encode())
+        _atomic_write(
+            self._remote / "feature_schema.json",
+            json.dumps({"feature_schema_version": FEATURE_SCHEMA_VERSION}).encode(),
         )
-        (remote_step / _STEP_DONE).write_text("ok")
+        # Marker publish is atomic (tmp + replace): a reader listing the
+        # remote can never see a half-written marker file and trust an
+        # incomplete step.
+        _atomic_write(remote_step / _STEP_DONE, b"ok")
         # GC: keep the newest max_to_keep COMPLETE steps; also sweep
         # UNMARKED step dirs other than the one just written — a crash
         # mid-upload leaves a markerless dir no future run completes
@@ -224,10 +452,18 @@ class Checkpointer:
         # _remote_steps would otherwise hide it from GC forever.
         complete = set(self._remote_steps())
         for child in self._remote.iterdir():
-            if child.name.isdigit() and int(child.name) != step and int(child.name) not in complete:
+            name = child.name
+            if name.isdigit() and int(name) != step and int(name) not in complete:
                 child.rmtree()
+            elif name.startswith("aux_") and name.endswith(".bin"):
+                stem = name[4:-4]
+                if stem.isdigit() and int(stem) != step and int(stem) not in complete:
+                    child.unlink()
         for old in sorted(complete)[: -self._max_to_keep]:
             (self._remote / str(old)).rmtree()
+            old_aux = self._remote / _AUX_FMT.format(old)
+            if old_aux.exists():
+                old_aux.unlink()
 
     def _remote_steps(self):
         if self._remote is None or not self._remote.exists():
@@ -282,6 +518,25 @@ class Checkpointer:
         if dst.exists():
             dst.rmtree()  # stale/partial local copy loses to the verified pull
         tmp.rename(dst)
+        # Pull the step's aux manifest too (full-state restores on a
+        # fresh pod need the reservoir/RNG/hwm, not just the arrays);
+        # absent remotely ⇒ a legacy step, restore proceeds state-only.
+        remote_aux = self._remote / _AUX_FMT.format(step)
+        if remote_aux.exists():
+            _atomic_write(self._dir / _AUX_FMT.format(step), remote_aux.read_bytes())
+        # Reconcile the version high-water DOWNWARD never: a stale local
+        # file (in-place container restart) may be ahead of the mirror's
+        # copy — max wins, monotonicity is the whole point.
+        remote_hwm = self._remote / _HWM_FILE
+        if remote_hwm.exists():
+            try:
+                rh: Optional[int] = int(remote_hwm.read_text().strip())
+            except (ValueError, OSError):
+                rh = None
+            if rh is not None:
+                lh = self.published_hwm()
+                if lh is None or rh > lh:
+                    _atomic_write(self._dir / _HWM_FILE, str(rh).encode())
         remote_schema = self._remote / "feature_schema.json"
         if remote_schema.exists():
             self._schema_path().write_text(remote_schema.read_text())
@@ -328,6 +583,15 @@ class Checkpointer:
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
+        # Drain order matters: the aux finalize worker is what SUBMITS
+        # mirror jobs on the full-state path, so it drains first — a
+        # mirror shutdown that ran first could miss the final step's
+        # upload that the aux worker was about to queue.
+        with self._aux_cond:
+            aux_pool = self._aux_pool
+        if aux_pool is not None:
+            aux_pool.shutdown(wait=True)  # drain pending aux manifests
         if self._mirror_pool is not None:
             self._mirror_pool.shutdown(wait=True)  # drain pending uploads
+        self._orbax_pool.shutdown(wait=True)
         self._mngr.close()

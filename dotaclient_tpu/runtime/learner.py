@@ -119,12 +119,22 @@ class WeightPublisher:
     """
 
     def __init__(
-        self, broker: Broker, materialize=None, boot_epoch: int = 0, legacy_dtw1: bool = False
+        self,
+        broker: Broker,
+        materialize=None,
+        boot_epoch: int = 0,
+        legacy_dtw1: bool = False,
+        on_published=None,
     ):
         self._materialize = materialize if materialize is not None else flatten_params
         self._broker = broker
         self._boot_epoch = boot_epoch
         self._legacy_dtw1 = legacy_dtw1
+        # Post-send hook, called on THIS thread with the version just
+        # fanned out. The full-state checkpointer persists its version
+        # high-water mark here (runtime/checkpoint.py) — off the train
+        # loop by construction. None = no extra work per publish.
+        self._on_published = on_published
         self._cond = threading.Condition()
         self._slot = None  # (np_params, version) — latest pending
         self._stop = False
@@ -188,6 +198,8 @@ class WeightPublisher:
                 )
                 self._broker.publish_weights(frame)
                 self.published += 1
+                if self._on_published is not None:
+                    self._on_published(version)
             except Exception:
                 _log.exception("weight publish failed (version %d); continuing", version)
 
@@ -201,6 +213,82 @@ class WeightPublisher:
             t = self._thread  # local ref: the thread nulls the handle on exit
         if t is not None:
             t.join(timeout=10)
+
+
+class CheckpointWorker:
+    """Off-critical-path full-state saver (--ckpt.async_save).
+
+    The loop thread pays ONE async jit dispatch per checkpoint — an
+    on-device copy of the TrainState, donation-safe for the same
+    stream-ordering reason as ParamFlattener (the copy is dispatched
+    before the next state-donating train step, so it reads the params
+    before donation can reuse them). This thread then pays everything
+    expensive: the blocking host read of the copy, the staging snapshot
+    handshake, the manifest pickle, and the orbax/aux submit.
+
+    Latest-wins single slot, the WeightPublisher coalescing argument:
+    durability only ever needs the newest state, so if the loop submits
+    step v+k while v is still saving, v is superseded — counted, never
+    silently dropped.
+    """
+
+    def __init__(self, save_fn):
+        self._save_fn = save_fn  # (host_state, version) -> None
+        self._cond = threading.Condition()
+        self._slot = None  # (state_copy_dev, version) — latest pending
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.saved = 0  # checkpoints actually written (telemetry/tests)
+        self.coalesced = 0  # checkpoints superseded before writing
+
+    def start(self) -> "CheckpointWorker":
+        with self._cond:
+            self._stop = False
+            if self._thread is not None and self._thread.is_alive():
+                self._cond.notify()
+                return self
+            # Same handle-publish-under-the-lock discipline as
+            # WeightPublisher.start (the late-null-clobber race).
+            t = threading.Thread(target=self._run, daemon=True, name="ckpt-saver")
+            self._thread = t
+            t.start()
+        return self
+
+    def submit(self, state_copy_dev, version: int) -> None:
+        with self._cond:
+            if self._slot is not None:
+                self.coalesced += 1
+            self._slot = (state_copy_dev, version)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._slot is None and not self._stop:
+                    self._cond.wait()
+                if self._stop and self._slot is None:
+                    self._thread = None
+                    return
+                state_dev, version = self._slot
+                self._slot = None
+            try:
+                host_state = jax.device_get(state_dev)
+                del state_dev  # release the device copy before the slow write
+                self._save_fn(host_state, version)
+                self.saved += 1
+            except Exception:
+                _log.exception("async checkpoint of step %d failed; continuing", version)
+
+    def stop(self, flush: bool = True) -> None:
+        """Stop the thread; by default drains a pending slot first."""
+        with self._cond:
+            if not flush:
+                self._slot = None
+            self._stop = True
+            self._cond.notify()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=60)
 
 
 class Learner:
@@ -322,11 +410,26 @@ class Learner:
             recorder=self.obs.recorder if self.obs is not None else None,
         )
         self.flattener = ParamFlattener(state.params)
+        # Full-state mode: every fanned-out version is persisted as a
+        # high-water mark (tiny atomic file, publisher thread) so a
+        # SIGKILL between periodic checkpoints can never roll the
+        # restored version counter below versions actors have already
+        # stamped on rollouts. Lazy closure: the checkpointer is
+        # constructed further down.
+        on_pub = None
+        if cfg.ckpt.full_state and cfg.checkpoint_dir:
+
+            def on_pub(version):
+                ck = self.checkpointer
+                if ck is not None:
+                    ck.record_published_version(version)
+
         self.publisher = WeightPublisher(
             broker,
             materialize=self.flattener.to_named,
             boot_epoch=self.boot_epoch,
             legacy_dtw1=cfg.publish_legacy_dtw1,
+            on_published=on_pub,
         )
         self.metrics = MetricsLogger(cfg.log_dir)
         self._boot_monotonic = time.monotonic()
@@ -370,6 +473,33 @@ class Learner:
                 "the obs metrics port (--obs.metrics_port) instead"
             )
             jax.profiler.start_server(cfg.profile_port)
+        # SIGTERM drain / kill plumbing (--ckpt.*): `_drain` asks run()
+        # to stop fetching, train out already-staged batches, and return
+        # (the caller then drain_save()s); `_abort` asks run() to return
+        # IMMEDIATELY, discarding staged work — the chaos controller's
+        # SIGKILL emulation. Both default-unset: the steady-state loop
+        # pays one Event.is_set() per iteration.
+        self._drain = threading.Event()
+        self._abort = threading.Event()
+        # Budget timer armed by the SIGTERM handler, cancelled by
+        # drain_save() once the final save is durable.
+        self._drain_timer: Optional[threading.Timer] = None
+        # resume_* scalars (obs/registry.py): merged into the FIRST
+        # metrics window after a restore so the resume is visible on the
+        # dashboard, then cleared.
+        self._resume_scalars = {}
+        self._ckpt_worker: Optional[CheckpointWorker] = None
+        self._state_copy_jit = None
+        if cfg.ckpt.async_save and cfg.checkpoint_dir:
+            # Built ONLY in async mode: with the flag off no extra jit
+            # object exists and checkpoint() is the pre-existing
+            # synchronous path (the inertness proof's contract).
+            import jax.numpy as jnp
+
+            self._state_copy_jit = jax.jit(
+                lambda s: jax.tree.map(jnp.copy, s)
+            )
+            self._ckpt_worker = CheckpointWorker(self._save_full)
         self.checkpointer = None
         if cfg.checkpoint_dir:
             from dotaclient_tpu.runtime.checkpoint import Checkpointer
@@ -383,11 +513,14 @@ class Learner:
                 remote_dir=cfg.checkpoint_remote_dir,
                 remote_push=self._primary,
             )
+            t_restore = time.monotonic()
             restored = self.checkpointer.restore_latest(self.state)
             if restored is not None:
                 self.state = jax.device_put(restored, self.state_shardings)
                 self.version = int(jax.device_get(restored.step))
                 _log.info("restored checkpoint at step %d", self.version)
+                if cfg.ckpt.full_state:
+                    self._restore_full_state(t_restore)
         if self._n_proc > 1:
             # Restore is per-process and a partial host restart (one pod
             # with a fresh disk) would leave processes at DIFFERENT
@@ -406,6 +539,27 @@ class Learner:
                     f"checkpoint (shared checkpoint_dir or remote mirror) "
                     f"before starting"
                 )
+            if cfg.ckpt.full_state:
+                # Published-high-water bump, global max: only process 0
+                # writes the hwm file, but every process must resume the
+                # SAME version counter (staleness filtering is
+                # per-process host work inside one SPMD program).
+                hwm = int(
+                    np.asarray(
+                        multihost_utils.process_allgather(
+                            np.int64(getattr(self, "_pending_hwm", self.version))
+                        )
+                    ).max()
+                )
+                if hwm > self.version:
+                    self._resume_scalars["resume_version_hwm_bump"] = float(
+                        hwm - self.version
+                    )
+                    _log.info(
+                        "resume: version counter %d -> %d (global published "
+                        "high-water)", self.version, hwm,
+                    )
+                    self.version = hwm
         if self.obs is not None:
             # Liveness watchdog (obs/watchdog.py, --obs.watchdog.*): reads
             # the telemetry the loop already produces; trips /healthz.
@@ -469,9 +623,214 @@ class Learner:
         )
         self.broker.publish_weights(frame)
 
-    def checkpoint(self) -> None:
-        if self.checkpointer is not None:
+    def checkpoint(self, wait: bool = False) -> None:
+        if self.checkpointer is None:
+            return
+        cfg = self.cfg.ckpt
+        if not cfg.full_state and not cfg.async_save:
+            # Pre-existing path, byte-identical on disk (the resume
+            # soak's inertness proof pins this).
             self.checkpointer.save(jax.device_get(self.state), step=self.version)
+            return
+        if self._ckpt_worker is not None and not wait:
+            # Loop thread pays one async on-device copy dispatch; the
+            # worker pays the host read + snapshot + write. Dispatched
+            # BEFORE the next (state-donating) train step, so stream
+            # ordering makes the copy donation-safe (CheckpointWorker
+            # docstring).
+            self._ckpt_worker.start()
+            self._ckpt_worker.submit(self._state_copy_jit(self.state), self.version)
+            return
+        self._save_full(jax.device_get(self.state), self.version, wait=wait)
+
+    def _save_full(self, host_state, version: int, wait: bool = False) -> None:
+        """Write one transactional full-state checkpoint: orbax step +
+        aux manifest (RNG streams, reservoir, pending frames, publisher
+        high-water mark). Runs on the CheckpointWorker thread in async
+        mode, on the caller otherwise."""
+        aux = None
+        if self.cfg.ckpt.full_state:
+            aux = self._build_aux(version)
+        self.checkpointer.save(host_state, step=version, wait=wait, aux=aux)
+
+    def _build_aux(self, version: int) -> bytes:
+        """The aux manifest payload. Versioned and pickled — everything
+        in it is host-side state the orbax arrays cannot carry:
+
+        - the staging snapshot: pending (popped-but-untrained) frames in
+          arrival order + the replay reservoir's entries, priorities,
+          ABSOLUTE staleness stamps, and its numpy Generator state (the
+          only host RNG stream the learner owns — the device-side
+          shuffle rng is a pure fold_in(seed, state.step) and needs no
+          capture, and a restored state.step replays it exactly);
+        - the weight-publisher version high-water AS OF this step (the
+          authoritative per-publish watermark is the hwm side-file,
+          which the mirror also carries — restore takes the max of all
+          three sources);
+        - metrics/env-step high-water marks so the restored learner's
+          telemetry continues instead of rewinding."""
+        import pickle
+
+        staging_snap = self.staging.snapshot_state() or {}
+        manifest = {
+            "manifest_version": 1,
+            "step": int(version),
+            "version_hwm": int(version),
+            "boot_epoch": int(self.boot_epoch),
+            "staging": staging_snap,
+            "metrics_last_step": int(self.metrics.latest_step()),
+            "env_steps_done": int(self.env_steps_done),
+        }
+        return pickle.dumps(manifest, protocol=4)
+
+    def _restore_full_state(self, t_restore: float) -> None:
+        """Rehydrate the host-side state the aux manifest carries and
+        bump the version counter to the published high-water mark —
+        rollouts already in flight are stamped with every version the
+        fleet has seen, and a counter that restarted BELOW those stamps
+        would compute negative staleness: under-aged experience passing
+        the max_staleness filter and entering ACER with staleness 0.
+        Monotonic-never-under-aged is the contract; over-aging (frames
+        from the dead incarnation's last steps looking older than the
+        redone steps they interleave with) is the safe direction, same
+        as the PR-5 chunk-boundary version stamping."""
+        import pickle
+
+        step = self.checkpointer.latest_step()
+        aux_bytes = self.checkpointer.load_aux(step)
+        aux = None
+        if aux_bytes is not None:
+            try:
+                aux = pickle.loads(aux_bytes)
+            except Exception:
+                _log.exception("aux manifest for step %s unreadable; state-only restore", step)
+        counts = {"pending": 0, "reservoir": 0}
+        hwm = self.version
+        if step is not None:
+            hwm = max(hwm, int(step))  # save labels track the version counter
+        if aux is not None:
+            counts = self.staging.restore_state(aux.get("staging", {}))
+            hwm = max(hwm, int(aux.get("version_hwm", 0)))
+            self.env_steps_done = int(aux.get("env_steps_done", 0))
+        file_hwm = self.checkpointer.published_hwm()
+        if file_hwm is not None:
+            hwm = max(hwm, file_hwm)
+        if self._n_proc > 1:
+            # Non-primary processes never publish, so only process 0
+            # holds the hwm file. Defer the bump: the resume-step
+            # equality check must compare the UN-bumped checkpoint
+            # steps, and then every process applies the same global-max
+            # bump (allgather in __init__).
+            self._pending_hwm = hwm
+            hwm = self.version
+        bump = hwm - self.version
+        if bump > 0:
+            _log.info(
+                "resume: version counter %d -> %d (published high-water; "
+                "staleness stamps stay monotonic)", self.version, hwm,
+            )
+            self.version = hwm
+        self._resume_scalars = {
+            "resume_restored_step": float(step if step is not None else -1),
+            "resume_version_hwm_bump": float(max(bump, 0)),
+            "resume_reservoir_entries": float(counts["reservoir"]),
+            "resume_pending_frames": float(counts["pending"]),
+            "resume_restore_wall_s": round(time.monotonic() - t_restore, 3),
+        }
+
+    # ------------------------------------------------------ drain / abort
+
+    @property
+    def resume_info(self) -> dict:
+        """The resume_* scalars of this boot's restore (empty for a
+        fresh start, or after the first metrics window consumed them) —
+        the chaos controller snapshots this at incarnation boot."""
+        return dict(self._resume_scalars)
+
+    def discard_unsaved(self) -> None:
+        """SIGKILL-emulation teardown (chaos controller): drop queued
+        async-checkpoint and aux/mirror work, exactly as a real kill -9
+        would — durable state is whatever already hit the disk."""
+        if self._ckpt_worker is not None:
+            self._ckpt_worker.stop(flush=False)
+        if self.checkpointer is not None:
+            self.checkpointer.discard_pending()
+
+    @property
+    def drain_requested(self) -> bool:
+        return self._drain.is_set()
+
+    @property
+    def aborted(self) -> bool:
+        return self._abort.is_set()
+
+    def request_drain(self) -> None:
+        """SIGTERM semantics: run() stops fetching new broker frames,
+        finishes the in-flight step, trains out already-staged batches,
+        and returns; the caller then drain_save()s and exits 0."""
+        self._drain.set()
+        # Wake a fetch blocked on its full batch timeout: quiesce stops
+        # intake and lets staging's getter raise Empty once drained.
+        self.staging.quiesce()
+
+    def abort(self) -> None:
+        """SIGKILL emulation for the chaos controller: run() returns as
+        soon as possible, staged work is DISCARDED, nothing is saved —
+        recovery must come from the last periodic checkpoint, exactly as
+        a real kill -9 would leave things."""
+        self._abort.set()
+        self.staging.quiesce()
+
+    def drain_save(self) -> None:
+        """Final act of the SIGTERM drain, called AFTER run() returned
+        (staging/publisher threads already stopped): persist the full
+        state — including the sub-batch leftover pending frames the
+        quiesced staging could not pack — with wait=True, so a zero exit
+        certifies durability."""
+        if self.checkpointer is None:
+            return
+        if self._ckpt_worker is not None:
+            self._ckpt_worker.stop(flush=False)  # superseded by this final save
+        self._save_full(jax.device_get(self.state), self.version, wait=True)
+        # The state is durable — disarm the budget timer. The budget
+        # covers drain + save, not obs/metrics teardown: a timer left
+        # running could os._exit(1) mid-close after a fully successful
+        # drain and mis-signal a dirty shutdown to the supervisor.
+        timer = self._drain_timer
+        if timer is not None:
+            timer.cancel()
+
+    def install_drain_handler(self, budget_s: Optional[float] = None) -> None:
+        """Learner-main wiring for --ckpt.drain_on_sigterm: SIGTERM →
+        request_drain() + a budget timer that force-exits nonzero if the
+        drain wedges — the pod must never coast past its k8s grace
+        period into SIGKILL with a half-written step. Replaces any
+        flight-recorder SIGTERM dump trigger: a drain is a CLEAN exit
+        (the recorder's excepthook stays armed for dirty ones)."""
+        import signal
+
+        budget = self.cfg.ckpt.drain_budget_s if budget_s is None else budget_s
+
+        def _on_term(signum, frame):
+            _log.warning("SIGTERM: draining (budget %.0fs)", budget)
+            self.request_drain()
+            if self._drain_timer is None:  # repeated SIGTERMs arm ONE timer
+                t = threading.Timer(budget, self._drain_budget_blown)
+                t.daemon = True
+                t.start()
+                self._drain_timer = t
+
+        signal.signal(signal.SIGTERM, _on_term)
+
+    def _drain_budget_blown(self) -> None:
+        _log.critical("SIGTERM drain exceeded its budget; forcing exit(1)")
+        if self.obs is not None:
+            try:
+                self.obs.recorder.record("drain_budget_blown")
+                self.obs.recorder.dump("drain_budget_blown")
+            except Exception:
+                pass
+        os._exit(1)
 
     # --------------------------------------------------------------- loop
 
@@ -593,6 +952,10 @@ class Learner:
                 # final batch wait overshoots the deadline by up to
                 # batch_timeout (observed: a 35s soak window returning
                 # 120s late because producers had exited).
+                if self._drain.is_set() or self._abort.is_set():
+                    # Draining/aborting: never park against the full
+                    # batch timeout — the drain budget is wall clock.
+                    return 0.2
                 if deadline is None:
                     return batch_timeout
                 return max(0.05, min(batch_timeout, deadline - time.monotonic()))
@@ -601,9 +964,24 @@ class Learner:
             win_wait += w
             win_put += p
             while num_steps is None or done_steps < num_steps:
+                if self._abort.is_set():
+                    # SIGKILL emulation: return NOW, staged work dies
+                    # with the incarnation (chaos controller contract).
+                    break
                 if deadline is not None and time.monotonic() >= deadline:
                     break
                 if next_batch is None:
+                    if self._drain.is_set():
+                        # Drain: staging intake is quiesced; an empty
+                        # fetch with nothing left to pack means the
+                        # in-flight work is trained out — return so the
+                        # caller can drain_save().
+                        if self.staging.drained():
+                            break
+                        next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
+                        win_wait += w
+                        win_put += p
+                        continue
                     idle += 1
                     if max_idle is not None and idle >= max_idle:
                         raise TimeoutError(
@@ -712,6 +1090,23 @@ class Learner:
                         for k, v in self.checkpointer.mirror_stats().items():
                             if isinstance(v, (int, float)):
                                 scalars[f"ckpt_mirror_{k}"] = v
+                        # Full-state save health (ckpt_* in obs/registry):
+                        # empty dict (no keys emitted) until the first
+                        # aux save, so default runs log nothing new.
+                        for k, v in self.checkpointer.save_stats().items():
+                            scalars[f"ckpt_{k}"] = float(v)
+                        if self._ckpt_worker is not None:
+                            scalars["ckpt_async_saves_total"] = float(
+                                self._ckpt_worker.saved
+                            )
+                            scalars["ckpt_async_coalesced_total"] = float(
+                                self._ckpt_worker.coalesced
+                            )
+                    if self._resume_scalars:
+                        # One-shot: the restore's provenance rides the
+                        # first logged window, then clears.
+                        scalars.update(self._resume_scalars)
+                        self._resume_scalars = {}
                     if stats["episodes"] > 0:
                         scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
                     if self.obs is not None:
@@ -740,6 +1135,12 @@ class Learner:
         return done_steps
 
     def close(self) -> None:
+        if self._ckpt_worker is not None:
+            # Drain (not discard) a pending async save: close() after a
+            # normal finish must leave the newest submitted step durable.
+            self._ckpt_worker.stop(flush=True)
+        if self.checkpointer is not None:
+            self.checkpointer.close()  # drains the aux + mirror workers
         if self.obs is not None:
             self.obs.close()
         self.metrics.close()
@@ -777,6 +1178,13 @@ def main(argv=None):
 
         broker = wrap_broker(broker, cfg.chaos)
     learner = Learner(cfg, broker)
+    if cfg.ckpt.drain_on_sigterm:
+        # SIGTERM → drain: stop fetching, finish the in-flight step,
+        # train out staged batches, save full state, exit 0 — inside
+        # --ckpt.drain_budget_s (k8s pairs terminationGracePeriodSeconds
+        # with it). Installed AFTER Learner.__init__ so it supersedes the
+        # flight recorder's SIGTERM dump trigger (a drain is clean).
+        learner.install_drain_handler()
     _log.info(
         "learner up: mesh=%s batch=%dx%d devices=%d",
         cfg.mesh_shape,
@@ -786,6 +1194,9 @@ def main(argv=None):
     )
     try:
         learner.run(num_steps=cfg.train_steps or None)
+        if learner.drain_requested and not learner.aborted:
+            learner.drain_save()
+            _log.info("SIGTERM drain complete at version %d; exiting 0", learner.version)
     finally:
         learner.close()
 

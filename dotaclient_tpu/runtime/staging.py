@@ -200,6 +200,26 @@ class StagingBuffer:
         # queue items: (TrainBatch, groups-dict-or-None)
         self._ready: "queue.Queue" = queue.Queue(maxsize=2)
         self._stop = threading.Event()
+        # SIGTERM drain: once set, the consumer stops popping the broker
+        # but keeps packing already-pending frames into full batches —
+        # the learner trains those out, then checkpoints the (< B)
+        # leftover pending frames in the full-state aux manifest so a
+        # drain loses ZERO popped frames. Cleared by start() (the
+        # restartable-buffer contract phased drivers rely on).
+        self._quiesce = threading.Event()
+        # True while the consumer holds a popped-but-not-yet-queued batch
+        # in its locals (set under _mutate_lock in the pop, cleared after
+        # the ready-queue put) — drained() must see that batch.
+        self._packing = False
+        # Full-state snapshot exclusion: the consumer thread holds this
+        # around its two mutation sites (_ingest, _next_batch_items) —
+        # two uncontended acquires per LOOP ITERATION, never per frame —
+        # and snapshot_state() takes it from the checkpoint worker, so a
+        # snapshot is always a consistent cut (never a half-formed
+        # batch: take-pending and the reservoir sample live inside one
+        # held region) regardless of whether the consumer is running,
+        # stopped, or being restarted by a phased driver.
+        self._mutate_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         # Set when the consumer thread dies on a BatchLayoutError; the
         # learner-side getters re-raise it so the mismatch surfaces as a
@@ -210,6 +230,19 @@ class StagingBuffer:
             from dotaclient_tpu import native
 
             self._lib = native.load_packer()
+        # Wire-bytes codec for pending items (full-state checkpoints):
+        # the native path stages raw frame bytes (identity), the python
+        # path stages Rollout objects (serialize/deserialize) — the same
+        # split the replay reservoir uses, so snapshots re-enter the SAME
+        # packer unchanged on restore.
+        if self._lib is not None:
+            self._item_encode = lambda it: it
+            self._item_decode = lambda b: b
+        else:
+            from dotaclient_tpu.transport.serialize import serialize_rollout
+
+            self._item_encode = serialize_rollout
+            self._item_decode = deserialize_rollout
         # Replay reservoir (dotaclient_tpu/replay/): owned and touched by
         # the consumer thread only, same single-writer discipline as
         # _pending. Payloads match the pending-item type — raw frame
@@ -287,6 +320,7 @@ class StagingBuffer:
         # phased drivers (train N steps → eval → train again, e.g.
         # scripts/train_north_star.py) can reuse one buffer
         self._stop.clear()
+        self._quiesce.clear()
         self._thread = threading.Thread(target=self._run, daemon=True, name="staging-consumer")
         self._thread.start()
         return self
@@ -295,11 +329,27 @@ class StagingBuffer:
         B = self.cfg.batch_size
         while not self._stop.is_set():
             try:
-                frames = self.broker.consume_experience(max_items=B, timeout=0.2)
+                if self._quiesce.is_set():
+                    # Draining: no new broker pops; pack out what is
+                    # already pending, pace the loop in place of the
+                    # consume timeout.
+                    frames = None
+                    time.sleep(0.02)
+                else:
+                    frames = self.broker.consume_experience(max_items=B, timeout=0.2)
                 if frames:
-                    self._ingest(frames)
+                    with self._mutate_lock:
+                        self._ingest(frames)
                 while not self._stop.is_set():
-                    items, staleness, traces = self._next_batch_items(B)
+                    with self._mutate_lock:
+                        items, staleness, traces = self._next_batch_items(B)
+                        # In-flight marker, set under the SAME lock hold
+                        # that popped the frames: between here and the
+                        # ready-queue put the batch lives only in this
+                        # thread's locals, and a quiesced drained() that
+                        # ignored it would let a SIGTERM drain stop one
+                        # batch early — silently losing popped frames.
+                        self._packing = items is not None
                     if items is None:
                         break
                     try:
@@ -314,6 +364,7 @@ class StagingBuffer:
                         _log.exception("packer rejected a batch; dropping %d frames", len(items))
                         with self._stats_lock:
                             self._stats["dropped_bad"] += len(items)
+                        self._packing = False
                         continue
                     if staleness is not None:
                         batch = batch._replace(
@@ -332,6 +383,7 @@ class StagingBuffer:
                             break
                         except queue.Full:
                             continue
+                    self._packing = False  # batch visible in _ready (or dead with _stop)
             except BatchLayoutError as e:
                 # Persistent builder/staging config disagreement: crash the
                 # consumer LOUDLY (ADVICE r5 item 1). The learner-side
@@ -652,6 +704,11 @@ class StagingBuffer:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             self._check_fatal()
+            if self._quiesce.is_set() and self.drained():
+                # SIGTERM drain: nothing left to pack and nothing queued —
+                # waiting out the full batch timeout would only burn the
+                # drain budget against a queue nothing will ever fill.
+                raise queue.Empty
             if deadline is None:
                 step = 0.2
             else:
@@ -687,6 +744,79 @@ class StagingBuffer:
             return None, None
         self.last_batch_trace = traces
         return batch, groups
+
+    # -- checkpoint / drain support --------------------------------------
+
+    def _take_snapshot(self) -> dict:
+        """Build the serializable staging image: pending (popped but not
+        yet packed) frames as wire bytes, in order, plus the reservoir's
+        own snapshot. Caller holds _mutate_lock."""
+        snap: dict = {"pending": [bytes(self._item_encode(it)) for it in self._pending]}  # graftlint: disable=THR001(caller holds _mutate_lock, the same lock the consumer's two mutation sites hold)
+        if self._reservoir is not None:
+            snap["reservoir"] = self._reservoir.snapshot()
+        return snap
+
+    def snapshot_state(self, timeout: float = 10.0) -> Optional[dict]:
+        """Checkpoint-worker side: a consistent image of the staging host
+        state for the full-state aux manifest. The mutate lock excludes
+        the consumer's two mutation sites, so the cut never contains a
+        half-formed batch — whether the consumer is live, stopped, or
+        mid-restart (phased drivers stop/start the buffer around every
+        run() call). `timeout` bounds the wait against a consumer
+        wedged inside a mutation (e.g. a ready-queue put stuck behind a
+        stalled learner): the checkpoint degrades to state-only rather
+        than stalling durability."""
+        if not self._mutate_lock.acquire(timeout=timeout):
+            return None
+        try:
+            return self._take_snapshot()
+        finally:
+            self._mutate_lock.release()
+
+    def restore_state(self, snap: dict) -> Dict[str, int]:
+        """PRE-START only (the learner restores in __init__, before any
+        consumer thread exists): re-inject checkpointed pending frames —
+        ahead of anything the broker will deliver, preserving the exact
+        pre-kill batch-formation order — and rebuild the reservoir.
+        Returns counts for the resume_* scalars."""
+        restored = [self._item_decode(b) for b in snap.get("pending", [])]
+        self._pending = restored  # graftlint: disable=THR001(pre-start contract: runs in Learner.__init__ before the consumer thread exists)
+        if self._tracer is not None:
+            # Restored frames re-enter untraced (TraceRefs are
+            # process-local); the parallel list must stay aligned.
+            self._pending_traces = [None] * len(restored)
+        restored_reservoir = 0
+        if self._reservoir is not None and "reservoir" in snap:
+            restored_reservoir = self._reservoir.restore(snap["reservoir"])
+        return {"pending": len(restored), "reservoir": restored_reservoir}
+
+    def quiesce(self) -> None:
+        """Stop popping the broker; keep packing already-pending frames.
+        The SIGTERM drain's first act — see _quiesce in __init__."""
+        self._quiesce.set()
+
+    def drained(self) -> bool:
+        """True once a quiesced buffer can produce no further batch: the
+        ready queue is empty and pending holds fewer frames than the
+        next batch's fresh-row requirement. Learner-thread gauge reads
+        of consumer-owned counters (len/occupancy) are single GIL-atomic
+        calls; a one-frame drift only delays the verdict by one poll."""
+        if not self._quiesce.is_set():
+            return False
+        # (packing, pending) must be observed atomically with the
+        # consumer's pop — it sets _packing under this same lock hold
+        # that empties _pending, so a batch is ALWAYS visible as one of:
+        # pending frames, the in-flight flag, or a ready-queue entry.
+        # Check _ready LAST (that is the direction batches move).
+        with self._mutate_lock:
+            if self._packing:
+                return False
+            need = self.cfg.batch_size
+            if self._reservoir is not None:
+                need -= min(self._replay_target, self._reservoir.occupancy)
+            if len(self._pending) >= need:  # graftlint: disable=THR001(read is under _mutate_lock; the consumer's mutation call sites (_ingest/_next_batch_items in _run) hold the same lock — lexically outside the mutating functions, so the rule cannot see it)
+                return False
+        return self._ready.empty()
 
     def stats(self) -> Dict[str, float]:
         with self._stats_lock:

@@ -73,6 +73,63 @@ def test_schedule_grammar_and_events():
         FaultSchedule.parse("melt@3:1")
 
 
+def test_kill_target_selector_grammar():
+    """kill@T:D@TGT[:SIG] — broker default, learner with SIGKILL/SIGTERM
+    variants; selectors on anything else are spec errors."""
+    s = FaultSchedule.parse(
+        "kill@10:3,kill@20:2@learner:term,kill@30:2@learner,kill@40:1@broker", seed=0
+    )
+    rows = [(e.at_s, e.duration_s, e.target, e.signal) for e in s.kills()]
+    assert rows == [
+        (10.0, 3.0, "broker", "kill"),
+        (20.0, 2.0, "learner", "term"),
+        (30.0, 2.0, "learner", "kill"),
+        (40.0, 1.0, "broker", "kill"),
+    ]
+    with pytest.raises(ValueError):
+        FaultSchedule.parse("stall@5:1@learner")  # selector is kill-only
+    with pytest.raises(ValueError):
+        FaultSchedule.parse("kill@5:1@broker:term")  # broker has no signal
+    with pytest.raises(ValueError):
+        FaultSchedule.parse("kill@5:1@learner:hup")
+    with pytest.raises(ValueError):
+        FaultSchedule.parse("kill@5:1@evaluator")
+
+
+# Golden decision sequence: (corrupt, truncate, dup, reset, shed) for op
+# indices 0..47 under seed=3 and the spec below, one 5-char 0/1 group per
+# index. Pinned VALUES, not just self-consistency: any change to the
+# canonical draw order — including one smuggled in by a grammar
+# extension — breaks replayability of every recorded chaos run.
+_GOLDEN_SPEC = "corrupt:0.04,truncate:0.03,dup:0.05,reset:0.02,shed:0.03,latency:0.002~0.001"
+_GOLDEN_SEQ = (
+    "010000000001000001000000000000000000000000100000000000000000"
+    "100000000000000000000000000000000000000000000000000000000000"
+    "000000000010000000000000001000000000000000000000000000000000"
+    "000000000000000000001010000100000000000000000000000000000000"
+)
+
+
+def test_golden_decision_sequence_pinned():
+    flags = ("corrupt", "truncate", "dup", "reset", "shed")
+
+    def seq(spec):
+        s = FaultSchedule.parse(spec, seed=3)
+        return "".join(
+            "".join(str(int(getattr(s.decide(i), n))) for n in flags) for i in range(48)
+        )
+
+    assert seq(_GOLDEN_SPEC) == _GOLDEN_SEQ
+    # Kill-target selectors are timed events: they must not consume (or
+    # shift) a single rate draw.
+    assert seq(_GOLDEN_SPEC + ",kill@10:2@learner:term,kill@20:2@learner") == _GOLDEN_SEQ
+    assert seq(_GOLDEN_SPEC + ",kill@5:1") == _GOLDEN_SEQ
+    # latency draw position pinned too (it follows the five rate draws)
+    s = FaultSchedule.parse(_GOLDEN_SPEC + ",kill@9:1@learner", seed=3)
+    assert round(s.decide(0).latency_s, 9) == 0.00253577
+    assert round(s.decide(47).latency_s, 9) == 0.002151729
+
+
 def test_corrupt_hits_magic_truncate_shortens():
     import random
 
@@ -335,6 +392,107 @@ def test_schedule_runner_executes_kills_and_reports_recovery():
     assert rec["recovery_s"] is not None and rec["recovery_s"] < 20
     inc.final_ledger()
     client.close()
+
+
+_LINC_SCRIPT = r"""
+import json, os, threading, time, tempfile
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dotaclient_tpu.chaos import LearnerIncarnations
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.runtime.learner import Learner
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect
+from dotaclient_tpu.transport.serialize import serialize_rollout
+from tests.test_transport import make_rollout
+
+SMALL = PolicyConfig(unit_embed_dim=8, lstm_hidden=8, mlp_hidden=8, dtype="float32")
+mem.reset("linc")
+ckpt = tempfile.mkdtemp()
+
+def make_learner():
+    cfg = LearnerConfig(batch_size=8, seq_len=4, policy=SMALL, checkpoint_dir=ckpt,
+                        checkpoint_every=5, publish_every=1, metrics_every=1)
+    cfg.ckpt.full_state = True
+    cfg.ckpt.async_save = True
+    return Learner(cfg, connect("mem://linc"))
+
+inc = LearnerIncarnations(make_learner, run_kwargs={"batch_timeout": 1.0}).start()
+pub = connect("mem://linc")
+stop_feed = threading.Event()
+
+def feeder():
+    i = 0
+    while not stop_feed.is_set():
+        learner = inc.learner
+        pub.publish_experience(serialize_rollout(
+            make_rollout(L=4, H=8, version=learner.version if learner else 0, seed=i)))
+        i += 1
+        time.sleep(0.002)
+
+threading.Thread(target=feeder, daemon=True).start()
+deadline = time.monotonic() + 120
+while inc.learner.version < 2 and time.monotonic() < deadline:
+    time.sleep(0.05)
+assert inc.learner.version >= 2, "warm-up never trained"
+
+led1 = inc.kill(sig="term")
+assert led1["exit_clean"] and led1["sig"] == "term", led1
+term_version = led1["version"]
+inc.restart()
+assert inc.boots[-1]["resume_version"] == term_version, (inc.boots[-1], term_version)
+assert inc.wait_first_step(timeout=60.0) is not None, "no post-drain step"
+
+led2 = inc.kill(sig="kill")
+assert led2["sig"] == "kill" and not led2["exit_clean"], led2
+inc.restart()
+# SIGKILL resume: the version counter never rolls back past the
+# published front (hwm file), even though the params may.
+assert inc.boots[-1]["resume_version"] == led2["version"], (inc.boots[-1], led2)
+assert inc.wait_first_step(timeout=60.0) is not None, "no post-kill step"
+
+stop_feed.set()
+totals = inc.final_ledger()
+assert totals["incarnations"] == 3, totals
+for l in inc.lives:  # per-life intake identity: every frame has a fate
+    fresh = l["rows_packed"] - l["rows_replayed"]
+    assert (l["consumed"] + l["resume_pending"]
+            == l["dropped_stale"] + l["dropped_bad"] + fresh
+            + l["pending_at_death"] + l["replay_admitted"]), l
+print("LINC_OK", json.dumps({"lives": len(inc.lives), "consumed": totals["consumed"]}))
+# os._exit: lingering jax/orbax C++ worker threads can abort a normal
+# interpreter teardown; the proof is the printed verdict + assertions.
+os._exit(0)
+"""
+
+
+def test_learner_incarnations_term_then_kill_and_ledgers():
+    """LearnerIncarnations drives both death variants end-to-end on one
+    checkpoint dir: SIGTERM drains (clean exit, durable full state, next
+    boot resumes it), SIGKILL aborts (nothing saved at death, restore
+    from the periodic cadence + hwm file), and every life's intake
+    ledger is harvested exactly. Runs in a SINGLE-DEVICE subprocess: the
+    8-virtual-device pytest harness piles enough XLA/orbax thread pools
+    that three learner lives wedge thread creation in-process — the same
+    scenario the resume soak runs (and passes) at 1 device."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "").replace(
+        " --xla_force_host_platform_device_count=8", ""
+    )
+    # The persistent XLA cache is for the 8-device pytest processes only
+    # (conftest): entries loaded under a different device topology have
+    # wedged/killed standalone drivers on this host.
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _LINC_SCRIPT],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "LINC_OK" in proc.stdout, proc.stdout[-1000:]
 
 
 # ------------------------------------------------- nightly soak wrapper

@@ -265,6 +265,147 @@ def test_mirror_coalesces_when_uploads_lag(tmp_path, gs_memory_fs):
     assert remote_steps == [1, 4], remote_steps
 
 
+def test_crash_mid_save_leaves_previous_step_restorable(tmp_path):
+    """The transactional contract: a crash anywhere inside a full-state
+    save (orbax uncommitted, aux half-written as a dot-tmp) must leave
+    the PREVIOUS step — including its aux manifest — fully restorable,
+    and the torn artifacts invisible."""
+    cfg, state = _state()
+    host = jax.device_get(state)
+    ck = Checkpointer(str(tmp_path / "l"))
+    ck.save(host, step=1, wait=True, aux=b"aux-for-step-1")
+    ck.close()
+    # Forge the wreckage of a crash mid-save of step 2: an aux tmp that
+    # never reached os.replace. (Orbax's own tmp-step dirs are already
+    # proven invisible by its commit protocol.)
+    (tmp_path / "l" / ".aux_2.bin.tmp").write_bytes(b"half-writ")
+
+    ck2 = Checkpointer(str(tmp_path / "l"))
+    assert ck2.latest_step() == 1
+    assert ck2.load_aux(1) == b"aux-for-step-1"
+    assert ck2.load_aux(2) is None  # complete-or-absent, never torn
+    restored = ck2.restore_latest(host)
+    assert restored is not None
+    _trees_equal(restored.params, state.params)
+    ck2.close()
+
+
+def test_aux_write_failure_counts_and_prior_aux_survives(tmp_path, monkeypatch):
+    """An aux finalize that fails mid-write is COUNTED (ckpt_aux_failures)
+    and degrades that step to state-only; the prior step's aux is
+    untouched."""
+    from dotaclient_tpu.runtime import checkpoint as ck_mod
+
+    cfg, state = _state()
+    host = jax.device_get(state)
+    ck = Checkpointer(str(tmp_path / "l"))
+    ck.save(host, step=1, wait=True, aux=b"aux-1")
+
+    real_write = ck_mod._atomic_write
+
+    def failing_write(dst, data):
+        if dst.name.startswith("aux_2"):
+            raise OSError("disk full")
+        real_write(dst, data)
+
+    monkeypatch.setattr(ck_mod, "_atomic_write", failing_write)
+    ck.save(host, step=2, wait=True, aux=b"aux-2")
+    monkeypatch.setattr(ck_mod, "_atomic_write", real_write)
+    stats = ck.save_stats()
+    assert stats["aux_failures"] == 1, stats
+    assert ck.load_aux(2) is None
+    assert ck.load_aux(1) == b"aux-1"
+    assert ck.latest_step() == 2  # arrays still restorable, state-only
+    ck.close()
+
+
+def test_marker_publish_is_atomic_interrupted_write_invisible(tmp_path, gs_memory_fs, monkeypatch):
+    """The remote step marker lands via tmp + replace: an upload that
+    dies before the replace leaves NO marker (the step stays invisible
+    to _remote_steps and restore pulls the previous complete step), and
+    a successful mirror leaves no tmp residue."""
+    from etils import epath
+
+    from dotaclient_tpu.runtime import checkpoint as ck_mod
+
+    cfg, state = _state()
+    host = jax.device_get(state)
+    remote = "gs://ckpt-bucket/atomic"
+    ck = Checkpointer(str(tmp_path / "l"), remote_dir=remote)
+    ck.save(host, step=1, wait=True)
+    assert [c.name for c in epath.Path(remote).iterdir() if c.name.startswith(".")] == []
+
+    real_write = ck_mod._atomic_write
+
+    def die_before_replace(dst, data):
+        if dst.name == "MIRROR_COMPLETE":
+            tmp = dst.parent / f".{dst.name}.tmp"
+            with tmp.open("wb") as f:
+                f.write(data)
+            raise OSError("upload died before replace")
+        real_write(dst, data)
+
+    monkeypatch.setattr(ck_mod, "_atomic_write", die_before_replace)
+    ck.save(host, step=2, wait=True)
+    monkeypatch.setattr(ck_mod, "_atomic_write", real_write)
+    assert ck.mirror_stats()["failures"] == 1
+    assert ck._remote_steps() == [1], "unmarked step must stay invisible"
+    ck.close()
+
+    pod = Checkpointer(str(tmp_path / "pod"), remote_dir=remote, remote_push=False)
+    assert pod.restore_latest(host) is not None
+    assert pod.latest_step() == 1
+    pod.close()
+
+
+def test_mirror_carries_aux_and_fresh_pod_restores_it(tmp_path, gs_memory_fs):
+    """Full-state durability end-to-end: the aux manifest rides the
+    mirror (before the marker) and a fresh pod's pull brings it back —
+    so a preempted node's replacement restores reservoir/RNG/hwm, not
+    just arrays. Remote GC sweeps aux with its step."""
+    from etils import epath
+
+    cfg, state = _state()
+    host = jax.device_get(state)
+    remote = "gs://ckpt-bucket/auxmirror"
+    ck = Checkpointer(str(tmp_path / "l"), max_to_keep=2, remote_dir=remote)
+    for step in (1, 2, 3):
+        ck.record_published_version(step + 4)  # publisher runs ahead
+        ck.save(host, step=step, wait=True, aux=f"aux-{step}".encode())
+    ck.close()
+    names = sorted(c.name for c in epath.Path(remote).iterdir())
+    assert "aux_3.bin" in names and "aux_2.bin" in names
+    assert "aux_1.bin" not in names, names  # GC'd with its step
+    assert "version_hwm" in names, names  # hwm rides the mirror
+
+    pod = Checkpointer(str(tmp_path / "pod"), remote_dir=remote, remote_push=False)
+    restored = pod.restore_latest(host)
+    assert restored is not None and pod.latest_step() == 3
+    assert pod.load_aux(3) == b"aux-3"
+    # A fresh pod's counter floor comes back with the pull — without it,
+    # in-flight rollouts stamped past the checkpoint step would read as
+    # under-aged to the staleness filter.
+    assert pod.published_hwm() == 7
+    pod.close()
+
+
+def test_close_drains_aux_and_mirror_workers(tmp_path, gs_memory_fs):
+    """close() must drain BOTH finalize stages: a save submitted moments
+    before close still lands its aux manifest and its remote mirror
+    (with the aux included) before close returns."""
+    from etils import epath
+
+    cfg, state = _state()
+    host = jax.device_get(state)
+    remote = "gs://ckpt-bucket/drainclose"
+    ck = Checkpointer(str(tmp_path / "l"), remote_dir=remote)
+    ck.save(host, step=4, aux=b"aux-4")  # no wait
+    ck.close()
+    assert (epath.Path(remote) / "4" / "MIRROR_COMPLETE").exists()
+    assert (epath.Path(remote) / "aux_4.bin").read_bytes() == b"aux-4"
+    assert ck.save_stats()["aux_written"] == 1
+
+
 def test_pull_retries_after_remote_gc_race(tmp_path, gs_memory_fs):
     """ADVICE r4 low: if the chosen remote step vanishes mid-pull (the
     primary's GC won the race), the pull must re-list and retry with what

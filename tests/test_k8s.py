@@ -179,6 +179,38 @@ def test_learner_manifests_keep_pipelined_loop():
         )
 
 
+def test_learner_drain_grace_pairing():
+    """Preemption drain arithmetic (PR 7): every learner manifest must
+    arm the SIGTERM drain and pair it with a terminationGracePeriod that
+    covers preStop + the drain budget with margin — otherwise the
+    kubelet SIGKILLs a mid-save learner exactly when durability matters
+    most."""
+    for name in ("learner", "learner-multihost"):
+        (_, doc), = [
+            (f, d) for f, d in DOCS
+            if d["metadata"]["name"] == name and d["kind"] != "Service"
+        ]
+        pod = doc["spec"]["template"]["spec"]
+        c = pod["containers"][0]
+        args = c["args"]
+        assert args[args.index("--ckpt.drain_on_sigterm") + 1] == "true", (
+            f"{name}: SIGTERM drain not armed"
+        )
+        assert args[args.index("--ckpt.full_state") + 1] == "true", (
+            f"{name}: drain without full_state would lose reservoir/pending state"
+        )
+        budget = float(args[args.index("--ckpt.drain_budget_s") + 1])
+        grace = pod.get("terminationGracePeriodSeconds")
+        assert grace is not None, f"{name}: no terminationGracePeriodSeconds"
+        prestop = c.get("lifecycle", {}).get("preStop", {}).get("exec", {}).get("command")
+        assert prestop and prestop[0] == "sleep", f"{name}: preStop sleep missing"
+        prestop_s = float(prestop[1])
+        assert grace >= budget + prestop_s + 5, (
+            f"{name}: grace {grace}s must cover preStop {prestop_s}s + "
+            f"drain budget {budget}s + margin"
+        )
+
+
 def test_broker_ships_admission_watermarks():
     """The production broker must run with load-shed armed: shed_high
     below the drop-oldest bound (overload surfaces at producers, not as

@@ -386,6 +386,10 @@ def test_production_lock_graph_soak(lockcheck):
     L, H = 4, 8
     cfg = LearnerConfig(batch_size=4, seq_len=L, native_packer=False)
     cfg.policy.lstm_hidden = H
+    # PR-7 threads ride along: the replay reservoir makes snapshot_state
+    # walk real entries under the staging mutate lock, concurrent with
+    # the consumer — the checkpoint-worker composition.
+    cfg.replay.enabled = True
     broker = connect("mem://lockcheck-soak")
     version = {"v": 0}
     staging = StagingBuffer(cfg, broker, version_fn=lambda: version["v"]).start()
@@ -410,11 +414,30 @@ def test_production_lock_graph_soak(lockcheck):
             if i % 10 == 0:
                 staging.stats()
                 watchdog.verdict()
-                version["v"] = min(version["v"] + 1, 3)
+                # let the counter outrun the frame stamps: early frames
+                # stay fresh (batch path), later ones age past
+                # max_staleness into the reservoir (offer path) — both
+                # consumer-side lock scopes get traffic
+                version["v"] = min(version["v"] + 1, 8)
                 staging.get_batch(timeout=0.01)
+            if i % 25 == 0:
+                # full-state checkpoint snapshot concurrent with the
+                # consumer (PR 7): pending + reservoir walk under the
+                # mutate lock, exactly the CheckpointWorker's read.
+                snap = staging.snapshot_state(timeout=1.0)
+                assert snap is not None
             i += 1
             if i % 50 == 0:
                 time.sleep(0.01)
+        # SIGTERM drain composition: quiesce stops intake, the getter's
+        # drain-aware early-exit path runs, drained() gauges are read
+        # cross-thread — all under instrumentation.
+        staging.quiesce()
+        drain_deadline = time.monotonic() + 5.0
+        while not staging.drained() and time.monotonic() < drain_deadline:
+            staging.get_batch(timeout=0.05)
+        assert staging.drained()
+        assert staging.snapshot_state(timeout=1.0) is not None  # drain_save's read
     finally:
         watchdog.stop()
         staging.stop()

@@ -392,6 +392,56 @@ def test_watchdog_scalars_are_registered():
     assert not missing, f"watchdog scalars not in obs/registry.py: {missing}"
 
 
+def test_ckpt_and_resume_scalars_are_registered():
+    """The full-state checkpoint families (PR 7) only flow once
+    --ckpt.full_state is on, so the default-config JSONL drift guard
+    never exercises them — pin the exact names the learner emits
+    (checkpointer.save_stats keys re-prefixed ckpt_, the CheckpointWorker
+    totals, and the one-shot resume_* restore provenance) against the
+    registry directly."""
+    from dotaclient_tpu.obs import registry
+
+    emitted = [
+        # Checkpointer.save_stats() keys as the learner prefixes them
+        "ckpt_aux_written",
+        "ckpt_aux_superseded",
+        "ckpt_aux_failures",
+        "ckpt_last_aux_bytes",
+        "ckpt_last_aux_step",
+        # CheckpointWorker totals
+        "ckpt_async_saves_total",
+        "ckpt_async_coalesced_total",
+        # _restore_full_state's one-shot window
+        "resume_restored_step",
+        "resume_version_hwm_bump",
+        "resume_reservoir_entries",
+        "resume_pending_frames",
+        "resume_restore_wall_s",
+    ]
+    missing = registry.unregistered(emitted)
+    assert not missing, f"ckpt/resume scalars not in obs/registry.py: {missing}"
+    # The prefix list must NOT have quietly grown a catch-all that would
+    # defeat the drift guard for these families.
+    assert not registry.is_registered("ckpt_bogus_scalar")
+    assert not registry.is_registered("resume_bogus_scalar")
+
+
+def test_ckpt_save_stats_keys_match_registry_pins():
+    """save_stats() is the source of the ckpt_aux_* names above — if a
+    key is renamed there, this drift guard (not a dashboard) breaks."""
+    import tempfile
+
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.runtime.checkpoint import Checkpointer
+
+    ck = Checkpointer(tempfile.mkdtemp())
+    ck.save({"x": 1.0}, step=1, wait=True, aux=b"a")
+    names = [f"ckpt_{k}" for k in ck.save_stats()]
+    ck.close()
+    missing = registry.unregistered(names)
+    assert not missing, f"save_stats keys drifted from obs/registry.py: {missing}"
+
+
 def test_actor_fleet_scalars_are_registered():
     """The actor_* family (vector fleet batcher meters) is scrape-only
     like watchdog_* — it never passes through MetricsLogger, so the
