@@ -600,6 +600,26 @@ def main() -> None:
     # to missing fields, never zero out a measured (possibly on-silicon)
     # number via the top-level error contract.
     model_flops = xla_flops = achieved_flops = peak = h2d_bytes = d2h_bytes = None
+    h2d_obs_bytes = wire_step_bytes = wire_step_bytes_bf16 = pack_obs_dtype = None
+    try:
+        # Experience-wire accounting (ISSUE 8): serialized bytes per env
+        # step for the frames these producers actually shipped (default
+        # f32 wire) and for the DTR3 bf16 wire at the same shapes — the
+        # broker/TCP/staging-intake cost per step, distinct from h2d.
+        from dotaclient_tpu.transport.serialize import (
+            cast_rollout_obs_bf16,
+            deserialize_rollout,
+            serialize_rollout,
+        )
+
+        _wire_frame = _make_frames(cfg, 1)[0]
+        wire_step_bytes = len(_wire_frame) / cfg.seq_len
+        wire_step_bytes_bf16 = (
+            len(serialize_rollout(cast_rollout_obs_bf16(deserialize_rollout(_wire_frame))))
+            / cfg.seq_len
+        )
+    except Exception:
+        pass
     try:
         from dotaclient_tpu.ops import flops as flops_mod
 
@@ -629,9 +649,22 @@ def main() -> None:
         updates_per_sec = n_iters / dt
         achieved_flops = model_flops * updates_per_sec
         peak = None if on_cpu_fallback else flops_mod.peak_flops_for(str(devices[0]))
+        # From the ACTUAL staged transfer payload, never an assumed f32
+        # layout: `batch` is the dtype-grouped buffers the loop really
+        # ships, so the obs floats count at their staged width (bf16
+        # under the default compute-dtype cast, f32 only when staging
+        # ships f32) — assuming f32 here would overreport the obs share
+        # 2x and hide the bf16-at-rest win.
         h2d_bytes = sum(
             np.dtype(b.dtype).itemsize * int(np.prod(b.shape)) for b in jax.tree.leaves(batch)
         )
+        obs_float_leaves = (
+            host_batch.obs.global_feats,
+            host_batch.obs.hero_feats,
+            host_batch.obs.unit_feats,
+        )
+        h2d_obs_bytes = sum(int(l.nbytes) for l in obs_float_leaves)
+        pack_obs_dtype = np.dtype(obs_float_leaves[0].dtype).name
         d2h_bytes = 4 * sum(
             int(np.prod(l.shape, dtype=np.int64)) if l.ndim else 1
             for l in jax.tree.leaves(state.params)
@@ -674,6 +707,19 @@ def main() -> None:
         if peak and achieved_flops
         else None,
         "h2d_bytes_per_iter": int(h2d_bytes) if h2d_bytes else None,
+        # obs-float share of h2d at the ACTUAL staged dtype, and that
+        # dtype by name — the BENCH_r0N trajectory for the bf16-at-rest
+        # transfer win (pack_path_obs_dtype "bfloat16" = the cast-free
+        # native pack + halved obs transfer; "float32" = staging cast off)
+        "h2d_obs_bytes_per_iter": int(h2d_obs_bytes) if h2d_obs_bytes else None,
+        "pack_path_obs_dtype": pack_obs_dtype,
+        # serialized wire bytes per env step: as shipped by these
+        # producers (f32 default wire) and at the DTR3 bf16 wire for the
+        # same shapes (the --wire.obs_dtype bf16 broker/intake saving)
+        "wire_bytes_per_env_step": round(wire_step_bytes, 1) if wire_step_bytes else None,
+        "wire_bytes_per_env_step_bf16": round(wire_step_bytes_bf16, 1)
+        if wire_step_bytes_bf16
+        else None,
         "d2h_bytes_per_iter": int(d2h_bytes) if d2h_bytes else None,
         "transfer_layout_ab": transfer_ab,
         # mean ms per pipeline hop from the traced section (obs/trace.py

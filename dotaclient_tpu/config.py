@@ -148,6 +148,23 @@ class ReplayConfig:
 
 
 @dataclass
+class WireConfig:
+    """Experience-wire quantization (transport/serialize.py DTR3).
+    Producer-side only — consumers (staging, the native packer) accept
+    DTR1/2/3 unconditionally, so the rolling-upgrade order is
+    consumers-first: roll the learner, then flip actors to bf16."""
+
+    # Wire dtype of the float obs leaves in published rollout frames:
+    # "f32" (default) ships byte-identical legacy DTR1/DTR2 frames;
+    # "bf16" casts obs f32→bf16 AT THE SOURCE (the exact RNE rounding
+    # staging's compute-dtype cast applies anyway, so the TrainBatch is
+    # bitwise unchanged) and ships DTR3 — roughly halving broker queue
+    # memory, wire bandwidth, and staging intake bytes
+    # (WIRE_QUANT_AB.json). Pinned f32 in prod manifests until the soak.
+    obs_dtype: str = "f32"
+
+
+@dataclass
 class RetryConfig:
     """Broker-client retry policy (transport/base.py RetryPolicy): one
     policy shared by the tcp transport's reconnect loop and the actor's
@@ -470,6 +487,8 @@ class ActorConfig:
     policy: PolicyConfig = field(default_factory=PolicyConfig)
     retry: RetryConfig = field(default_factory=RetryConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    # Experience-wire quantization (--wire.obs_dtype {f32,bf16}).
+    wire: WireConfig = field(default_factory=WireConfig)
     seed: int = 0
     actor_id: int = 0
     # Actors are CPU processes (reference architecture: the accelerator

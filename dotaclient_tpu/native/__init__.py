@@ -148,10 +148,46 @@ def load_packer() -> Optional[ctypes.CDLL]:
 # High-level wrappers (numpy in, numpy out).
 
 
-def _schema_dims():
-    from dotaclient_tpu.env import featurizer as F
+_schema_dims_cached = None
 
-    return (F.GLOBAL_FEATURES, F.HERO_FEATURES, F.MAX_UNITS, F.UNIT_FEATURES, F.N_ACTION_TYPES)
+
+def _schema_dims():
+    # Featurizer dims are process constants; caching keeps this helper
+    # off the per-batch pack profile (it sat at ~1% of pack_frames).
+    global _schema_dims_cached
+    if _schema_dims_cached is None:
+        from dotaclient_tpu.env import featurizer as F
+
+        _schema_dims_cached = (
+            F.GLOBAL_FEATURES, F.HERO_FEATURES, F.MAX_UNITS, F.UNIT_FEATURES, F.N_ACTION_TYPES
+        )
+    return _schema_dims_cached
+
+
+_expect_dtypes_cached = {}
+
+
+def _expect_dtypes(obs_bf16: bool):
+    """np.dtype objects per `out` leaf, C-ABI order, cached: dtype-object
+    comparison in the per-batch stride validation is ~10x cheaper than
+    the `np.dtype(x).name` string path it replaced (the validation loop
+    was a measurable slice of the pack call at flagship shapes)."""
+    got = _expect_dtypes_cached.get(obs_bf16)
+    if got is None:
+        if obs_bf16:
+            import ml_dtypes
+
+            obs_dt = np.dtype(ml_dtypes.bfloat16)
+        else:
+            obs_dt = np.dtype(np.float32)
+        got = (
+            [obs_dt] * 3
+            + [np.dtype(np.bool_)] * 3
+            + [np.dtype(np.int32)] * 4
+            + [np.dtype(np.float32)] * 10
+        )
+        _expect_dtypes_cached[obs_bf16] = got
+    return got
 
 
 def frame_header(lib: ctypes.CDLL, frame: bytes) -> Optional[Tuple[int, int, int, int, int, float, float]]:
@@ -216,7 +252,7 @@ def frame_headers(lib: ctypes.CDLL, frames: List[bytes]) -> FrameHeaders:
     G, HF, U, UF, A = _schema_dims()
     n = len(frames)
     frame_ptrs = (ctypes.c_char_p * n)(*frames)
-    frame_lens = (ctypes.c_int64 * n)(*[len(f) for f in frames])
+    frame_lens = np.fromiter((len(f) for f in frames), np.int64, count=n)
     versions = np.zeros(n, np.int64)
     Ls = np.zeros(n, np.int64)
     Hs = np.zeros(n, np.int64)
@@ -225,19 +261,25 @@ def frame_headers(lib: ctypes.CDLL, frames: List[bytes]) -> FrameHeaders:
     ep_rets = np.zeros(n, np.float32)
     last_dones = np.zeros(n, np.float32)
     ok = np.zeros(n, np.uint8)
+
+    # Same bare-address pointer args as pack_frames (the staging ingest
+    # calls this once per drain; data_as cost ~7us per array).
+    def ptr(a):
+        return ctypes.c_void_p(a.ctypes.data)
+
     lib.dt_frame_headers(
         ctypes.cast(frame_ptrs, ctypes.POINTER(_u8p)),
-        frame_lens,
+        ptr(frame_lens),
         ctypes.c_int64(n),
         *(ctypes.c_int64(d) for d in (G, HF, U, UF, A)),
-        versions.ctypes.data_as(_i64p),
-        Ls.ctypes.data_as(_i64p),
-        Hs.ctypes.data_as(_i64p),
-        flags.ctypes.data_as(_i64p),
-        actor_ids.ctypes.data_as(_i64p),
-        ep_rets.ctypes.data_as(_f32p),
-        last_dones.ctypes.data_as(_f32p),
-        ok.ctypes.data_as(_u8p),
+        ptr(versions),
+        ptr(Ls),
+        ptr(Hs),
+        ptr(flags),
+        ptr(actor_ids),
+        ptr(ep_rets),
+        ptr(last_dones),
+        ptr(ok),
     )
     # .tolist() once: the consumer's python filter loop then touches only
     # plain ints/floats (numpy scalar extraction per element is ~10x slower)
@@ -319,16 +361,13 @@ def pack_frames(
         # writer's widths are fixed, so a template/flag mismatch (e.g. an
         # uncast f32 template with obs_bf16=True) must fail HERE, not
         # silently reinterpret the storage and ship garbage obs.
-        obs_dt = "bfloat16" if obs_bf16 else "float32"
-        expect_dtypes = (
-            [obs_dt] * 3 + ["bool"] * 3 + ["int32"] * 4 + ["float32"] * 7 + ["float32"] * 3
-        )
+        expect_dtypes = _expect_dtypes(obs_bf16)
         stride_vals = []
         for arr, want in zip(ordered, expect_dtypes):
             if arr is None:
                 stride_vals.append(0)
                 continue
-            if np.dtype(arr.dtype).name != want:
+            if arr.dtype != want:
                 raise BatchLayoutError(
                     f"out leaf dtype {np.dtype(arr.dtype).name} != {want} "
                     f"(obs_bf16={obs_bf16}; template/flag mismatch)"
@@ -348,59 +387,80 @@ def pack_frames(
         strides_arg = (ctypes.c_int64 * 20)(*stride_vals)
     G, HF, U, UF, A = _schema_dims()
 
+    args, _keepalive = _pack_batch_args(
+        frames, batch, seq_len, lstm_hidden, with_aux, obs_bf16, strides_arg,
+        (G, HF, U, UF, A),
+    )
+    rc = lib.dt_pack_batch(*args)
+    if rc != 0:
+        raise ValueError(f"native packer rejected frame {-rc - 1}")
+    return batch
+
+
+def _pack_batch_args(frames, batch, seq_len, lstm_hidden, with_aux, obs_bf16,
+                     strides_arg, dims):
+    """The dt_pack_batch argument vector for a (frames, batch) pair →
+    (args, keepalive). Split from pack_frames so the ctypes glue — a
+    fixed per-call cost the wire dtype cannot change — is separately
+    buildable/timed from the C pack itself (scripts/ab_wire_quant.py);
+    `keepalive` must outlive the call (it owns the marshaled buffers).
+
+    Bare-address pointer args: `c_void_p(a.ctypes.data)` is ~5x cheaper
+    than `data_as(POINTER(...))` and this call passes 24 of them — the
+    data_as path alone was ~0.15 ms of the ~1 ms flagship pack
+    (dt_pack_batch declares no argtypes, so a void* passes through like
+    any typed pointer; the arrays stay referenced by `batch`/keepalive
+    for the duration of the call). dtype checking is not lost — the
+    caller's validation (or zeros_train_batch allocation) already fixed
+    every leaf's dtype. The obs leaves serve f32 AND bf16 storage; the
+    C side reinterprets by the obs_bf16 flag."""
+    n = len(frames)
     frame_ptrs = (ctypes.c_char_p * n)(*frames)
-    frame_lens = (ctypes.c_int64 * n)(*[len(f) for f in frames])
-    versions = np.zeros(n, np.uint32)
-    actor_ids = np.zeros(n, np.uint32)
-    ep_returns = np.zeros(n, np.float32)
+    # np.fromiter beats a ctypes-array(*listcomp) ~3x for the length
+    # vector; the C side reads it as const int64_t* either way.
+    frame_lens = np.fromiter((len(f) for f in frames), np.int64, count=n)
+    # np.empty: dt_pack_batch writes every row before returning 0, and
+    # the caller discards all three on a nonzero rc.
+    versions = np.empty(n, np.uint32)
+    actor_ids = np.empty(n, np.uint32)
+    ep_returns = np.empty(n, np.float32)
 
-    def fp(a):
-        return a.ctypes.data_as(_f32p)
-
-    def u8(a):
-        return a.ctypes.data_as(_u8p)
-
-    def i32(a):
-        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    def ptr(a):
+        return ctypes.c_void_p(a.ctypes.data)
 
     obs, acts, aux = batch.obs, batch.actions, batch.aux
-    # The three obs leaves go through fp too: data_as does no dtype
-    # checking, so it serves f32 AND bf16 storage — the C side
-    # reinterprets the pointer by the obs_bf16 flag.
-    rc = lib.dt_pack_batch(
+    args = (
         ctypes.cast(frame_ptrs, ctypes.POINTER(_u8p)),
-        frame_lens,
+        ptr(frame_lens),
         ctypes.c_int64(n),
         ctypes.c_int64(seq_len),
         ctypes.c_int64(lstm_hidden),
         ctypes.c_int64(1 if with_aux else 0),
         ctypes.c_int64(1 if obs_bf16 else 0),
-        *(ctypes.c_int64(d) for d in (G, HF, U, UF, A)),
+        *(ctypes.c_int64(d) for d in dims),
         strides_arg,
-        fp(obs.global_feats),
-        fp(obs.hero_feats),
-        fp(obs.unit_feats),
-        u8(obs.unit_mask),
-        u8(obs.target_mask),
-        u8(obs.action_mask),
-        i32(acts.type),
-        i32(acts.move_x),
-        i32(acts.move_y),
-        i32(acts.target),
-        fp(batch.behavior_logp),
-        fp(batch.behavior_value),
-        fp(batch.rewards),
-        fp(batch.dones),
-        fp(batch.mask),
-        fp(batch.initial_state[0]),
-        fp(batch.initial_state[1]),
-        fp(aux.win) if aux is not None else None,
-        fp(aux.last_hit) if aux is not None else None,
-        fp(aux.net_worth) if aux is not None else None,
-        versions.ctypes.data_as(_u32p),
-        actor_ids.ctypes.data_as(_u32p),
-        ep_returns.ctypes.data_as(_f32p),
+        ptr(obs.global_feats),
+        ptr(obs.hero_feats),
+        ptr(obs.unit_feats),
+        ptr(obs.unit_mask),
+        ptr(obs.target_mask),
+        ptr(obs.action_mask),
+        ptr(acts.type),
+        ptr(acts.move_x),
+        ptr(acts.move_y),
+        ptr(acts.target),
+        ptr(batch.behavior_logp),
+        ptr(batch.behavior_value),
+        ptr(batch.rewards),
+        ptr(batch.dones),
+        ptr(batch.mask),
+        ptr(batch.initial_state[0]),
+        ptr(batch.initial_state[1]),
+        ptr(aux.win) if aux is not None else None,
+        ptr(aux.last_hit) if aux is not None else None,
+        ptr(aux.net_worth) if aux is not None else None,
+        ptr(versions),
+        ptr(actor_ids),
+        ptr(ep_returns),
     )
-    if rc != 0:
-        raise ValueError(f"native packer rejected frame {-rc - 1}")
-    return batch
+    return args, (frame_ptrs, frame_lens, versions, actor_ids, ep_returns, batch)

@@ -15,10 +15,20 @@
 // masks). ctypes releases the GIL around the call, so batch packing
 // overlaps the device step.
 //
-// Frame layout (transport/serialize.py, little-endian):
-//   magic 'DTR1' | u32 version | u16 L | u16 H | u8 flags | u32 actor_id
-//   | f32 episode_return | arrays in fixed order (shapes derive from L/H
-//   and the schema dims passed in by the caller).
+// Frame layouts (transport/serialize.py, little-endian):
+//   DTR1: magic 'DTR1' | u32 version | u16 L | u16 H | u8 flags
+//         | u32 actor_id | f32 episode_return | arrays in fixed order
+//         (shapes derive from L/H and the schema dims passed in by the
+//         caller).
+//   DTR3 (quantized wire): magic 'DTR3' | the same fixed fields | u64
+//         trace_id | f64 birth_time | u8 n_dtypes | u8[n] dtype-map |
+//         arrays in their WIRE dtypes. This build accepts exactly the
+//         canonical map with the three float obs leaves uniformly f32
+//         or uniformly bf16 (codes 0/3) — the same accept set as the
+//         python parser. bf16 wire → bf16 batch is the cast-free fast
+//         path: the obs copy is a strided memcpy, no convert loop.
+//   (DTR2 never reaches this code: the staging intake normalizes traced
+//   f32 frames to byte-identical DTR1 first.)
 
 #include <cstdint>
 #include <cstring>
@@ -26,7 +36,10 @@
 namespace {
 
 constexpr int64_t kHeaderBytes = 21;
+constexpr int64_t kTraceExtBytes = 16;  // u64 trace_id + f64 birth_time
 constexpr uint8_t kFlagAux = 1;
+// DTR3 dtype-map codes (transport/serialize.py _WIRE_*).
+constexpr uint8_t kWireF32 = 0, kWireI32 = 1, kWireU8 = 2, kWireBf16 = 3;
 
 // f32 -> bf16 with round-to-nearest-even, the exact semantics of
 // numpy.astype(ml_dtypes.bfloat16) (and of the policy's own first-op
@@ -72,10 +85,36 @@ struct Reader {
     }
     p += n_floats * 4;
   }
+  // Read n bf16 from the frame, write f32. The widening is exact (pad
+  // 16 zero mantissa bits) — a bf16-wire frame consumed by an f32-batch
+  // config (compute dtype f32, or staging cast off) loses nothing
+  // beyond what the producer's cast already rounded away.
+  void copy_bf16_to_f32(float* dst, int64_t n) {
+    if (!ok || p + n * 2 > end) {
+      ok = false;
+      return;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+      uint16_t b;
+      std::memcpy(&b, p + i * 2, 2);
+      const uint32_t x = static_cast<uint32_t>(b) << 16;
+      std::memcpy(dst + i, &x, 4);
+    }
+    p += n * 2;
+  }
   // Dispatch for float OBS fields: dst_f32 points at f32 storage when
-  // obs_bf16 == 0, at bf16 (u16) storage when 1; `off` is in ELEMENTS.
-  void copy_obs(float* dst_f32, int64_t off, int64_t n_floats, int64_t obs_bf16) {
-    if (obs_bf16) {
+  // obs_bf16 == 0, at bf16 (u16) storage when 1; `off` is in ELEMENTS;
+  // wire_bf16 is the FRAME's obs dtype (DTR3 dtype-map). The matched
+  // cases are memcpys; the mixed cases convert one direction each.
+  void copy_obs(float* dst_f32, int64_t off, int64_t n_floats, int64_t obs_bf16,
+                int64_t wire_bf16) {
+    if (wire_bf16) {
+      if (obs_bf16) {
+        copy(reinterpret_cast<uint16_t*>(dst_f32) + off, n_floats * 2);
+      } else {
+        copy_bf16_to_f32(dst_f32 + off, n_floats);
+      }
+    } else if (obs_bf16) {
       copy_f32_to_bf16(reinterpret_cast<uint16_t*>(dst_f32) + off, n_floats);
     } else {
       copy(dst_f32 + off, n_floats * 4);
@@ -113,12 +152,16 @@ struct Header {
   int64_t flags;
   float ep_ret;
   float last_done;
+  int64_t wire_obs_bf16;  // DTR3 map says the float obs travel as bf16
+  int64_t body_off;       // where the arrays start (header + extensions)
 };
 
 bool parse_header(const uint8_t* p, int64_t len,
                   int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
                   Header* h) {
-  if (len < kHeaderBytes || std::memcmp(p, "DTR1", 4) != 0) return false;
+  if (len < kHeaderBytes) return false;
+  const bool dtr3 = std::memcmp(p, "DTR3", 4) == 0;
+  if (!dtr3 && std::memcmp(p, "DTR1", 4) != 0) return false;
   uint16_t L16, H16;
   std::memcpy(&h->version, p + 4, 4);
   std::memcpy(&L16, p + 8, 2);
@@ -130,14 +173,43 @@ bool parse_header(const uint8_t* p, int64_t len,
   h->H = H16;
   const int64_t T1 = h->L + 1;
   const bool aux = (h->flags & kFlagAux) != 0;
-  const int64_t expect = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
+  h->wire_obs_bf16 = 0;
+  int64_t body = kHeaderBytes;
+  if (dtr3) {
+    // Trace extension (values irrelevant to packing) + dtype-map. The
+    // map must be EXACTLY the canonical layout, obs leaves uniformly
+    // f32 or bf16 — same accept set as transport/serialize.py
+    // check_dtr3_dtype_map, so python and native quarantine identically.
+    body += kTraceExtBytes;
+    if (len < body + 1) return false;
+    const int64_t n_map = aux ? 19 : 16;
+    if (p[body] != n_map) return false;
+    body += 1;
+    if (len < body + n_map) return false;
+    const uint8_t* m = p + body;
+    const uint8_t oc = m[0];
+    if (oc != kWireF32 && oc != kWireBf16) return false;
+    for (int64_t i = 1; i < 3; ++i)
+      if (m[i] != oc) return false;
+    for (int64_t i = 3; i < 6; ++i)
+      if (m[i] != kWireU8) return false;
+    for (int64_t i = 6; i < 10; ++i)
+      if (m[i] != kWireI32) return false;
+    for (int64_t i = 10; i < n_map; ++i)
+      if (m[i] != kWireF32) return false;
+    h->wire_obs_bf16 = (oc == kWireBf16) ? 1 : 0;
+    body += n_map;
+  }
+  h->body_off = body;
+  const int64_t obs_sz = h->wire_obs_bf16 ? 2 : 4;
+  const int64_t expect = body + T1 * (G + HF + U * UF) * obs_sz +
                          T1 * (2 * U + A) + h->L * 8 * 4 + h->H * 2 * 4 +
                          (aux ? h->L * 3 * 4 : 0);
   if (len != expect) return false;
   // last element of the dones array (episode-end marker for stats)
   h->last_done = 0.0f;
   if (h->L > 0) {
-    const int64_t dones_off = kHeaderBytes + T1 * (G + HF + U * UF) * 4 +
+    const int64_t dones_off = body + T1 * (G + HF + U * UF) * obs_sz +
                               T1 * (2 * U + A) + h->L * 7 * 4;
     std::memcpy(&h->last_done, p + dones_off + (h->L - 1) * 4, 4);
   }
@@ -164,9 +236,11 @@ extern "C" {
 int64_t dt_pack_batch(
     const uint8_t** frames, const int64_t* frame_lens, int64_t n,
     int64_t T, int64_t H, int64_t want_aux,
-    // When 1, the three float obs outputs are bf16 (uint16) storage and
-    // the pack converts f32->bf16 in the copy loop (RNE, bitwise equal
-    // to the python cast pass). Non-obs floats are always f32.
+    // When 1, the three float obs outputs are bf16 (uint16) storage;
+    // f32-wire frames convert f32->bf16 in the copy loop (RNE, bitwise
+    // equal to the python cast pass) and bf16-wire (DTR3) frames copy
+    // straight through — the cast-free fast path. Non-obs floats are
+    // always f32 on every wire.
     int64_t obs_bf16,
     // schema dims: global, hero, units, unit-features, action-types
     int64_t G, int64_t HF, int64_t U, int64_t UF, int64_t A,
@@ -203,10 +277,10 @@ int64_t dt_pack_batch(
     const bool frame_aux = (hdr.flags & kFlagAux) != 0;
     const int64_t T1 = L + 1;
 
-    Reader r{p + kHeaderBytes, p + len, true};
-    r.copy_obs(global_f, b * st[0], T1 * G, obs_bf16);
-    r.copy_obs(hero_f, b * st[1], T1 * HF, obs_bf16);
-    r.copy_obs(unit_f, b * st[2], T1 * U * UF, obs_bf16);
+    Reader r{p + hdr.body_off, p + len, true};
+    r.copy_obs(global_f, b * st[0], T1 * G, obs_bf16, hdr.wire_obs_bf16);
+    r.copy_obs(hero_f, b * st[1], T1 * HF, obs_bf16, hdr.wire_obs_bf16);
+    r.copy_obs(unit_f, b * st[2], T1 * U * UF, obs_bf16, hdr.wire_obs_bf16);
     r.copy_bool(unit_m + b * st[3], T1 * U);
     r.copy_bool(target_m + b * st[4], T1 * U);
     r.copy_bool(action_m + b * st[5], T1 * A);

@@ -64,6 +64,20 @@ SCALARS: Dict[str, str] = {
     ),
     "queue_ready": "packed batches waiting in the staging queue",
     "episodes": "episodes completed (cumulative, from done frames)",
+    # --- experience wire (transport/serialize.py DTR3, staged by
+    #     runtime/staging.py, emitted by the learner loop) --------------
+    "wire_bytes_consumed_total": (
+        "serialized experience bytes entering the staging intake "
+        "(cumulative; the bf16 wire roughly halves the obs share)"
+    ),
+    "wire_frames_obs_bf16_total": (
+        "frames whose float obs leaves traveled as bf16 (DTR3 quantized "
+        "wire, --wire.obs_dtype bf16 producers)"
+    ),
+    "wire_frames_obs_f32_total": (
+        "frames whose float obs leaves traveled as f32 (legacy DTR1/DTR2 "
+        "producers) — nonzero during a rolling upgrade"
+    ),
     "weights_published": "weight fanout frames actually sent",
     "weights_coalesced": "weight publishes superseded before sending",
     "mean_episode_return": "mean per-episode return over consumed frames",

@@ -61,6 +61,7 @@ from dotaclient_tpu.transport.serialize import (
     deserialize_weights,
     serialize_rollout,
     unflatten_params,
+    wire_cast_fn,
 )
 
 _log = logging.getLogger(__name__)
@@ -450,6 +451,12 @@ class Actor:
         self.publish_throttle = ShedThrottle(
             RetryPolicy.from_config(retry_cfg) if retry_cfg is not None else None
         )
+        # Quantized experience wire (--wire.obs_dtype): resolved ONCE at
+        # boot so a bad value fails the actor loudly at startup, not per
+        # chunk. "f32" (default) is the identity — byte-identical legacy
+        # frames, no ml_dtypes import on the publish path.
+        wire_cfg = getattr(cfg, "wire", None)
+        self._wire_cast = wire_cast_fn(wire_cfg.obs_dtype if wire_cfg is not None else "f32")
         self.obs = self._make_obs_runtime()
         # ±1 result of the last finished episode, 0.0 for a decided draw
         # (episode ended with no winning team), None while in flight or
@@ -606,10 +613,12 @@ class Actor:
                 )
                 if self.obs is not None:
                     rollout = self.obs.stamp(rollout, self.actor_id)
-                # Shed/failed publishes drop the chunk and pay a jittered
-                # backoff (ShedThrottle docstring); the episode continues.
+                # Cast-at-source wire quantization (identity under the
+                # default f32), then shed/failed publishes drop the chunk
+                # and pay a jittered backoff (ShedThrottle docstring);
+                # the episode continues.
                 if await self.publish_throttle.publish(
-                    self.broker, serialize_rollout(rollout)
+                    self.broker, serialize_rollout(self._wire_cast(rollout))
                 ):
                     self.rollouts_published += 1
                 state, chunk = next_chunk(cfg.policy, state)

@@ -1075,6 +1075,13 @@ class Learner:
                     scalars["staging_quarantined"] = stats["quarantined"]
                     scalars["queue_ready"] = stats["ready_batches"]
                     scalars["episodes"] = stats["episodes"]
+                    # Experience-wire meters (DTR3 quantized wire): bytes
+                    # entering the staging intake and the fleet's frame
+                    # split by obs wire dtype — the consumers-first
+                    # rolling upgrade's progress gauge.
+                    scalars["wire_bytes_consumed_total"] = stats["wire_bytes"]
+                    scalars["wire_frames_obs_bf16_total"] = stats["wire_frames_obs_bf16"]
+                    scalars["wire_frames_obs_f32_total"] = stats["wire_frames_obs_f32"]
                     # Replay reservoir health (replay.enabled only):
                     # occupancy, hit ratio, replayed-frame age histogram
                     # buckets, bytes spilled — all pre-flattened scalars.

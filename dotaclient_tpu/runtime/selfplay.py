@@ -56,7 +56,11 @@ from dotaclient_tpu.runtime.actor import (
     reset_env_stub,
 )
 from dotaclient_tpu.transport.base import Broker, BrokerShedError
-from dotaclient_tpu.transport.serialize import serialize_rollout, unflatten_params
+from dotaclient_tpu.transport.serialize import (
+    serialize_rollout,
+    unflatten_params,
+    wire_cast_fn,
+)
 
 _log = logging.getLogger(__name__)
 
@@ -117,6 +121,10 @@ class SelfPlayActor:
         self.last_win: Optional[float] = None  # radiant (live) perspective
         self.last_heroes: list = []  # live side's pool draws, last episode
         self.last_weight_time = time.monotonic()  # kill-switch clock
+        # Same cast-at-source wire quantization as Actor (identity under
+        # the default --wire.obs_dtype f32).
+        wire_cfg = getattr(cfg, "wire", None)
+        self._wire_cast = wire_cast_fn(wire_cfg.obs_dtype if wire_cfg is not None else "f32")
         # Same opt-in trace stamping as Actor (runtime/actor.py): None
         # when --obs.enabled is off, and frames stay legacy DTR1.
         from dotaclient_tpu.obs import ObsRuntime
@@ -179,7 +187,7 @@ class SelfPlayActor:
         if self.obs is not None:
             rollout = self.obs.stamp(rollout, self.actor_id)
         try:
-            self.broker.publish_experience(serialize_rollout(rollout))
+            self.broker.publish_experience(serialize_rollout(self._wire_cast(rollout)))
             self.rollouts_published += 1
         except BrokerShedError:
             # Admission refusal: drop the chunk and continue the episode.

@@ -56,9 +56,13 @@ from dotaclient_tpu.obs.trace import TraceRef
 from dotaclient_tpu.transport.base import Broker
 from dotaclient_tpu.transport.serialize import (
     Rollout,
+    WireDtypeError,
+    check_dtr3_dtype_map,
     deserialize_rollout,
     peek_rollout_trace,
+    rollout_obs_bf16,
     strip_rollout_trace,
+    wire_obs_is_bf16,
 )
 
 
@@ -307,6 +311,15 @@ class StagingBuffer:
             "episode_return_sum": 0.0,
             "episodes": 0,
             "consumer_errors": 0,
+            # Experience-wire meters (the DTR3 quantized-wire rollout):
+            # cumulative serialized bytes entering the intake, and frames
+            # split by the wire dtype of their float obs leaves. The
+            # learner re-emits these as the registry-pinned wire_*_total
+            # scalars — the fleetwide "who has flipped to bf16" gauge a
+            # consumers-first rolling upgrade is steered by.
+            "wire_bytes": 0,
+            "wire_frames_obs_bf16": 0,
+            "wire_frames_obs_f32": 0,
         }
 
     @property
@@ -505,15 +518,19 @@ class StagingBuffer:
         return cast_obs_to_compute_dtype(self.cfg, batch), None
 
     def _parse(self, frame: bytes):
-        """PYTHON-fallback frame parse → (Rollout, version, L, H,
-        actor_id, ep_return, last_done) or None if malformed. The native
-        path never comes through here — _ingest parses a whole drain in
-        one `native.frame_headers` call and keeps raw frame bytes for
-        the C packer."""
+        """PYTHON-fallback frame parse → ((Rollout, version, L, H,
+        actor_id, ep_return, last_done), None) or (None, reason) if
+        malformed — reason is the quarantine label ("dtype_map" for a
+        DTR3 dtype-map failure, "parse" otherwise). The native path
+        never comes through here — _ingest parses a whole drain in one
+        `native.frame_headers` call and keeps raw frame bytes for the C
+        packer."""
         try:
             r = deserialize_rollout(frame)
+        except WireDtypeError:
+            return None, "dtype_map"
         except (ValueError, KeyError):
-            return None
+            return None, "parse"
         last_done = float(r.dones[-1]) if r.length else 0.0
         return (
             r,
@@ -523,7 +540,7 @@ class StagingBuffer:
             r.actor_id,
             r.episode_return,
             last_done,
-        )
+        ), None
 
     def _offer_replay(
         self, item, frame: bytes, version: int, current_version: int, ref=None
@@ -584,25 +601,47 @@ class StagingBuffer:
         ep_ret = 0.0
         now = time.monotonic()
         tr = self._tracer
+        wire_bytes = sum(len(f) for f in frames)
+        wire_bf16 = wire_f32 = 0
         # Rolling-upgrade intake for the native path: trace-stamped DTR2
         # frames are normalized here to the byte-identical DTR1 layout
         # the C packer speaks (transport.serialize.strip_rollout_trace),
         # independent of whether THIS process traces — a consumer must
-        # parse every producer's frames mid-roll. An all-DTR1 drain (the
-        # default-off fleet) pays one 4-byte prefix check per frame and
-        # keeps the exact frame objects (no copies — asserted in
-        # tests/test_obs.py). The python fallback needs none of this:
-        # deserialize_rollout speaks both magics natively.
+        # parse every producer's frames mid-roll. Quantized DTR3 frames
+        # pass through WHOLE (the C packer parses the dtype-map itself —
+        # stripping would change the array encoding); only their
+        # dtype-map is pre-checked here, in constant time per frame, so
+        # a truncated/corrupt map dead-letters under its own "dtype_map"
+        # reason instead of the generic native parse failure. An
+        # all-DTR1 drain (the default-off fleet) pays one 4-byte prefix
+        # check per frame and keeps the exact frame objects (no copies —
+        # asserted in tests/test_obs.py). The python fallback needs
+        # none of this: deserialize_rollout speaks all three magics.
         frame_traces: Optional[List] = None
+        bad_maps: Dict[int, bytes] = {}
         if self._lib is not None:
             for i, f in enumerate(frames):
-                if f[:4] == b"DTR2":
+                pfx = f[:4]
+                if pfx == b"DTR2":
                     if tr is not None:
                         if frame_traces is None:
                             frame_traces = [None] * consumed
                         tid, birth = peek_rollout_trace(f)
                         frame_traces[i] = TraceRef(tid, birth)
                     frames[i] = strip_rollout_trace(f)
+                elif pfx == b"DTR3":
+                    if check_dtr3_dtype_map(f) is not None:
+                        # Keep the original bytes as quarantine evidence;
+                        # the emptied slot fails the native header parse
+                        # below, which routes it to the poison branch.
+                        bad_maps[i] = f
+                        frames[i] = b""
+                    elif tr is not None:
+                        tid, birth = peek_rollout_trace(f)
+                        if tid or birth:
+                            if frame_traces is None:
+                                frame_traces = [None] * consumed
+                            frame_traces[i] = TraceRef(tid, birth)
             # ONE ctypes call parses/validates every frame of the drain
             # (the per-frame FFI loop cost 1.3ms/batch at 256 frames —
             # r5 profile); the python loop below then touches only plain
@@ -613,23 +652,36 @@ class StagingBuffer:
                 native.frame_headers(self._lib, frames)
             )
             parsed_iter = (
-                (frames[i], versions[i], Ls[i], Hs[i], actor_ids[i], ep_rets[i], last_dones[i])
-                if ok[i]
-                else None
+                (
+                    (frames[i], versions[i], Ls[i], Hs[i], actor_ids[i], ep_rets[i], last_dones[i])
+                    if ok[i]
+                    else None,
+                    "dtype_map" if i in bad_maps else "parse",
+                )
                 for i in range(consumed)
             )
         else:
             parsed_iter = (self._parse(f) for f in frames)
-        for i, parsed in enumerate(parsed_iter):
+        for i, (parsed, bad_reason) in enumerate(parsed_iter):
             if parsed is None:
                 # Poison frame (bad magic, truncated arrays, corrupt
-                # header): dead-letter it WITH evidence instead of only
-                # ticking a counter.
+                # header, unsupported dtype-map): dead-letter it WITH
+                # evidence instead of only ticking a counter.
                 dropped_bad += 1
                 quarantined += 1
-                self._quarantine_put(frames[i], "parse")
+                self._quarantine_put(bad_maps.get(i, frames[i]), bad_reason)
                 continue
             item, version, L, frame_h, actor_id, frame_ret, last_done = parsed
+            # Wire-dtype meter: native items are raw frame bytes (magic +
+            # map byte check), python items are Rollouts (leaf dtype).
+            if (
+                wire_obs_is_bf16(item)
+                if not isinstance(item, Rollout)
+                else rollout_obs_bf16(item)
+            ):
+                wire_bf16 += 1
+            else:
+                wire_f32 += 1
             self._actor_seen[actor_id] = now  # heartbeat (consumer thread only)
             # Prune long-gone ids here, on the sole writer thread, so the
             # dict stays bounded without stats() ever mutating shared state.
@@ -681,6 +733,9 @@ class StagingBuffer:
             self._stats["quarantined"] += quarantined
             self._stats["episodes"] += episodes
             self._stats["episode_return_sum"] += ep_ret
+            self._stats["wire_bytes"] += wire_bytes
+            self._stats["wire_frames_obs_bf16"] += wire_bf16
+            self._stats["wire_frames_obs_f32"] += wire_f32
 
     # -- learner side ----------------------------------------------------
 

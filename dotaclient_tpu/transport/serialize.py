@@ -24,14 +24,36 @@ the obs/ pipeline-tracing extension):
   u64    trace_id   — pipeline trace id stamped by the publishing actor
   f64    birth_time — time.time() at publish (e2e latency origin)
   then the arrays, identical to DTR1.
+
+Quantized rollout frame (DTR3, emitted whenever the float obs leaves
+travel in a non-f32 wire dtype — the --wire.obs_dtype bf16 experience
+quantization, HEPPO-GAE-style):
+  magic  b'DTR3'
+  then the FULL DTR2 header (DTR1 fields + u64 trace_id + f64
+  birth_time; both zero when untraced — one format either way)
+  u8     n_dtypes   — number of arrays in the frame (16, or 19 with aux;
+         must match the flags byte)
+  u8[n]  dtype-map  — per-array wire dtype code, serialization order
+         (codes: 0=f32, 1=i32, 2=u8, 3=bf16)
+  then the arrays in their WIRE dtypes. This build constrains the map:
+  every non-obs-float entry must be canonical, and the three float obs
+  entries must be uniformly f32 or uniformly bf16 — both the python
+  parser and the native C packer enforce the same accept set, and a
+  frame violating it is a WireDtypeError (staging quarantines it with
+  the distinct "dtype_map" reason). The bf16 cast happens AT THE SOURCE
+  (cast_rollout_obs_bf16, the exact round-to-nearest-even of staging's
+  cast_obs_to_compute_dtype), so a bf16-wire TrainBatch is bitwise
+  identical to the f32-wire + cast-at-staging batch.
+
 Rolling-upgrade contract, the publish_legacy_dtw1 precedent: compat is
 one-directional — NEW readers (deserialize_rollout, the staging intake's
-strip_rollout_trace normalization) accept BOTH magics, old readers
-reject DTR2. Tracing is therefore opt-in per actor (--obs.enabled) and
-default-off: with it off the frames are byte-identical DTR1, so a fleet
-rolls consumers first, then turns tracing on — exactly the DTW1→DTW2
-ordering. Golden bytes for both layouts are frozen in
-tests/test_transport.py.
+strip_rollout_trace normalization, the native packer's parse_header)
+accept DTR1+DTR2+DTR3, old readers reject DTR2/DTR3 loudly (unknown
+magic). Tracing (--obs.enabled) and wire quantization
+(--wire.obs_dtype bf16) are therefore opt-in per actor and default-off:
+with both off the frames are byte-identical DTR1, so a fleet rolls
+consumers first, then turns either on — exactly the DTW1→DTW2 ordering.
+Golden bytes for all three layouts are frozen in tests/test_transport.py.
 
 Weight frame layout (current, DTW2 — the authoritative spec any native
 or non-Python reader is written from; golden bytes frozen in
@@ -64,6 +86,7 @@ from dotaclient_tpu.ops.action_dist import Action
 
 _ROLLOUT_MAGIC = b"DTR1"
 _ROLLOUT_MAGIC2 = b"DTR2"  # trace-extended (obs/): header + trace_id/birth
+_ROLLOUT_MAGIC3 = b"DTR3"  # quantized wire: DTR2 header + per-array dtype-map
 _WEIGHTS_MAGIC = b"DTW1"  # legacy: no boot_epoch (read-compat only)
 _WEIGHTS_MAGIC2 = b"DTW2"
 _HDR = struct.Struct("<4sIHHBIf")
@@ -71,6 +94,66 @@ _HDR = struct.Struct("<4sIHHBIf")
 _HDR2 = struct.Struct("<4sIHHBIfQd")
 
 _FLAG_AUX = 1
+
+# Wire dtype codes for the DTR3 dtype-map (the rollout-side analog of the
+# weight-frame _DTYPES table below; 3=bf16 is rollout-only).
+_WIRE_F32, _WIRE_I32, _WIRE_U8, _WIRE_BF16 = 0, 1, 2, 3
+
+
+class WireDtypeError(ValueError):
+    """A DTR3 frame whose dtype-map is truncated, malformed, or names a
+    wire layout this build does not speak. Distinct from the plain
+    ValueError of a generally-corrupt frame so the staging quarantine
+    can file it under its own reason ("dtype_map") — a fleetwide stream
+    of these means a producer is ahead of this consumer, not that the
+    wire is flipping bits."""
+
+
+def _bf16_dtype():
+    import ml_dtypes  # deferred: only DTR3/bf16 paths need it
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _canonical_codes(flags: int, obs_code: int) -> bytes:
+    """The dtype-map this build accepts, in serialization order: 3 float
+    obs leaves (f32 or bf16, uniform), 3 u8 masks, 4 i32 action heads,
+    6 f32 scalars/state, +3 f32 aux when flagged."""
+    codes = [obs_code] * 3 + [_WIRE_U8] * 3 + [_WIRE_I32] * 4 + [_WIRE_F32] * 6
+    if flags & _FLAG_AUX:
+        codes += [_WIRE_F32] * 3
+    return bytes(codes)
+
+
+def check_dtr3_dtype_map(data: bytes) -> Optional[str]:
+    """None when `data` (magic already known to be DTR3) carries a
+    well-formed dtype-map this build speaks, else the quarantine reason.
+    Constant-time header peek — no array parsing, shared by the python
+    parser and the staging intake's native-path pre-check so both paths
+    accept the exact same frames."""
+    if len(data) < _HDR2.size + 1:
+        return "dtype_map"
+    flags = data[12]
+    n = data[_HDR2.size]
+    if len(data) < _HDR2.size + 1 + n:
+        return "dtype_map"
+    m = data[_HDR2.size + 1 : _HDR2.size + 1 + n]
+    if m != _canonical_codes(flags, _WIRE_F32) and m != _canonical_codes(
+        flags, _WIRE_BF16
+    ):
+        return "dtype_map"
+    return None
+
+
+def wire_obs_is_bf16(data: bytes) -> bool:
+    """True iff `data` is a DTR3 frame shipping its float obs leaves as
+    bf16 (map code 3 at entry 0). Cheap per-frame meter for the staging
+    wire_* scalars; garbage-safe (short/foreign frames are False)."""
+    return (
+        len(data) > _HDR2.size + 1
+        and data[:4] == _ROLLOUT_MAGIC3
+        and data[_HDR2.size + 1] == _WIRE_BF16
+    )
 
 
 class RolloutAux(NamedTuple):
@@ -111,11 +194,55 @@ class Rollout(NamedTuple):
         return bool(self.trace_id or self.birth_time)
 
 
-def _obs_arrays(obs: F.Observation) -> List[np.ndarray]:
+def rollout_obs_bf16(r: Rollout) -> bool:
+    """True when the rollout's float obs leaves are already bf16 — the
+    cast-at-source wire form. Serialization keys the frame format off
+    the ACTUAL leaf dtype, so a producer opts in simply by casting."""
+    return np.dtype(getattr(r.obs.global_feats, "dtype", np.float32)).name == "bfloat16"
+
+
+def cast_rollout_obs_bf16(r: Rollout) -> Rollout:
+    """Cast the float obs leaves f32→bf16 at the SOURCE (the actor),
+    with numpy's astype round-to-nearest-even — bit-for-bit the rounding
+    staging's cast_obs_to_compute_dtype (and the native packer's fused
+    convert) applies to f32 wire frames, so the TrainBatch built from a
+    frame cast here is provably identical to one cast downstream. Masks
+    and every non-obs leaf keep their types; already-bf16 leaves pass
+    through (idempotent)."""
+    dt = _bf16_dtype()
+    # Same untrusted-float story as the staging cast: NaN/inf propagate,
+    # out-of-range saturates — never a per-publish RuntimeWarning.
+    with np.errstate(invalid="ignore", over="ignore"):
+        obs = r.obs._replace(
+            **{
+                f: v.astype(dt)
+                for f, v in r.obs._asdict().items()
+                if getattr(v, "dtype", None) == np.float32
+            }
+        )
+    return r._replace(obs=obs)
+
+
+def wire_cast_fn(obs_dtype: str):
+    """The publish-side cast for a --wire.obs_dtype value: identity for
+    "f32" (byte-identical legacy frames), cast_rollout_obs_bf16 for
+    "bf16". The ONE place config values map to wire behavior — actors,
+    self-play, and benches all resolve through here."""
+    if obs_dtype in ("f32", "float32"):
+        return lambda r: r
+    if obs_dtype in ("bf16", "bfloat16"):
+        return cast_rollout_obs_bf16
+    raise ValueError(
+        f"wire.obs_dtype must be 'f32' or 'bf16', got {obs_dtype!r}"
+    )
+
+
+def _obs_arrays(obs: F.Observation, obs_bf16: bool = False) -> List[np.ndarray]:
+    fdt = _bf16_dtype() if obs_bf16 else np.float32
     return [
-        np.ascontiguousarray(obs.global_feats, np.float32),
-        np.ascontiguousarray(obs.hero_feats, np.float32),
-        np.ascontiguousarray(obs.unit_feats, np.float32),
+        np.ascontiguousarray(obs.global_feats, fdt),
+        np.ascontiguousarray(obs.hero_feats, fdt),
+        np.ascontiguousarray(obs.unit_feats, fdt),
         np.ascontiguousarray(obs.unit_mask, np.uint8),
         np.ascontiguousarray(obs.target_mask, np.uint8),
         np.ascontiguousarray(obs.action_mask, np.uint8),
@@ -126,7 +253,18 @@ def serialize_rollout(r: Rollout) -> bytes:
     L = r.length
     H = r.initial_state[0].shape[-1]
     flags = _FLAG_AUX if r.aux is not None else 0
-    if r.traced:
+    obs_bf16 = rollout_obs_bf16(r)
+    if obs_bf16:
+        # Quantized wire: DTR3 carries the trace fields unconditionally
+        # (zeros when untraced) plus the dtype-map — ONE format whether
+        # or not the chunk is trace-stamped.
+        hdr = _HDR2.pack(
+            _ROLLOUT_MAGIC3, r.version, L, H, flags, r.actor_id,
+            r.episode_return, r.trace_id, r.birth_time,
+        )
+        codes = _canonical_codes(flags, _WIRE_BF16)
+        parts = [hdr, struct.pack("<B", len(codes)), codes]
+    elif r.traced:
         parts = [
             _HDR2.pack(
                 _ROLLOUT_MAGIC2, r.version, L, H, flags, r.actor_id,
@@ -137,7 +275,7 @@ def serialize_rollout(r: Rollout) -> bytes:
         # Untraced rollouts stay byte-identical legacy DTR1 — old
         # consumers keep parsing every frame a default-config actor emits.
         parts = [_HDR.pack(_ROLLOUT_MAGIC, r.version, L, H, flags, r.actor_id, r.episode_return)]
-    arrays = _obs_arrays(r.obs)
+    arrays = _obs_arrays(r.obs, obs_bf16)
     arrays += [np.ascontiguousarray(a, np.int32) for a in r.actions]
     arrays += [
         np.ascontiguousarray(r.behavior_logp, np.float32),
@@ -153,13 +291,14 @@ def serialize_rollout(r: Rollout) -> bytes:
     return b"".join(parts)
 
 
-def _expected_layout(L: int, H: int, flags: int):
+def _expected_layout(L: int, H: int, flags: int, obs_bf16: bool = False):
     """(shape, dtype) per array, in serialization order."""
     T1 = L + 1
+    fdt = _bf16_dtype() if obs_bf16 else np.float32
     layout = [
-        ((T1, F.GLOBAL_FEATURES), np.float32),
-        ((T1, F.HERO_FEATURES), np.float32),
-        ((T1, F.MAX_UNITS, F.UNIT_FEATURES), np.float32),
+        ((T1, F.GLOBAL_FEATURES), fdt),
+        ((T1, F.HERO_FEATURES), fdt),
+        ((T1, F.MAX_UNITS, F.UNIT_FEATURES), fdt),
         ((T1, F.MAX_UNITS), np.uint8),
         ((T1, F.MAX_UNITS), np.uint8),
         ((T1, F.N_ACTION_TYPES), np.uint8),
@@ -173,10 +312,11 @@ def _expected_layout(L: int, H: int, flags: int):
 
 
 def peek_rollout_trace(data: bytes) -> Tuple[int, float]:
-    """(trace_id, birth_time) of a DTR2 frame, (0, 0.0) for DTR1 or any
-    frame too short to carry the extension. Constant-time header peek —
-    no array parsing."""
-    if len(data) >= _HDR2.size and data[:4] == _ROLLOUT_MAGIC2:
+    """(trace_id, birth_time) of a DTR2/DTR3 frame, (0, 0.0) for DTR1 or
+    any frame too short to carry the extension. Constant-time header
+    peek — no array parsing. (DTR3 stores the trace fields at the same
+    offsets as DTR2, zeros when untraced.)"""
+    if len(data) >= _HDR2.size and data[:4] in (_ROLLOUT_MAGIC2, _ROLLOUT_MAGIC3):
         trace_id, birth = struct.unpack_from("<Qd", data, _HDR.size)
         return trace_id, birth
     return 0, 0.0
@@ -184,12 +324,15 @@ def peek_rollout_trace(data: bytes) -> Tuple[int, float]:
 
 def strip_rollout_trace(data: bytes) -> bytes:
     """DTR2 frame → the byte-identical DTR1 frame (trace extension
-    removed). DTR1 frames pass through untouched (same object, no copy).
+    removed). DTR1 frames pass through untouched (same object, no copy)
+    — and so do DTR3 frames: their arrays are RE-ENCODED (bf16), not
+    merely suffixed, and the native packer parses DTR3 whole.
 
     This is the staging intake's rolling-upgrade normalization: the
-    native C packer (native/packer.cc) speaks exactly the DTR1 layout,
-    so traced frames are normalized once at ingest — paid only for
-    frames a producer chose to stamp, never on the legacy path."""
+    native C packer (native/packer.cc) speaks the DTR1 and DTR3
+    layouts, so DTR2 traced frames are normalized once at ingest — paid
+    only for frames a producer chose to stamp, never on the legacy
+    path."""
     if len(data) >= _HDR2.size and data[:4] == _ROLLOUT_MAGIC2:
         return _ROLLOUT_MAGIC + data[4:_HDR.size] + data[_HDR2.size:]
     return data
@@ -212,7 +355,20 @@ def stamp_rollout_trace(data: bytes, trace_id: int, birth_time: float) -> bytes:
 
 def deserialize_rollout(data: bytes) -> Rollout:
     trace_id, birth_time = 0, 0.0
-    if len(data) >= _HDR2.size and data[:4] == _ROLLOUT_MAGIC2:
+    obs_bf16 = False
+    if data[:4] == _ROLLOUT_MAGIC3:
+        # check_dtr3_dtype_map also rejects frames truncated inside the
+        # header, so both python and native intakes file ANY short/bad
+        # DTR3 under the same distinct quarantine reason.
+        if check_dtr3_dtype_map(data) is not None:
+            raise WireDtypeError("bad DTR3 dtype-map")
+        magic, version, L, H, flags, actor_id, ep_ret, trace_id, birth_time = (
+            _HDR2.unpack_from(data)
+        )
+        n_map = data[_HDR2.size]
+        obs_bf16 = data[_HDR2.size + 1] == _WIRE_BF16
+        off = _HDR2.size + 1 + n_map
+    elif len(data) >= _HDR2.size and data[:4] == _ROLLOUT_MAGIC2:
         magic, version, L, H, flags, actor_id, ep_ret, trace_id, birth_time = (
             _HDR2.unpack_from(data)
         )
@@ -223,7 +379,7 @@ def deserialize_rollout(data: bytes) -> Rollout:
     else:
         raise ValueError("bad rollout frame")
     arrays = []
-    for shape, dtype in _expected_layout(L, H, flags):
+    for shape, dtype in _expected_layout(L, H, flags, obs_bf16):
         n = int(np.prod(shape)) * np.dtype(dtype).itemsize
         if off + n > len(data):
             raise ValueError("truncated rollout frame")

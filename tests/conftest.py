@@ -71,6 +71,24 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+def clean_subprocess_env(extra=None):
+    """Env dict for subprocesses spawned FROM pytest: strips the
+    pytest-only persistent XLA cache and the 8-virtual-device flag —
+    cache entries are ISA/topology-sensitive native executables, and a
+    child running a different device topology can SIGSEGV at jax import
+    loading them (the PR-7 gotcha; see the cache comment above). The
+    recipe was hand-copied in several test files before this helper;
+    new subprocess tests should call this instead."""
+    env = dict(os.environ)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "").replace(
+        " --xla_force_host_platform_device_count=8", ""
+    )
+    if extra:
+        env.update(extra)
+    return env
+
+
 # --------------------------------------------------------------- lockcheck
 
 import pytest  # noqa: E402

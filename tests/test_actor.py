@@ -73,6 +73,87 @@ def test_actor_episode_publishes_valid_rollouts(env):
         assert deserialize_rollout(f).dones[-1] == 0.0
 
 
+def test_actor_bf16_wire_publishes_dtr3(env):
+    """--wire.obs_dtype bf16: every published chunk is a DTR3 frame with
+    bf16 obs leaves, and it round-trips through the new consumer. Same
+    episode stream otherwise (the cast touches serialization only)."""
+    from dotaclient_tpu.config import WireConfig
+    from dotaclient_tpu.transport.serialize import rollout_obs_bf16
+
+    actor, broker, cfg = make_actor(env, "actor_wire_bf16", wire=WireConfig(obs_dtype="bf16"))
+    run(actor.run_episode())
+    frames = broker.consume_experience(1000, timeout=0.2)
+    assert len(frames) == actor.rollouts_published >= 1
+    for f in frames:
+        assert f[:4] == b"DTR3"
+        r = deserialize_rollout(f)
+        assert rollout_obs_bf16(r)
+        assert r.behavior_logp.dtype == np.float32  # scalars stay f32
+
+
+def test_actor_default_wire_is_identity_and_frames_stay_dtr1(env):
+    """Default --wire.obs_dtype f32: the resolved cast is the IDENTITY
+    (same Rollout object, no copy) and every frame keeps the legacy DTR1
+    magic — old consumers parse everything a default actor emits."""
+    actor, broker, cfg = make_actor(env, "actor_wire_f32")
+    assert cfg.wire.obs_dtype == "f32"
+    from tests.test_transport import make_rollout as _mk
+
+    r = _mk(L=4, H=16)
+    assert actor._wire_cast(r) is r
+    run(actor.run_episode())
+    frames = broker.consume_experience(1000, timeout=0.2)
+    assert frames and all(f[:4] == b"DTR1" for f in frames)
+
+
+def test_actor_bad_wire_dtype_fails_at_boot(env):
+    from dotaclient_tpu.config import WireConfig
+
+    with pytest.raises(ValueError):
+        make_actor(env, "actor_wire_bad", wire=WireConfig(obs_dtype="int8"))
+
+
+def test_default_wire_inert_subprocess():
+    """Subprocess inertness proof (the PR 6/7 pattern): a fresh process
+    resolving the DEFAULT ActorConfig wire cast gets the identity, and
+    the golden rollout serializes to the byte-identical pre-DTR3 DTR1
+    frame — the default wire is provably unchanged by this build."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import hashlib
+import numpy as np
+from dotaclient_tpu.config import ActorConfig
+from dotaclient_tpu.transport.serialize import wire_cast_fn
+from tests.test_transport import (
+    ROLLOUT_DTR1_SHA256, make_golden_rollout,
+)
+from dotaclient_tpu.transport.serialize import serialize_rollout
+cfg = ActorConfig()
+cast = wire_cast_fn(cfg.wire.obs_dtype)
+r = make_golden_rollout()
+assert cast(r) is r, "default wire cast must be the identity"
+data = serialize_rollout(cast(r))
+assert data[:4] == b"DTR1"
+assert hashlib.sha256(data).hexdigest() == ROLLOUT_DTR1_SHA256, "wire bytes changed"
+print("INERT_OK")
+"""
+    from tests.conftest import clean_subprocess_env
+
+    env_vars = clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"})
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env_vars,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0 and "INERT_OK" in proc.stdout, proc.stderr[-2000:]
+
+
 def test_actor_hot_swaps_weights(env):
     actor, broker, cfg = make_actor(env, "actor_t2")
     new_params = init_params(cfg.policy, jax.random.PRNGKey(99))

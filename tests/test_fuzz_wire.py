@@ -25,6 +25,7 @@ from hypothesis import HealthCheck, given, settings, strategies as st
 
 from dotaclient_tpu import native
 from dotaclient_tpu.transport.serialize import (
+    cast_rollout_obs_bf16,
     deserialize_rollout,
     deserialize_weights,
     serialize_rollout,
@@ -39,6 +40,10 @@ FUZZ = settings(
 )
 
 _BASE = serialize_rollout(make_rollout(L=5, H=8, aux=True, seed=3))
+# DTR3 quantized-wire twin: same rollout, obs cast bf16 at the source.
+# WireDtypeError is a ValueError subclass, so the except clauses below
+# cover the dtype-map rejection path without naming it.
+_BASE3 = serialize_rollout(cast_rollout_obs_bf16(make_rollout(L=5, H=8, aux=True, seed=3)))
 _BASE_W = serialize_weights([("a", np.arange(6, dtype=np.float32).reshape(2, 3))], 7, 2)
 
 
@@ -66,6 +71,27 @@ def test_rollout_mutations_fail_clean_or_decode(cut, flip_at, flip_bit):
     try:
         r = deserialize_rollout(bytes(mutated))
         # decoded: basic invariants must hold (shapes derive from header)
+        assert r.obs.global_feats.shape[0] == r.length + 1
+    except (ValueError, KeyError):
+        pass
+
+
+@given(
+    cut=st.integers(min_value=0, max_value=len(_BASE3)),
+    flip_at=st.integers(min_value=0, max_value=len(_BASE3) - 1),
+    flip_bit=st.integers(min_value=0, max_value=7),
+)
+@FUZZ
+def test_dtr3_mutations_fail_clean_or_decode(cut, flip_at, flip_bit):
+    """DTR3 truncations and bit flips — the 54-byte header+dtype-map
+    region forges magic/L/H/flags AND dtype codes: ValueError (incl.
+    WireDtypeError for map corruption) or a clean decode, never a
+    crash."""
+    mutated = bytearray(_BASE3[:cut]) if cut < len(_BASE3) else bytearray(_BASE3)
+    if flip_at < len(mutated):
+        mutated[flip_at] ^= 1 << flip_bit
+    try:
+        r = deserialize_rollout(bytes(mutated))
         assert r.obs.global_feats.shape[0] == r.length + 1
     except (ValueError, KeyError):
         pass
@@ -131,6 +157,30 @@ class TestNativeFuzz:
     @FUZZ
     def test_native_random_bytes_rejected(self, data):
         assert native.frame_header(_lib, data) is None or len(data) >= 21
+
+    @given(
+        cut=st.integers(min_value=0, max_value=len(_BASE3)),
+        flip_at=st.integers(min_value=0, max_value=57),  # header + dtype-map region
+        flip_bit=st.integers(min_value=0, max_value=7),
+    )
+    @FUZZ
+    def test_dtr3_header_and_map_forgeries_rejected_or_consistent(self, cut, flip_at, flip_bit):
+        """Bit flips across the DTR3 header AND dtype-map: parse_header
+        must reject any forgery whose map or derived size disagrees, and
+        dt_pack_batch must error cleanly, never fault or misread the
+        bf16 arrays at a wrong width."""
+        mutated = bytearray(_BASE3[:cut]) if cut < len(_BASE3) else bytearray(_BASE3)
+        if flip_at < len(mutated):
+            mutated[flip_at] ^= 1 << flip_bit
+        frame = bytes(mutated)
+        hdr = native.frame_header(_lib, frame)
+        if hdr is not None:
+            version, L, H, flags, actor_id, ep_ret, last_done = hdr
+            try:
+                native.pack_frames(_lib, [frame], seq_len=max(L, 1), lstm_hidden=H,
+                                   with_aux=bool(flags & 1))
+            except ValueError:
+                pass
 
 
 # ---------------------------------------------------------------------------

@@ -242,6 +242,32 @@ def test_chaos_pinned_off_in_all_prod_manifests():
     assert checked >= 4  # learner, learner-multihost, actors, evaluator
 
 
+def test_wire_obs_dtype_pinned_f32_on_actors():
+    """The quantized-wire flag ships EXPLICITLY pinned to the
+    byte-identical f32 default on the actor fleet (the chaos-flag
+    precedent): prod stays on the legacy wire until the bf16 soak signs
+    off, and a copy-pasted bench flag can't flip the fleet early. The
+    broker is wire-agnostic by design — it must NOT grow the flag
+    (opaque bytes; no restart in the consumers-first upgrade)."""
+    actor_containers = [
+        (fname, c)
+        for fname, c in _our_containers()
+        if c.get("command") and c["command"][2] == "dotaclient_tpu.runtime.actor"
+    ]
+    assert actor_containers
+    for fname, c in actor_containers:
+        args = c.get("args", [])
+        assert "--wire.obs_dtype" in args, f"{fname}: wire.obs_dtype not pinned"
+        assert args[args.index("--wire.obs_dtype") + 1] == "f32", (
+            f"{fname}: wire.obs_dtype must stay f32 until the bf16 soak"
+        )
+    for fname, c in _our_containers():
+        if c.get("command") and c["command"][2] == "dotaclient_tpu.transport.tcp_server":
+            assert "--wire.obs_dtype" not in c.get("args", []), (
+                f"{fname}: the broker is wire-format agnostic; no wire flag"
+            )
+
+
 def test_actor_fleet_scale_and_kill_switch():
     (_, doc), = [(f, d) for f, d in DOCS if d["metadata"]["name"] == "actors"]
     assert doc["spec"]["replicas"] >= 2

@@ -232,6 +232,108 @@ def test_staging_native_bf16_path_matches_python_fallback():
     np.testing.assert_array_equal(nat.mask, py.mask)
 
 
+# --- DTR3 quantized wire (ISSUE 8): the cast-free native pack path -----
+
+
+def test_dtr3_pack_bitwise_matches_f32_wire_convert():
+    """THE tentpole parity proof at the C level: packing bf16-wire
+    (DTR3) frames into the bf16 batch — a strided memcpy — must be
+    BITWISE identical to packing the same rollouts' f32 frames through
+    the in-copy convert, NaN canonicalization and RNE ties included
+    (the source cast and the pack-time cast are the same function)."""
+    from dotaclient_tpu.transport.serialize import cast_rollout_obs_bf16
+
+    rollouts = [make_rollout(L=L, H=8, version=i, seed=i, aux=(i == 0)) for i, L in enumerate([4, 8, 3])]
+    specials = np.array([np.nan, np.inf, -np.inf, -0.0, 1.0 + 2 ** -8, 1e-40], np.float32)
+    payload_nans = np.array([0x7FA00000, 0xFFA00001], np.uint32).view(np.float32)
+    rollouts[0].obs.global_feats.flat[:8] = np.concatenate([specials, payload_nans])
+    f32 = [serialize_rollout(r) for r in rollouts]
+    bf = [serialize_rollout(cast_rollout_obs_bf16(r)) for r in rollouts]
+    a = native.pack_frames(lib, f32, seq_len=8, lstm_hidden=8, with_aux=True, obs_bf16=True)
+    b = native.pack_frames(lib, bf, seq_len=8, lstm_hidden=8, with_aux=True, obs_bf16=True)
+    import ml_dtypes
+
+    assert b.obs.global_feats.dtype == ml_dtypes.bfloat16
+    # obs leaves BITWISE via u16 views (value-compare would choke on the
+    # NaNs we salted in — and bit equality is the actual claim)
+    for field in ("global_feats", "hero_feats", "unit_feats"):
+        np.testing.assert_array_equal(
+            getattr(a.obs, field).view(np.uint16), getattr(b.obs, field).view(np.uint16)
+        )
+
+    def sans_float_obs(batch):
+        return batch._replace(
+            obs=batch.obs._replace(global_feats=0, hero_feats=0, unit_feats=0)
+        )
+
+    leaves_equal(sans_float_obs(a), sans_float_obs(b))
+
+
+def test_dtr3_pack_into_f32_batch_upcasts_exactly():
+    """bf16 wire consumed by an f32-batch config (obs_bf16=0): the C
+    widening must equal numpy's exact bf16->f32 upcast — a mixed fleet
+    mid-roll must not corrupt an f32-compute learner."""
+    from dotaclient_tpu.transport.serialize import cast_rollout_obs_bf16
+
+    r = make_rollout(L=4, H=8, seed=5)
+    rb = cast_rollout_obs_bf16(r)
+    nat = native.pack_frames(
+        lib, [serialize_rollout(rb)], seq_len=8, lstm_hidden=8, with_aux=False, obs_bf16=False
+    )
+    assert nat.obs.global_feats.dtype == np.float32
+    np.testing.assert_array_equal(
+        nat.obs.global_feats[0, :5], np.asarray(rb.obs.global_feats).astype(np.float32)
+    )
+
+
+def test_dtr3_grouped_pack_bitwise_matches_dense():
+    """DTR3 frames through the fused-H2D strided views (row_strides
+    path) — the production landing zone — must match the dense pack."""
+    import jax
+
+    from dotaclient_tpu.parallel.fused_io import FusedBatchIO
+    from dotaclient_tpu.parallel import mesh as mesh_lib
+    from dotaclient_tpu.transport.serialize import cast_rollout_obs_bf16
+
+    policy = PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="bfloat16")
+    cfg = LearnerConfig(batch_size=4, seq_len=8, policy=policy)
+    rollouts = [make_rollout(L=3 + i, H=8, seed=i, actor_id=i) for i in range(4)]
+    frames = [serialize_rollout(cast_rollout_obs_bf16(r)) for r in rollouts]
+    dense = native.pack_frames(lib, frames, seq_len=8, lstm_hidden=8, with_aux=False, obs_bf16=True)
+    from dotaclient_tpu.parallel.train_step import _batch_template
+    from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
+
+    template = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, _batch_template(cfg)))
+    io = FusedBatchIO(template, mesh_lib.make_mesh("dp=-1"))
+    groups, out = io.alloc_views()
+    native.pack_frames(lib, frames, seq_len=8, lstm_hidden=8, with_aux=False, obs_bf16=True, out=out)
+    leaves_equal(dense, out)
+
+
+def test_dtr3_malformed_maps_rejected_cleanly():
+    """Corrupt/truncated dtype-maps: error code (frame index named),
+    never a fault — and the accept set matches the python parser."""
+    from dotaclient_tpu.transport.serialize import (
+        WireDtypeError,
+        cast_rollout_obs_bf16,
+        deserialize_rollout,
+    )
+
+    good = serialize_rollout(cast_rollout_obs_bf16(make_rollout(L=4, H=8, seed=0)))
+    mutants = {
+        "bad_code": bytes(good[:38]) + b"\x07" + bytes(good[39:]),
+        "mixed_obs": bytes(good[:39]) + b"\x00" + bytes(good[40:]),  # codes[1] f32
+        "bad_count": bytes(good[:37]) + b"\x05" + bytes(good[38:]),
+        "truncated_map": good[:40],
+    }
+    for name, m in mutants.items():
+        assert native.frame_header(lib, m) is None, name
+        with pytest.raises((ValueError, WireDtypeError)):
+            deserialize_rollout(m)
+        with pytest.raises(ValueError):
+            native.pack_frames(lib, [m], seq_len=8, lstm_hidden=8, with_aux=False, obs_bf16=True)
+
+
 def test_isa_fingerprint_invalidates_foreign_so(tmp_path, monkeypatch):
     """A cached -march=native .so from a DIFFERENT host must be rebuilt,
     not loaded (mtime alone would reuse it and risk SIGILL mid-pack)."""

@@ -467,6 +467,33 @@ def test_actor_fleet_scalars_are_registered():
     }
 
 
+def test_wire_scalars_are_registered_and_emitted_names_pinned():
+    """The wire_* family (DTR3 quantized-wire meters): the learner
+    emits exactly these names from staging's wire_ stats — pin them
+    against the registry so a rename must touch obs/registry.py (the
+    closed-loop drift guard above re-proves emission end-to-end)."""
+    from dotaclient_tpu.obs import registry
+
+    names = [
+        "wire_bytes_consumed_total",
+        "wire_frames_obs_bf16_total",
+        "wire_frames_obs_f32_total",
+    ]
+    missing = registry.unregistered(names)
+    assert not missing, f"wire scalars not in obs/registry.py: {missing}"
+    assert not registry.is_registered("wire_bogus_scalar")
+    # the staging stats keys these are derived from must exist
+    from dotaclient_tpu.config import LearnerConfig
+    from dotaclient_tpu.runtime.staging import StagingBuffer
+    from dotaclient_tpu.transport.base import connect
+    from dotaclient_tpu.transport import memory as mem
+
+    mem.reset("wire_pins")
+    sb = StagingBuffer(LearnerConfig(batch_size=2, seq_len=8), connect("mem://wire_pins"))
+    stats = sb.stats()
+    assert {"wire_bytes", "wire_frames_obs_bf16", "wire_frames_obs_f32"} <= set(stats)
+
+
 def test_chaos_and_shed_scalars_are_registered():
     """Chaos-era names (ISSUE 6): the staging quarantine scalar, the
     broker_shed_* publish-degradation family (ShedThrottle.stats /

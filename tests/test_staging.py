@@ -87,6 +87,114 @@ def test_staging_drops_garbage_frames():
         buf.stop()
 
 
+@pytest.mark.parametrize("native_on", [True, False])
+def test_staging_dtr3_corrupt_dtype_map_quarantined_distinctly(native_on):
+    """ISSUE 8 satellite: a truncated/corrupt DTR3 dtype-map must
+    dead-letter under its own 'dtype_map' reason — on the native intake
+    (python pre-check before the C parse) AND the python fallback — and
+    never crash the consumer; good frames keep flowing."""
+    from dotaclient_tpu.transport.serialize import cast_rollout_obs_bf16
+
+    name = f"stage_dtr3q_{native_on}"
+    mem.reset(name)
+    broker = connect(f"mem://{name}")
+    buf = StagingBuffer(CFG, connect(f"mem://{name}"))
+    if not native_on:
+        buf._lib = None
+    buf.start()
+    try:
+        good = serialize_rollout(cast_rollout_obs_bf16(make_rollout(L=4, H=8, version=0, seed=9)))
+        corrupt = bytes(good[:38]) + b"\x07" + bytes(good[39:])  # bad obs code
+        truncated = good[:40]  # cut inside the dtype-map
+        broker.publish_experience(corrupt)
+        broker.publish_experience(truncated)
+        for i in range(4):
+            broker.publish_experience(
+                serialize_rollout(cast_rollout_obs_bf16(make_rollout(L=3, H=8, version=0, seed=i)))
+            )
+        batch = buf.get_batch(timeout=10)
+        assert batch is not None
+        stats = buf.stats()
+        assert stats["dropped_bad"] == 2 and stats["quarantined"] == 2
+        assert stats["consumer_errors"] == 0
+        reasons = [e["reason"] for e in buf.quarantine()]
+        assert reasons == ["dtype_map", "dtype_map"]
+        # evidence is the ORIGINAL corrupt bytes, not the emptied slot
+        assert buf.quarantine()[0]["bytes"] == len(corrupt)
+        assert buf.quarantine()[0]["head"].startswith(b"DTR3".hex())
+    finally:
+        buf.stop()
+
+
+@pytest.mark.parametrize("native_on", [True, False])
+def test_staging_wire_meters_split_by_obs_dtype(native_on):
+    """wire_bytes / wire_frames_obs_{bf16,f32} count consumed bytes and
+    the per-frame wire dtype — the rolling-upgrade progress gauge."""
+    from dotaclient_tpu.transport.serialize import cast_rollout_obs_bf16
+
+    name = f"stage_wirem_{native_on}"
+    mem.reset(name)
+    broker = connect(f"mem://{name}")
+    buf = StagingBuffer(CFG, connect(f"mem://{name}"))
+    if not native_on:
+        buf._lib = None
+    buf.start()
+    try:
+        frames = []
+        for i in range(2):
+            frames.append(serialize_rollout(make_rollout(L=3, H=8, version=0, seed=i)))
+        for i in range(2):
+            frames.append(
+                serialize_rollout(cast_rollout_obs_bf16(make_rollout(L=3, H=8, version=0, seed=10 + i)))
+            )
+        for f in frames:
+            broker.publish_experience(f)
+        batch = buf.get_batch(timeout=10)
+        assert batch is not None
+        stats = buf.stats()
+        assert stats["wire_bytes"] == sum(len(f) for f in frames)
+        assert stats["wire_frames_obs_f32"] == 2
+        assert stats["wire_frames_obs_bf16"] == 2
+    finally:
+        buf.stop()
+
+
+def test_staging_dtr3_bf16_wire_batch_bitwise_equals_f32_wire():
+    """Cast-at-actor vs cast-at-staging through the python packer at
+    this file's small config: bitwise-equal TrainBatch (the native-path
+    twin lives in test_native.py; the full-shape A/B in
+    WIRE_QUANT_AB.json)."""
+    from dotaclient_tpu.transport.serialize import cast_rollout_obs_bf16
+
+    rollouts = [make_rollout(L=4, H=8, version=0, seed=i) for i in range(CFG.batch_size)]
+    batches = {}
+    for tag, frames in (
+        ("f32", [serialize_rollout(r) for r in rollouts]),
+        ("bf16", [serialize_rollout(cast_rollout_obs_bf16(r)) for r in rollouts]),
+    ):
+        name = f"stage_par_{tag}"
+        mem.reset(name)
+        broker = connect(f"mem://{name}")
+        cfg = LearnerConfig(
+            batch_size=CFG.batch_size, seq_len=CFG.seq_len,
+            policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype="bfloat16"),
+        )
+        buf = StagingBuffer(cfg, connect(f"mem://{name}"))
+        buf._lib = None  # python packer
+        buf.start()
+        try:
+            for f in frames:
+                broker.publish_experience(f)
+            batches[tag] = buf.get_batch(timeout=10)
+            assert batches[tag] is not None
+        finally:
+            buf.stop()
+    import jax
+
+    for a, b in zip(jax.tree.leaves(batches["f32"]), jax.tree.leaves(batches["bf16"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_staging_double_buffer_bounded():
     mem.reset("stage3")
     broker = connect("mem://stage3")
