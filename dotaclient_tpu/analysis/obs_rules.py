@@ -82,6 +82,7 @@ _BINARY_CONFIGS = {
     "dotaclient_tpu.runtime.actor": "ActorConfig",
     "dotaclient_tpu.runtime.selfplay": "ActorConfig",
     "dotaclient_tpu.eval.evaluator": "EvalConfig",
+    "dotaclient_tpu.serve.server": "InferenceConfig",
     "dotaclient_tpu.transport.tcp_server": "argparse:transport/tcp_server.py",
 }
 

@@ -283,16 +283,29 @@ class ScheduleRunner:
         broker: Optional[BrokerIncarnations],
         t0: float,
         learner: Optional[LearnerIncarnations] = None,
+        server: Optional[object] = None,
     ):
         self.schedule = schedule
         self.broker = broker
         self.learner_inc = learner
+        # Routing STUB for kill@T:D@server (the inference service): any
+        # object with kill()/restart() routes; the real ServeIncarnations
+        # controller (in-process InferenceServer lives + carry-loss
+        # recovery probes) belongs to the serve chaos soak, out of scope
+        # this build (chaos/schedule.py grammar note).
+        self.server_inc = server
         self.t0 = t0
         for ev in schedule.kills():
             if ev.target == "learner" and learner is None:
                 raise ValueError("schedule kills the learner but no LearnerIncarnations given")
             if ev.target == "broker" and broker is None:
                 raise ValueError("schedule kills the broker but no BrokerIncarnations given")
+            if ev.target == "server" and server is None:
+                raise ValueError(
+                    "schedule kills the inference server but no server "
+                    "controller given (kill@..@server is a routing stub: "
+                    "supply an object with kill()/restart())"
+                )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # (kill_index, restart_monotonic, first_enqueue_monotonic | None)
@@ -331,6 +344,25 @@ class ScheduleRunner:
                         "at_s": ev.at_s,
                         "down_s": round(ev.duration_s, 3),
                         "recovery_s": None if first is None else round(first - restarted, 3),
+                    }
+                )
+                continue
+            if ev.target == "server":
+                # Routing stub (see __init__): kill/restart the supplied
+                # controller; no recovery probe is defined yet — the
+                # serve soak will add one (first-post-restart tick, the
+                # first_enqueue_t analog).
+                self.server_inc.kill()
+                if not self._sleep_until(ev.at_s + ev.duration_s):
+                    return
+                self.server_inc.restart()
+                self.recovery.append(
+                    {
+                        "kill_index": k,
+                        "target": "server",
+                        "at_s": ev.at_s,
+                        "down_s": round(ev.duration_s, 3),
+                        "recovery_s": None,
                     }
                 )
                 continue

@@ -23,16 +23,24 @@ Timed one-shots (wall-clock offsets from the schedule epoch `t0`):
                     the broker process (chaos/controller.py), because a
                     client-side wrapper cannot kill a server
     kill@T:D@TGT    kill-target selector: TGT is `broker` (the default,
-                    identical to the bare form) or `learner[:SIG]`
+                    identical to the bare form), `learner[:SIG]`
                     where SIG is `kill` (SIGKILL semantics: nothing
                     saved, recovery from the last periodic checkpoint)
                     or `term` (SIGTERM drain: train out staged batches,
                     full-state save, clean exit) — executed against a
-                    LearnerIncarnations controller. Timed events never
-                    consume per-op rate draws, so the selector leaves
-                    the canonical draw order of every existing spec
-                    untouched (pinned by the golden decision-sequence
-                    test in tests/test_chaos.py).
+                    LearnerIncarnations controller — or `server` (the
+                    inference service, dotaclient_tpu/serve/): the
+                    GRAMMAR and ScheduleRunner routing exist today, but
+                    only a routing stub backs them — a ServeIncarnations
+                    controller (sequential in-process InferenceServer
+                    lives + carry-loss/recovery probes) is the serve
+                    chaos soak's job, not this build's; a spec with a
+                    server kill therefore requires the caller to supply
+                    a controller with kill()/restart(). Timed events
+                    never consume per-op rate draws, so the selector
+                    leaves the canonical draw order of every existing
+                    spec untouched (pinned by the golden
+                    decision-sequence test in tests/test_chaos.py).
 
 Determinism contract: the decision for operation index i draws from
 `random.Random(seed * 1_000_003 + i)` in a FIXED canonical order, for
@@ -105,7 +113,7 @@ class FaultSchedule:
                             f"in {clause!r}"
                         )
                     target, _, sig_s = tail.partition(":")
-                    if target not in ("broker", "learner"):
+                    if target not in ("broker", "learner", "server"):
                         raise ValueError(f"unknown kill target {target!r} in {clause!r}")
                     if sig_s:
                         if target != "learner":

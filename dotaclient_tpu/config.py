@@ -165,6 +165,49 @@ class WireConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Centralized inference service — SERVER-side knobs (the
+    `python -m dotaclient_tpu.serve.server` binary; dotaclient_tpu/serve/).
+    The server owns one param tree, holds per-client LSTM carries
+    resident, and runs continuous batching over a bounded gather window
+    (the PR-5 InferenceBatcher semantics: fire at capacity or
+    gather_window_s after the tick's first request, pad partial ticks to
+    ONE jit signature, drop pad rows)."""
+
+    # TCP port the inference service listens on (0 = pick a free port,
+    # bench/test use; the k8s Service pins 13380).
+    port: int = 13380
+    # Batch capacity of one inference tick — the jit signature's row
+    # count. Size to the expected concurrent in-flight requests (the
+    # fan-in env count); partial ticks pad up to this, so oversizing
+    # costs pad-row FLOPs, undersizing costs extra ticks.
+    max_batch: int = 16
+    # Bounded gather window, seconds: a tick fires at capacity or this
+    # long after its FIRST request — one slow client stalls only itself.
+    gather_window_s: float = 0.005
+    # Cadence of the weight-fanout poll (the server subscribes to the
+    # same broker weight fanout actors use; WeightPublisher's
+    # on_published hook can poke the poll awake for same-tick swaps).
+    weight_poll_s: float = 0.5
+
+
+@dataclass
+class ServeClientConfig:
+    """Centralized inference service — ACTOR-side opt-in
+    (dotaclient_tpu/serve/client.py). Default OFF: with endpoint empty
+    the actor's inference hot path is byte-identical to the local jit
+    path (the serve package is never imported — subprocess inertness
+    proof in tests/test_serve.py)."""
+
+    # host:port of the inference service. "" (default) = local
+    # inference, exactly the pre-serve actor.
+    endpoint: str = ""
+    # Per-request reply timeout, seconds: a server that dies without RST
+    # must surface as a retryable RemoteInferenceError, not a hung env.
+    timeout_s: float = 30.0
+
+
+@dataclass
 class RetryConfig:
     """Broker-client retry policy (transport/base.py RetryPolicy): one
     policy shared by the tcp transport's reconnect loop and the actor's
@@ -489,11 +532,39 @@ class ActorConfig:
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     # Experience-wire quantization (--wire.obs_dtype {f32,bf16}).
     wire: WireConfig = field(default_factory=WireConfig)
+    # Centralized inference service opt-in (--serve.endpoint host:port):
+    # ship featurized obs to a dedicated batching server instead of
+    # running the policy locally. Default off = the local jit path,
+    # byte-identical to the pre-serve build.
+    serve: ServeClientConfig = field(default_factory=ServeClientConfig)
     seed: int = 0
     actor_id: int = 0
     # Actors are CPU processes (reference architecture: the accelerator
     # belongs to the learner). "cpu" also defeats environments that
     # force-register an accelerator backend for every python process.
+    platform: str = "cpu"
+
+
+@dataclass
+class InferenceConfig:
+    """Inference-service binary (dotaclient_tpu/serve/server.py): owns
+    one param tree (init'd from --seed like an actor, hot-swapped from
+    the broker weight fanout), serves batched policy steps to remote
+    actors, and exports serve_* scalars on the obs scrape surface."""
+
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    policy: PolicyConfig = field(default_factory=PolicyConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    # Weight-fanout source (same URL the actors use). The service is a
+    # weights SUBSCRIBER only — experience never flows through it.
+    broker_url: str = "mem://"
+    # Param-init seed: must match the learner fleet's seed so the
+    # service can serve from step zero (the actor-boot convention).
+    seed: int = 0
+    # "cpu" pins the service to host devices; "" = default backend
+    # (a GPU/TPU inference pod serves large-batch forward passes).
     platform: str = "cpu"
 
 

@@ -106,11 +106,28 @@ SCALARS: Dict[str, str] = {
     # --- vector actor fleet (runtime/actor.py InferenceBatcher) --------
     # Emitted by InferenceBatcher.stats() / VectorActor.stats():
     # bench_actors.py commits them into ACTOR_FLEET.json, and a
-    # metrics-serving actor exports them as scrape gauges.
+    # metrics-serving actor exports them as scrape gauges. The inference
+    # service (dotaclient_tpu/serve/) runs the SAME batcher and exports
+    # the same family on its own /metrics — deliberately shared names,
+    # so fleet and serve dashboards read one distribution.
     "actor_offered_steps_per_sec": "real env steps offered by this process per second",
     "actor_batch_occupancy": "mean real-rows / capacity of the batched inference tick",
     "actor_gather_wait_s": "mean per-tick wait assembling the batch (bounded by --gather_window_s)",
     "actor_jit_step_s": "mean per-tick batched jit inference latency (incl. the one device_get)",
+    # --- inference service (dotaclient_tpu/serve/server.py) ------------
+    "serve_requests_total": "policy-step requests handled (cumulative, all connections)",
+    "serve_unknown_client_total": (
+        "steps naming a client_key with no resident carry and no "
+        "episode-start flag (server restarted/evicted; the client "
+        "abandons the episode)"
+    ),
+    "serve_bad_requests_total": "malformed step requests refused",
+    "serve_episode_resets_total": "carry resets on EPISODE_START flags (cumulative)",
+    "serve_evictions_total": "carries evicted on client disconnect (cumulative)",
+    "serve_weight_swaps_total": "param-tree hot-swaps applied between ticks (cumulative)",
+    "serve_version": "model version of the currently-serving param tree",
+    "serve_clients_connected": "live client connections",
+    "serve_carries_resident": "LSTM carries held server-side across all connections",
     # --- full-state checkpointing (runtime/checkpoint.py aux manifests,
     #     runtime/learner.py CheckpointWorker) — emitted only when
     #     --ckpt.full_state / --ckpt.async_save are on -----------------
@@ -157,6 +174,14 @@ PREFIXES: Dict[str, str] = {
     # obs gauges exported only on the scrape surface (not JSONL):
     # obs_broker_experience_depth, obs_staging_*, ...
     "obs_": "live scrape-surface gauges (obs/__init__.py sources)",
+    # rows-per-fired-tick occupancy histogram (InferenceBatcher):
+    # actor_tick_rows_<k> = cumulative ticks whose batch carried exactly
+    # k real rows, k in 1..capacity (k=0 cannot fire — a tick starts
+    # from its first request). The capacity-dependent tail is why this
+    # is a family, not exact names; the mean lives in
+    # actor_batch_occupancy. Exported by vector actors AND the
+    # inference service (same batcher, same distribution semantics).
+    "actor_tick_rows_": "rows-per-fired-tick occupancy histogram (runtime/actor.py InferenceBatcher)",
     # broker admission control + actor publish degradation:
     # broker_shed_observed_total, broker_shed_publish_failed_total,
     # broker_shed_throttle_s (runtime/actor.py ShedThrottle /

@@ -415,6 +415,13 @@ class _Chunk:
 class Actor:
     """One self-play actor process (player_id 0 on team radiant)."""
 
+    # Episode failures the run loop retries with backoff instead of
+    # dying: env RPC outages for the local paths; the serve tier's
+    # RemoteActor extends this with its RemoteInferenceError (a lost
+    # server carry abandons the episode exactly like a lost env
+    # session). Class attr so subclasses extend without forking run().
+    _RETRYABLE_EPISODE_ERRORS: tuple = (grpc.aio.AioRpcError,)
+
     def __init__(
         self,
         cfg: ActorConfig,
@@ -521,13 +528,22 @@ class Actor:
             obs.action_mask[F.ACT_CAST] = False
         return obs, handles
 
-    async def _policy_step(self, state, obs: F.Observation):
+    async def _policy_step(
+        self, state, obs: F.Observation, chunk_len: int = 0, episode_start: bool = False
+    ):
         """ONE policy inference for the current (unbatched) obs →
         (state', action, logp, value), each with the [1, ...] batch axis
         the chunk format stores. The base actor dispatches its own B=1
         jit call and advances its own rng carry; the vector fleet's env
-        workers override this to await the shared InferenceBatcher —
-        run_episode is otherwise identical in both modes."""
+        workers override this to await the shared InferenceBatcher, and
+        the serve tier's RemoteActor routes it over the wire —
+        run_episode is otherwise identical in all modes.
+
+        `chunk_len`/`episode_start` describe the loop position (steps
+        already in the current chunk; first step of the episode). The
+        local paths ignore them; the remote path needs them to drive the
+        server-resident carry protocol (reset on episode start, carry
+        return at chunk-fill steps) without forking run_episode."""
         obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
         state, action, logp, value, self.rng = self.step_fn(self.params, state, obs_b, self.rng)
         return state, action, logp, value
@@ -562,8 +578,12 @@ class Actor:
         # each worldstate is featurized exactly once; the pair rolls forward
         obs, handles = self._featurize(world)
 
+        episode_start = True
         while not done:
-            state, action, logp, value = await self._policy_step(state, obs)
+            state, action, logp, value = await self._policy_step(
+                state, obs, chunk_len=len(chunk), episode_start=episode_start
+            )
+            episode_start = False
 
             hero = F.find_hero(world, self.player_id)
             if hero is not None:
@@ -641,11 +661,12 @@ class Actor:
             try:
                 ret = await self.run_episode()
                 backoff = 1.0
-            except grpc.aio.AioRpcError as e:
+            except self._RETRYABLE_EPISODE_ERRORS as e:
                 _log.warning(
-                    "actor %d: env rpc failed (%s); retrying in %.1fs",
+                    "actor %d: episode failed (%s: %s); retrying in %.1fs",
                     self.actor_id,
-                    e.code(),
+                    type(e).__name__,
+                    e.code() if isinstance(e, grpc.aio.AioRpcError) else e,
                     backoff,
                 )
                 await reset_env_stub(self)  # drop the dead subchannel
@@ -704,6 +725,14 @@ class InferenceBatcher:
         # Meters (driver-coroutine-written; stats() snapshots).
         self._ticks = 0
         self._rows = 0
+        # Rows-per-fired-tick occupancy HISTOGRAM (index k = ticks that
+        # carried exactly k real rows; k=0 never fires — a tick starts
+        # from its first request). The mean alone hid the distribution:
+        # a 0.5 mean could be "every tick half full" (window too short)
+        # or "alternating full/single" (bursty arrivals) — different
+        # tuning moves. The serve tier exports the same family, so the
+        # serve bench and the PR-5 fleet report comparable shapes.
+        self._tick_rows = [0] * (capacity + 1)
         self._gather_wait_s = 0.0
         self._jit_s = 0.0
         self._first_tick_t: Optional[float] = None
@@ -766,6 +795,20 @@ class InferenceBatcher:
                     fut.set_exception(exc)
             self._fail_pending(exc)
 
+    def _tick_bundle(self):
+        """One ATOMIC read of everything a tick steps with. The base
+        batcher only needs the param tree; the serve tier's subclass
+        returns (params, version, tick_id) so every row of a tick is
+        provably served by one tree — the no-mixed-batch-tick hot-swap
+        invariant rides on this being a single read per tick."""
+        return (self._params_fn(),)
+
+    def _row_result(self, out, i: int, bundle):
+        """Per-row future payload: the base contract is the bare row
+        tree (state', action, logp, value, rng'); the serve subclass
+        attaches the tick's (version, tick_id) from the bundle."""
+        return jax.tree.map(lambda x: x[i], out)
+
     def _run_tick(self, reqs, gather_wait: float) -> None:
         K = len(reqs)
         M = self.capacity
@@ -779,17 +822,19 @@ class InferenceBatcher:
         obs_b = jax.tree.map(lambda *xs: np.stack(xs)[:, None], *obs_rows)
         state_b = jax.tree.map(lambda *xs: np.stack(xs), *states)
         rng_b = np.stack([np.asarray(r) for r in rngs])
+        bundle = self._tick_bundle()
         t1 = time.monotonic()
-        out = self._step(self._params_fn(), state_b, obs_b, rng_b)
+        out = self._step(bundle[0], state_b, obs_b, rng_b)
         # ONE transfer for the whole tick; per-env slices are then cheap
         # numpy views (the env loop re-device_gets them as no-ops).
         out = jax.device_get(out)
         t2 = time.monotonic()
         for i, (_, _, _, fut) in enumerate(reqs):
             if not fut.cancelled():
-                fut.set_result(jax.tree.map(lambda x: x[i], out))
+                fut.set_result(self._row_result(out, i, bundle))
         self._ticks += 1
         self._rows += K
+        self._tick_rows[K] += 1
         self._gather_wait_s += gather_wait
         self._jit_s += t2 - t1
         if self._first_tick_t is None:
@@ -801,6 +846,7 @@ class InferenceBatcher:
         from the measured window). Driver-loop-thread only."""
         self._ticks = 0
         self._rows = 0
+        self._tick_rows = [0] * (self.capacity + 1)
         self._gather_wait_s = 0.0
         self._jit_s = 0.0
         self._first_tick_t = None
@@ -823,12 +869,21 @@ class InferenceBatcher:
         ticks, rows = self._ticks, self._rows
         first, last = self._first_tick_t, self._last_tick_t
         elapsed = (last - first) if (first is not None and last is not None and last > first) else 0.0
-        return {
+        out = {
             "actor_offered_steps_per_sec": rows / elapsed if elapsed > 0 else 0.0,
             "actor_batch_occupancy": rows / float(max(ticks, 1) * self.capacity),
             "actor_gather_wait_s": self._gather_wait_s / max(ticks, 1),
             "actor_jit_step_s": self._jit_s / max(ticks, 1),
         }
+        # Occupancy histogram (actor_tick_rows_<k> family, registry
+        # PREFIXES): count of fired ticks that carried exactly k real
+        # rows, k in 1..capacity. list(...) = one GIL-atomic snapshot of
+        # the driver-written counters.
+        for k, n in enumerate(list(self._tick_rows)):
+            if k == 0:
+                continue  # a tick fires from its first request; k=0 can't occur
+            out[f"actor_tick_rows_{k}"] = float(n)
+        return out
 
 
 class _BatchedEnvActor(Actor):
@@ -844,7 +899,9 @@ class _BatchedEnvActor(Actor):
     def _make_obs_runtime(self):
         return self.owner.obs
 
-    async def _policy_step(self, state, obs: F.Observation):
+    async def _policy_step(
+        self, state, obs: F.Observation, chunk_len: int = 0, episode_start: bool = False
+    ):
         state, action, logp, value, self.rng = await self.owner.batcher.step(state, obs, self.rng)
         return state, action, logp, value
 
@@ -985,11 +1042,12 @@ class VectorActor:
                 self.check_weight_freshness()
                 ret = await env.run_episode()
                 backoff = 1.0
-            except grpc.aio.AioRpcError as e:
+            except env._RETRYABLE_EPISODE_ERRORS as e:
                 _log.warning(
-                    "vector env %d: env rpc failed (%s); retrying in %.1fs",
+                    "vector env %d: episode failed (%s: %s); retrying in %.1fs",
                     env.actor_id,
-                    e.code(),
+                    type(e).__name__,
+                    e.code() if isinstance(e, grpc.aio.AioRpcError) else e,
                     backoff,
                 )
                 await reset_env_stub(env)  # drop the dead subchannel
@@ -1063,6 +1121,23 @@ def main(argv=None):
 
         broker = wrap_broker(broker, cfg.chaos)
     M = max(int(cfg.envs_per_process), 1)
+    if cfg.serve.endpoint:
+        # Centralized inference service mode (dotaclient_tpu/serve/):
+        # featurized obs ship to the batching server, no local policy
+        # step. Gated IMPORT (the chaos/ckpt precedent): with the
+        # endpoint empty the serve package never loads and the actor hot
+        # path is byte-identical to the local build.
+        if cfg.opponent in ("self", "league"):
+            raise ValueError(
+                "--serve.endpoint does not support self/league actors: their "
+                "sessions step per-session param sets (league snapshots) the "
+                "shared-tree inference service cannot serve"
+            )
+        from dotaclient_tpu.serve.client import RemoteFleet
+
+        fleet = RemoteFleet(cfg, broker, actor_id=cfg.actor_id, envs=M)
+        asyncio.run(fleet.run())
+        return
     if cfg.opponent in ("self", "league"):
         from dotaclient_tpu.runtime.selfplay import SelfPlayActor
 

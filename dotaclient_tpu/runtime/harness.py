@@ -74,6 +74,16 @@ class ActorPool:
         M = self._envs_per_actor
         if M is None:
             M = int(getattr(getattr(actor, "cfg", None), "envs_per_process", 1) or 1)
+        if getattr(actor, "remote_policy", None) is not None:
+            # Serve-tier actor (dotaclient_tpu/serve/client.py): the
+            # SERVER batches, so local VectorActor wrapping would be a
+            # second (pointless) batching layer. RemoteFleet drives M
+            # env slots over the shared connection — and even at M=1 it
+            # supplies the episode-retry loop a bare run_episode worker
+            # lacks (a server blip must not count as a dead actor).
+            from dotaclient_tpu.serve.client import RemoteFleet
+
+            return RemoteFleet.from_actor(actor, envs=max(M, 1))
         if M <= 1:
             return actor
         from dotaclient_tpu.runtime.actor import Actor, VectorActor

@@ -1,0 +1,43 @@
+"""Centralized inference service (ROADMAP open item 1, the SEED-RL /
+Sample Factory split): env-stepping clients ship featurized observations
+over the wire to a dedicated server that owns the param tree and runs
+large-batch jit forward passes — the batch-1 dispatch overhead that
+collapses the thread fleet (ACTOR_FLEET.json: 78→26 offered steps/s from
+1→8 one-env threads) amortizes across every client of the service, and
+param residency moves to ONE process per fleet.
+
+- serve/wire.py    the framed request/response protocol (single-obs
+                   frames on the PR-8 bf16 dtype-code convention);
+- serve/server.py  InferenceServer: continuous batching over a bounded
+                   gather window (the PR-5 InferenceBatcher, extended
+                   with a per-tick (params, version) bundle), per-client
+                   LSTM carry residency, weight hot-swap between ticks,
+                   serve_* scalars on the obs /metrics + /healthz
+                   surface; `python -m dotaclient_tpu.serve.server`;
+- serve/client.py  RemotePolicyClient (multiplexing wire client),
+                   RemoteActor / RemoteFleet (the actor loop with its
+                   `_policy_step` seam routed over the wire).
+
+Import contract (the chaos/ckpt precedent): actors with
+`--serve.endpoint` unset NEVER import this package — the local
+inference hot path is byte-identical to the pre-serve build
+(subprocess inertness proof in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+__all__ = ["InferenceServer", "RemoteActor", "RemoteFleet", "RemotePolicyClient"]
+
+
+def __getattr__(name):
+    # Lazy exports: importing the package (e.g. for a docstring) must
+    # not drag jax/grpc into processes that only wanted the wire module.
+    if name == "InferenceServer":
+        from dotaclient_tpu.serve.server import InferenceServer
+
+        return InferenceServer
+    if name in ("RemoteActor", "RemoteFleet", "RemotePolicyClient"):
+        from dotaclient_tpu.serve import client
+
+        return getattr(client, name)
+    raise AttributeError(name)

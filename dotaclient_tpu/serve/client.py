@@ -1,0 +1,528 @@
+"""Remote-policy actor client (dotaclient_tpu/serve/).
+
+`RemotePolicyClient` multiplexes many envs' step requests over ONE
+connection to the inference server (responses demultiplex by
+client_key); `RemoteActor` is the classic Actor with its `_policy_step`
+seam routed over that client — run_episode, chunking, publishing, the
+stale-weights kill switch and the shed throttle are all the unchanged
+local code; `RemoteFleet` drives M remote env slots on one loop (the
+VectorActor topology with the batcher replaced by the server).
+
+What stays client-side vs moves server-side:
+
+- client OWNS: featurization, its rng stream (sent/advanced/returned
+  per request — a server restart never desynchronizes sampling), chunk
+  assembly, experience publishing, version STAMPS (synced at chunk
+  boundaries from the version each response reports, the PR-5 rule).
+- server OWNS: the param tree (hot-swapped between ticks) and the LSTM
+  carry (resident per client_key; requests carry only obs + flags).
+  The carry comes back only on chunk-fill steps (WANT_CARRY), where it
+  becomes the next chunk's wire initial_state — mid-chunk the local
+  `state` variable holds the episode's last materialized carry as a
+  stand-in, which nothing reads (next_chunk runs only at publishes; the
+  one discarded-at-episode-end call is documented in _policy_step).
+
+Failure semantics: any transport failure or a server-side carry miss
+(UNKNOWN_CLIENT after a server restart) raises RemoteInferenceError,
+which the run loops treat exactly like a lost env session — abandon the
+episode, back off, start fresh (the first step of a new episode carries
+EPISODE_START and needs no server state).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+from typing import Dict, Optional
+
+import grpc
+import numpy as np
+
+from dotaclient_tpu.config import ActorConfig
+from dotaclient_tpu.ops import action_dist as ad
+from dotaclient_tpu.runtime.actor import Actor, reset_env_stub
+from dotaclient_tpu.serve import wire as W
+
+_log = logging.getLogger(__name__)
+
+
+class RemoteInferenceError(ConnectionError):
+    """The inference service failed this step: transport failure,
+    timeout, or a lost server-side carry (UNKNOWN_CLIENT). Retryable at
+    episode granularity — the actor abandons the episode and starts a
+    fresh one, exactly the lost-env-session path."""
+
+
+class RemotePolicyClient:
+    """One multiplexed connection to the inference server. All use is
+    single-event-loop asyncio (the actor process's loop); `step()` may
+    be in flight for many client_keys at once, at most one per key."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        policy_cfg,
+        wire_obs_dtype: str = "f32",
+        timeout_s: float = 30.0,
+    ):
+        host, _, port = endpoint.partition(":")
+        if not port:
+            raise ValueError(f"serve endpoint must be host:port, got {endpoint!r}")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.lstm_hidden = int(policy_cfg.lstm_hidden)
+        if wire_obs_dtype in ("f32", "float32"):
+            self._obs_bf16 = False
+        elif wire_obs_dtype in ("bf16", "bfloat16"):
+            self._obs_bf16 = True
+        else:
+            raise ValueError(f"wire obs_dtype must be f32|bf16, got {wire_obs_dtype!r}")
+        self.timeout_s = timeout_s
+        self._reader = None
+        self._writer = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._wlock: Optional[asyncio.Lock] = None
+        self._connect_lock: Optional[asyncio.Lock] = None
+        # close() is TERMINAL: afterwards every step fails fast with
+        # RemoteInferenceError instead of reconnecting. This is the
+        # teardown backstop for the Python 3.10 wait_for cancel-swallow
+        # race (the PR-5 batcher's stop-flag lesson): a worker whose
+        # cancel was swallowed must not quietly reconnect and run
+        # forever — its next step raises, its loop sees the fleet
+        # stopping, and teardown converges.
+        self._closed = False
+        self.server_info: Optional[dict] = None
+        # Bench meters: per-request round-trip latency samples (bounded)
+        # + counters. Single-loop access, no locking.
+        self.steps = 0
+        self.errors = 0
+        self.latency_s = collections.deque(maxlen=100_000)
+
+    async def _ensure_connected(self) -> None:
+        if self._closed:
+            raise RemoteInferenceError("client is closed")
+        if self._writer is not None:
+            return
+        # Serialize connection setup: M envs fire their first steps
+        # concurrently, and without the lock each would dial its own
+        # socket and clobber the others' reader/writer mid-handshake.
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            if self._writer is not None:
+                return  # a sibling env connected while we waited
+            try:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(*self.addr), self.timeout_s
+                )
+                # Handshake BEFORE the demux loop starts (sequential
+                # read): the server must agree on the carry width or
+                # every response would deframe wrong.
+                self._writer.write(W.frame(W.S_INFO, b""))
+                await self._writer.drain()
+                mtype, payload = await asyncio.wait_for(
+                    W.read_frame(self._reader), self.timeout_s
+                )
+            except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError, ValueError) as e:
+                await self._teardown()
+                raise RemoteInferenceError(f"connect to {self.addr} failed: {e}") from e
+            try:
+                self._finish_handshake(mtype, payload)
+            except ValueError:
+                # policy mismatch is NOT retryable — a config error, not
+                # an outage; tear down and let it propagate loudly
+                await self._teardown()
+                raise
+
+    def _finish_handshake(self, mtype: int, payload: bytes) -> None:
+        import json
+
+        info = json.loads(payload) if mtype == W.R_INFO else {}
+        if info.get("lstm_hidden") != self.lstm_hidden or info.get("arch") != "lstm":
+            raise ValueError(
+                f"inference server policy mismatch: server {info}, client "
+                f"expects lstm_hidden={self.lstm_hidden}"
+            )
+        self.server_info = info
+        self._wlock = asyncio.Lock()
+        self._reader_task = asyncio.ensure_future(self._read_loop(self._reader))
+
+    async def _read_loop(self, reader) -> None:
+        import struct
+
+        try:
+            while True:
+                mtype, payload = await W.read_frame(reader)
+                if mtype != W.R_STEP or len(payload) < 8:
+                    raise ValueError(f"unexpected server frame {mtype:#x}")
+                (key,) = struct.unpack_from("<Q", payload)
+                fut = self._pending.pop(key, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(payload)
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            exc = RemoteInferenceError(f"server connection lost: {e}")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(exc)
+            self._pending.clear()
+
+    async def _teardown(self) -> None:
+        task, self._reader_task = self._reader_task, None
+        writer, self._writer = self._writer, None
+        self._reader = None
+        # Drop the asyncio primitives with the connection: they bind to
+        # the loop that created them, and a reconnect may happen on a
+        # different loop (drivers that asyncio.run() per phase).
+        self._connect_lock = None
+        self._wlock = None
+        if task is not None:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:
+                pass
+        exc = RemoteInferenceError("connection torn down")
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    async def step(
+        self,
+        client_key: int,
+        obs,
+        rng,
+        episode_start: bool = False,
+        want_carry: bool = False,
+    ) -> W.StepResponse:
+        await self._ensure_connected()
+        # Local snapshots: a SIBLING env's failure can run _teardown()
+        # (nulling _wlock/_writer) while this coroutine awaits the lock;
+        # operating on the snapshot keeps this step's failure path on
+        # the old connection's exceptions (OSError / the pending-future
+        # RemoteInferenceError teardown already set) instead of an
+        # AttributeError on None that would crash the whole fleet.
+        wlock, writer = self._wlock, self._writer
+        if wlock is None or writer is None:
+            raise RemoteInferenceError("connection torn down")
+        if client_key in self._pending:
+            raise RuntimeError(f"concurrent steps for client_key {client_key}")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[client_key] = fut
+        payload = W.encode_step_request(
+            client_key, obs, rng, episode_start, want_carry, self._obs_bf16
+        )
+        t0 = time.perf_counter()
+        try:
+            async with wlock:
+                writer.write(W.frame(W.S_STEP, payload))
+                await writer.drain()
+            resp_payload = await asyncio.wait_for(fut, self.timeout_s)
+        except RemoteInferenceError:
+            self.errors += 1
+            raise
+        except (OSError, asyncio.TimeoutError) as e:
+            self.errors += 1
+            self._pending.pop(client_key, None)
+            await self._teardown()
+            raise RemoteInferenceError(f"step failed: {e}") from e
+        self.latency_s.append(time.perf_counter() - t0)
+        resp = W.decode_step_response(resp_payload, self.lstm_hidden)
+        if resp.status == W.UNKNOWN_CLIENT:
+            # The connection is healthy; only THIS episode's carry is
+            # gone (server restart / eviction). Abandon the episode.
+            self.errors += 1
+            raise RemoteInferenceError(
+                f"server lost the carry for client {client_key} (restart?)"
+            )
+        if resp.status != W.OK:
+            self.errors += 1
+            await self._teardown()
+            raise RemoteInferenceError(f"server rejected step (status {resp.status})")
+        self.steps += 1
+        return resp
+
+    async def close(self) -> None:
+        """Terminal: fails in-flight steps and refuses new ones (build a
+        fresh client to reconnect deliberately)."""
+        self._closed = True
+        await self._teardown()
+
+    def latency_percentiles(self) -> dict:
+        """p50/p99 over the retained window (bench artifact payload)."""
+        if not self.latency_s:
+            return {"p50_ms": 0.0, "p99_ms": 0.0, "samples": 0}
+        lat = np.asarray(self.latency_s)
+        return {
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "samples": int(lat.size),
+        }
+
+
+class RemoteActor(Actor):
+    """The classic Actor with inference served remotely. Everything else
+    — featurize, chunking, publish path (including the PR-8 wire cast),
+    shed throttle, episode/retry loop — is the inherited local code."""
+
+    _RETRYABLE_EPISODE_ERRORS = (grpc.aio.AioRpcError, RemoteInferenceError)
+
+    def __init__(self, cfg: ActorConfig, broker, actor_id: int = 0, stub=None, client=None):
+        if cfg.policy.arch != "lstm":
+            raise ValueError(
+                "remote inference requires policy.arch='lstm' (server-side "
+                "carry residency)"
+            )
+        self._owns_client = client is None
+        self.remote_policy = (
+            client
+            if client is not None
+            else RemotePolicyClient(
+                cfg.serve.endpoint,
+                cfg.policy,
+                wire_obs_dtype=cfg.wire.obs_dtype,
+                timeout_s=cfg.serve.timeout_s,
+            )
+        )
+        # params=(): the server owns the tree; nothing local ever applies
+        # it (maybe_update_weights is overridden) and init_params here
+        # would burn a full net init per env slot for nothing.
+        super().__init__(cfg, broker, actor_id=actor_id, stub=stub, params=())
+        # Version stamping state (the PR-5 chunk-boundary rule):
+        # responses report the version their TICK was served by;
+        # self.version — what chunks are stamped with — syncs to it only
+        # at maybe_update_weights (run_episode calls it right after each
+        # publish), so a chunk whose tail crossed a hot-swap stamps its
+        # chunk-start version: staleness over-estimated, never under-aged.
+        self._seen_version = 0
+        # The episode's last MATERIALIZED carry: real at episode start
+        # (zeros) and after every chunk-fill step (the server returns it
+        # there); a stand-in mid-chunk, where nothing consumes it.
+        self._episode_state = None
+
+    async def _policy_step(
+        self, state, obs, chunk_len: int = 0, episode_start: bool = False
+    ):
+        """One remote policy step. `state` in/out is the chunk-boundary
+        carry protocol described in the module docstring: the returned
+        state is REAL exactly where run_episode consumes it (episode
+        start and chunk-fill steps, whose value becomes the next chunk's
+        wire initial_state). The one place a stand-in reaches next_chunk
+        — an episode that ends mid-chunk — builds a chunk run_episode
+        provably discards (the while-not-done loop exits)."""
+        if episode_start:
+            self._episode_state = state  # the true zero carry, [1, H] pair
+        want_carry = chunk_len + 1 >= self.cfg.rollout_len
+        res = await self.remote_policy.step(
+            self.actor_id, obs, self.rng, episode_start=episode_start, want_carry=want_carry
+        )
+        self.rng = res.rng
+        if res.version != self._seen_version:
+            # A version ADVANCE observed through serving is the weight
+            # freshness signal in remote mode (there is no local fanout
+            # subscription): the kill switch stays meaningful — a
+            # healthy server with a dead weight feed still ages out.
+            self._seen_version = int(res.version)
+            self.last_weight_time = time.monotonic()
+        if res.carry is not None:
+            c, h = res.carry
+            self._episode_state = (
+                np.ascontiguousarray(c, np.float32)[None],
+                np.ascontiguousarray(h, np.float32)[None],
+            )
+        a = res.action
+        action = ad.Action(
+            type=np.asarray([a[0]], np.int32),
+            move_x=np.asarray([a[1]], np.int32),
+            move_y=np.asarray([a[2]], np.int32),
+            target=np.asarray([a[3]], np.int32),
+        )
+        logp = np.asarray([res.logp], np.float32)
+        value = np.asarray([res.value], np.float32)
+        return self._episode_state, action, logp, value
+
+    def maybe_update_weights(self) -> bool:
+        """No broker weight subscription in remote mode — the server
+        owns the tree. This is the chunk-boundary STAMP sync only."""
+        changed = self.version != self._seen_version
+        self.version = self._seen_version
+        return changed
+
+    async def run(self, num_episodes: Optional[int] = None) -> None:
+        try:
+            await super().run(num_episodes)
+        finally:
+            # Standalone use owns its connection; fleet env slots share
+            # the owner's (episode_stream closes it once, at the end).
+            if self._owns_client:
+                await self.remote_policy.close()
+
+
+class _RemoteEnvActor(RemoteActor):
+    """One env slot of a RemoteFleet: shares the owner's wire client and
+    ObsRuntime (one connection, one crash-handler chain per process)."""
+
+    def __init__(self, owner: "RemoteFleet", actor_id: int):
+        self.owner = owner  # before super().__init__: _make_obs_runtime reads it
+        super().__init__(
+            owner.cfg, owner.broker, actor_id=actor_id, client=owner.client
+        )
+
+    def _make_obs_runtime(self):
+        return self.owner.obs
+
+
+class RemoteFleet:
+    """M env sessions, one process, one multiplexed connection to the
+    inference service — the VectorActor topology with the local batcher
+    replaced by the server (which batches across EVERY connected
+    process, not just this one). Env slot j runs actor_id
+    `actor_id * M + j`, the same id scheme as VectorActor, so frames are
+    byte-identical to standalone actors with those ids."""
+
+    def __init__(self, cfg: ActorConfig, broker, actor_id: int = 0, envs: Optional[int] = None, client=None, obs_runtime=None):
+        M = int(envs if envs is not None else getattr(cfg, "envs_per_process", 1))
+        if M < 1:
+            raise ValueError(f"envs must be >= 1, got {M}")
+        self.cfg = cfg
+        self.broker = broker
+        self.actor_id = actor_id
+        self.client = (
+            client
+            if client is not None
+            else RemotePolicyClient(
+                cfg.serve.endpoint,
+                cfg.policy,
+                wire_obs_dtype=cfg.wire.obs_dtype,
+                timeout_s=cfg.serve.timeout_s,
+            )
+        )
+        if obs_runtime is not None:
+            self.obs = obs_runtime
+        else:
+            from dotaclient_tpu.obs import ObsRuntime
+
+            self.obs = ObsRuntime.create(cfg.obs, role=f"remote{actor_id}")
+        self.last_win: Optional[float] = None
+        self._stopping = False  # teardown flag; see episode_stream
+        self.envs = [_RemoteEnvActor(self, actor_id * M + j) for j in range(M)]
+
+    @classmethod
+    def from_actor(cls, actor: RemoteActor, envs: Optional[int] = None) -> "RemoteFleet":
+        """Wrap a constructed RemoteActor (ActorPool's envs-per-actor
+        mode): same cfg/broker/actor_id, shared client + ObsRuntime."""
+        return cls(
+            actor.cfg,
+            actor.broker,
+            actor_id=actor.actor_id,
+            envs=envs,
+            client=actor.remote_policy,
+            obs_runtime=actor.obs,
+        )
+
+    # aggregate counters (driver/bench surface, the VectorActor shape)
+    @property
+    def steps_done(self) -> int:
+        return sum(e.steps_done for e in self.envs)
+
+    @property
+    def episodes_done(self) -> int:
+        return sum(e.episodes_done for e in self.envs)
+
+    @property
+    def rollouts_published(self) -> int:
+        return sum(e.rollouts_published for e in self.envs)
+
+    @property
+    def rollouts_shed(self) -> int:
+        return sum(e.publish_throttle.shed for e in self.envs)
+
+    @property
+    def rollouts_failed(self) -> int:
+        return sum(e.publish_throttle.failed for e in self.envs)
+
+    def stats(self) -> dict:
+        shed = failed = 0
+        throttle_s = 0.0
+        for e in self.envs:
+            t = e.publish_throttle
+            shed += t.shed
+            failed += t.failed
+            throttle_s += t.throttle_s
+        return {
+            "broker_shed_observed_total": float(shed),
+            "broker_shed_publish_failed_total": float(failed),
+            "broker_shed_throttle_s": throttle_s,
+        }
+
+    async def _env_loop(self, env: _RemoteEnvActor, results: "asyncio.Queue") -> None:
+        backoff = 1.0
+        while not self._stopping:
+            try:
+                env.check_weight_freshness()
+                ret = await env.run_episode()
+                backoff = 1.0
+            except env._RETRYABLE_EPISODE_ERRORS as e:
+                if self._stopping:
+                    return  # teardown: the failure IS the closed client
+                _log.warning(
+                    "remote env %d: episode failed (%s: %s); retrying in %.1fs",
+                    env.actor_id,
+                    type(e).__name__,
+                    e.code() if isinstance(e, grpc.aio.AioRpcError) else e,
+                    backoff,
+                )
+                if isinstance(e, grpc.aio.AioRpcError):
+                    await reset_env_stub(env)  # drop the dead env subchannel
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2.0, 30.0)
+                continue
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # incl. StaleWeightsError: surface it
+                await results.put((env, e))
+                return
+            await results.put((env, float(ret)))
+
+    async def episode_stream(self):
+        """Async generator yielding each completed episode's return (any
+        env); closing it tears the workers and the connection down."""
+        results: "asyncio.Queue" = asyncio.Queue()
+        workers = [asyncio.create_task(self._env_loop(e, results)) for e in self.envs]
+        try:
+            while True:
+                env, ret = await results.get()
+                if isinstance(ret, BaseException):
+                    raise ret
+                self.last_win = env.last_win
+                yield ret
+        finally:
+            # Stop-flag + close() BEFORE cancel (the PR-5 teardown
+            # lesson): a cancel swallowed by the 3.10 wait_for race
+            # leaves its worker alive — but its next wire await now
+            # fails fast on the closed client and the loop flag exits
+            # it, so the gather below always converges.
+            self._stopping = True
+            await self.client.close()
+            for t in workers:
+                t.cancel()
+            await asyncio.gather(*workers, return_exceptions=True)
+
+    async def run(self, num_episodes: Optional[int] = None) -> None:
+        if self.obs is not None:
+            self.obs.serve_metrics([self.stats])
+        try:
+            done = 0
+            async for _ in self.episode_stream():
+                done += 1
+                if num_episodes is not None and done >= num_episodes:
+                    return
+        finally:
+            if self.obs is not None:
+                self.obs.close()

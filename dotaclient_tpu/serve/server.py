@@ -1,0 +1,480 @@
+"""Inference server: continuous batching + carry residency + hot-swap.
+
+`python -m dotaclient_tpu.serve.server --serve.port 13380
+ --broker_url tcp://broker:13370 --obs.enabled true --obs.metrics_port 9100`
+
+One process owns one param tree and serves policy steps to remote
+actors (serve/client.py) over the serve wire (serve/wire.py):
+
+- **Continuous batching.** Requests from all connections funnel into a
+  `_ServeBatcher` — the PR-5 `InferenceBatcher` (fire at capacity or
+  `--serve.gather_window_s` after the tick's first request; pad partial
+  ticks to ONE jit signature; drop pad rows) extended with a per-tick
+  (params, version, tick) bundle. Row results are bitwise those of the
+  standalone B=1 actor step (the lax.map occupancy-invariance contract),
+  so remote actors publish byte-identical frames.
+
+- **LSTM carry residency.** The server keeps each client's (c, h)
+  resident, keyed by (connection, client_key): requests carry only the
+  featurized obs + episode-boundary flags. EPISODE_START resets the
+  carry to zeros; a disconnect evicts the connection's carries; a step
+  naming an unknown key (server restarted, carry evicted) is answered
+  UNKNOWN_CLIENT and the client abandons the episode — exactly the lost
+  env-session semantics.
+
+- **Weight hot-swap without draining.** The tree + version live in ONE
+  tuple (`_bundle`) swapped by a single reference assignment; the
+  batcher reads it ONCE per tick (`_tick_bundle`), so every row of a
+  tick is served by one tree and clients can never observe a mixed
+  tick — no drain, no pause, the swap lands between ticks. Swaps come
+  from the broker weight fanout (a poll thread with the actor's
+  `apply_weight_frame` staleness/epoch rules) or directly via
+  `swap_params` — a co-located learner chains it off its
+  WeightPublisher `on_published` hook (with `poke()` collapsing the
+  poll latency to the next tick boundary).
+
+Obs surface: `serve_*` scalars + the batcher's `actor_*` family
+(including the `actor_tick_rows_<k>` occupancy histogram) on
+`/metrics`, structured `/healthz` — registry-pinned in obs/registry.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dotaclient_tpu.config import ActorConfig, InferenceConfig
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.models import policy as P
+from dotaclient_tpu.runtime.actor import InferenceBatcher, apply_weight_frame
+from dotaclient_tpu.serve import wire as W
+
+_log = logging.getLogger(__name__)
+
+
+class _ServeBatcher(InferenceBatcher):
+    """InferenceBatcher whose rows carry serving provenance: the tick's
+    (params, version) bundle is read ONCE per tick, and every future
+    resolves to (row, version, tick) — the hot-swap no-mixed-tick
+    invariant is structural, not timed."""
+
+    def __init__(self, cfg: ActorConfig, bundle_fn, capacity: int):
+        # params_fn is unused by this subclass (_tick_bundle overrides
+        # the read), but the base requires a callable.
+        super().__init__(cfg, lambda: bundle_fn()[0], capacity=capacity)
+        self._bundle_fn = bundle_fn
+        self._tick_seq = 0
+
+    def _tick_bundle(self):
+        params, version = self._bundle_fn()  # ONE atomic tuple read
+        self._tick_seq += 1
+        return (params, version, self._tick_seq)
+
+    def _row_result(self, out, i: int, bundle):
+        return jax.tree.map(lambda x: x[i], out), bundle[1], bundle[2]
+
+
+class _ClientConn:
+    """Per-connection server state: the resident carries this connection
+    owns and the write lock serializing interleaved responses."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.lock = asyncio.Lock()
+        self.carries: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+
+    async def send(self, mtype: int, payload: bytes) -> None:
+        try:
+            async with self.lock:
+                self.writer.write(W.frame(mtype, payload))
+                await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            # The client disconnected while a step was in flight: its
+            # result dies with the connection (the env abandoned the
+            # episode anyway); the reader side of _handle does eviction.
+            pass
+
+
+class InferenceServer:
+    """Asyncio inference service; `start()` runs it in a daemon thread
+    (the BrokerServer lifecycle pattern). Construction initializes the
+    param tree deterministically from cfg.seed — the actor-boot
+    convention, so the service answers from step zero while the first
+    weight broadcast is still compiling."""
+
+    def __init__(self, cfg: InferenceConfig, broker=None, obs_runtime=None):
+        if cfg.policy.arch != "lstm":
+            raise ValueError(
+                f"inference service requires policy.arch='lstm' (server-side "
+                f"carry residency is (c, h)-keyed), got {cfg.policy.arch!r}"
+            )
+        self.cfg = cfg
+        self.host = "0.0.0.0"
+        self.port = int(cfg.serve.port)
+        self.broker = broker
+        # apply_weight_frame contract: params/version/weight_epoch/
+        # last_weight_time live on the agent object.
+        self.params = P.init_params(cfg.policy, jax.random.PRNGKey(cfg.seed))
+        self.version = 0
+        self.last_weight_time = time.monotonic()
+        # THE hot-swap cell: (params, version) swapped by one reference
+        # assignment (poller thread writes, batcher tick reads once) —
+        # the atomically-rebound-and-read-once pattern.
+        self._bundle: Tuple[object, int] = (self.params, self.version)
+        # Batcher cfg: the serve knobs mapped onto the ActorConfig shape
+        # InferenceBatcher speaks (gather window + policy).
+        bcfg = ActorConfig(policy=cfg.policy, gather_window_s=cfg.serve.gather_window_s)
+        self.batcher = _ServeBatcher(bcfg, lambda: self._bundle, capacity=cfg.serve.max_batch)
+        # Loop-thread-written counters; stats() takes GIL-atomic single
+        # reads (the BrokerServer ledger pattern — exact after stop()).
+        self.requests_total = 0
+        self.unknown_client_total = 0
+        self.bad_requests_total = 0
+        self.episode_resets_total = 0
+        self.evictions_total = 0
+        self.weight_swaps_total = 0
+        self._conns: set = set()  # live _ClientConn, loop-thread mutated
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._boot_error: Optional[BaseException] = None
+        self._stop_poll = threading.Event()
+        self._poke = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self.obs = obs_runtime
+
+    # ------------------------------------------------------------ weights
+
+    def swap_params(self, named_or_params, version: int) -> None:
+        """Swap the serving tree directly (in-process publisher hook,
+        tests). `named_or_params` is either a (name, array) list (the
+        WeightPublisher materialization) or a params pytree. Thread-safe
+        by construction: the new (params, version) tuple is built fully,
+        then published with one reference assignment — in-flight ticks
+        keep the tuple they already read."""
+        if isinstance(named_or_params, list):
+            from dotaclient_tpu.transport.serialize import unflatten_params
+
+            params = unflatten_params(named_or_params, self.params)
+        else:
+            params = named_or_params
+        self.params = params
+        self.version = int(version)
+        self.weight_swaps_total += 1
+        self._bundle = (params, int(version))
+
+    def poke(self) -> None:
+        """Wake the weight-poll thread now (WeightPublisher on_published
+        chaining): the swap lands at the next tick boundary instead of
+        up to weight_poll_s later."""
+        self._poke.set()
+
+    def _poll_weights_loop(self) -> None:
+        while not self._stop_poll.is_set():
+            self._poke.wait(self.cfg.serve.weight_poll_s)
+            self._poke.clear()
+            if self._stop_poll.is_set():
+                return
+            try:
+                frame = self.broker.poll_weights()
+            except Exception as e:  # broker outage: keep serving the current tree
+                _log.warning("serve: weight poll failed (%s); retrying", e)
+                continue
+            if frame is None:
+                continue
+            if apply_weight_frame(self, frame, "serve"):
+                # apply_weight_frame mutated params/version; publish them
+                # as one tuple for the tick reader.
+                self.weight_swaps_total += 1
+                self._bundle = (self.params, self.version)
+
+    # ------------------------------------------------------------- serving
+
+    def _zero_state(self):
+        return jax.tree.map(np.asarray, P.initial_state(self.cfg.policy, (1,)))
+
+    @staticmethod
+    def _canon_obs(obs: F.Observation) -> F.Observation:
+        """Upcast bf16 float leaves to f32 (exact) so ONE jit signature
+        serves f32 and bf16 clients alike. f32 obs pass through
+        untouched (same arrays, no copy)."""
+        if np.dtype(obs.global_feats.dtype) == np.float32:
+            return obs
+        return obs._replace(
+            global_feats=obs.global_feats.astype(np.float32),
+            hero_feats=obs.hero_feats.astype(np.float32),
+            unit_feats=obs.unit_feats.astype(np.float32),
+        )
+
+    async def _step_request(self, conn: _ClientConn, payload: bytes) -> None:
+        try:
+            req = W.decode_step_request(payload)
+        except Exception as e:
+            self.bad_requests_total += 1
+            _log.warning("serve: bad step request: %s", e)
+            # Echo the REAL client_key when the head parses (a
+            # size-mismatched frame still carries it): the error must
+            # route to the env that sent it, not to whichever env
+            # happens to use key 0, and the sender must not sit out its
+            # full reply timeout.
+            import struct
+
+            key = struct.unpack_from("<Q", payload)[0] if len(payload) >= 8 else 0
+            await conn.send(
+                W.R_STEP, W.encode_step_response(W.StepResponse(key, W.BAD_REQUEST))
+            )
+            return
+        self.requests_total += 1
+        if req.episode_start:
+            state = self._zero_state()
+            self.episode_resets_total += 1
+        else:
+            state = conn.carries.get(req.client_key)
+            if state is None:
+                self.unknown_client_total += 1
+                await conn.send(
+                    W.R_STEP,
+                    W.encode_step_response(
+                        W.StepResponse(req.client_key, W.UNKNOWN_CLIENT)
+                    ),
+                )
+                return
+        row, version, tick = await self.batcher.step(
+            state, self._canon_obs(req.obs), req.rng
+        )
+        new_state, action, logp, value, rng2 = row
+        new_state = jax.tree.map(np.asarray, new_state)
+        conn.carries[req.client_key] = new_state
+        carry = None
+        if req.want_carry:
+            carry = (np.asarray(new_state[0][0]), np.asarray(new_state[1][0]))
+        await conn.send(
+            W.R_STEP,
+            W.encode_step_response(
+                W.StepResponse(
+                    client_key=req.client_key,
+                    status=W.OK,
+                    version=version,
+                    tick=tick,
+                    rng=np.asarray(rng2),
+                    action=np.asarray(
+                        [action.type[0], action.move_x[0], action.move_y[0], action.target[0]],
+                        np.int32,
+                    ),
+                    logp=float(np.asarray(logp)[0]),
+                    value=float(np.asarray(value)[0]),
+                    carry=carry,
+                )
+            ),
+        )
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = _ClientConn(writer)
+        self._conns.add(conn)
+        tasks: set = set()
+        try:
+            while True:
+                mtype, payload = await W.read_frame(reader)
+                if mtype == W.S_STEP:
+                    # One task per request: a connection's envs step
+                    # concurrently, and the batcher gathers them into
+                    # one tick — handling serially would cap occupancy
+                    # at 1 row per connection.
+                    t = asyncio.ensure_future(self._step_request(conn, payload))
+                    tasks.add(t)
+                    t.add_done_callback(tasks.discard)
+                elif mtype == W.S_STATS:
+                    await conn.send(W.R_STATS, json.dumps(self.stats()).encode())
+                elif mtype == W.S_INFO:
+                    await conn.send(W.R_INFO, json.dumps(self.info()).encode())
+                else:
+                    raise ValueError(f"unknown message type {mtype:#x}")
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass  # client went away; eviction below is the contract
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.evictions_total += len(conn.carries)
+            conn.carries.clear()
+            self._conns.discard(conn)
+            for t in tasks:
+                t.cancel()
+            writer.close()
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def _main(self):
+        driver = asyncio.ensure_future(self.batcher.run())
+        self._stop_ev = asyncio.Event()
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+        await self._stop_ev.wait()
+        # Teardown order (the BrokerServer shutdown dance): stop
+        # accepting, fail the batcher's pending futures, cancel handler
+        # tasks, abort transports so close is immediate.
+        self._server.close()
+        self.batcher.stop()
+        me = asyncio.current_task()
+        handlers = [t for t in asyncio.all_tasks() if t is not me]
+        for t in handlers:
+            t.cancel()
+        for c in list(self._conns):
+            c.writer.transport.abort()
+        if handlers:
+            await asyncio.gather(*handlers, return_exceptions=True)
+        await self._server.wait_closed()
+        driver.cancel()
+        await asyncio.gather(driver, return_exceptions=True)
+
+    def _warm(self) -> None:
+        """Compile the tick signature before accepting traffic: a pad
+        tick exercises the exact (params, state, obs, rng) shapes every
+        real tick uses, so the first client request never pays the
+        compile wall."""
+        M = self.batcher.capacity
+        state_b = jax.tree.map(
+            lambda *xs: np.stack(xs), *([self.batcher._pad_state] * M)
+        )
+        obs_b = jax.tree.map(
+            lambda *xs: np.stack(xs)[:, None], *([self.batcher._pad_obs] * M)
+        )
+        rng_b = np.stack([self.batcher._pad_rng] * M)
+        out = self.batcher._step(self._bundle[0], state_b, obs_b, rng_b)
+        jax.block_until_ready(out)
+
+    def _run(self):
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        try:
+            self._warm()
+            loop.run_until_complete(self._main())
+            pending = asyncio.all_tasks(loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(asyncio.gather(*pending, return_exceptions=True))
+        except BaseException as e:
+            self._boot_error = e
+            self._started.set()
+        finally:
+            loop.close()
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self._run, daemon=True, name="serve-server")
+        self._thread.start()
+        # Generous boot wait: _warm() compiles the full batched tick
+        # signature before the listener comes up (flagship M=16 on a
+        # cold CPU cache is tens of seconds).
+        if not self._started.wait(300):
+            raise RuntimeError("inference server failed to start (timeout)")
+        boot_error = self._boot_error
+        if boot_error is not None:
+            raise RuntimeError(f"inference server failed to start: {boot_error}") from boot_error
+        if self.broker is not None:
+            self._poll_thread = threading.Thread(
+                target=self._poll_weights_loop, daemon=True, name="serve-weights"
+            )
+            self._poll_thread.start()
+        if self.obs is not None:
+            self.obs.serve_metrics([self.stats], health_provider=self._health)
+        return self
+
+    def stop(self) -> None:
+        self._stop_poll.set()
+        self._poke.set()
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(self._stop_ev.set)
+            except RuntimeError:
+                pass
+        if self._thread:
+            self._thread.join(timeout=10)
+        if self._poll_thread:
+            self._poll_thread.join(timeout=5)
+        if self.obs is not None:
+            self.obs.close()
+
+    # ------------------------------------------------------------- surface
+
+    def stats(self) -> dict:
+        out = dict(self.batcher.stats())
+        out.update(
+            {
+                "serve_requests_total": float(self.requests_total),
+                "serve_unknown_client_total": float(self.unknown_client_total),
+                "serve_bad_requests_total": float(self.bad_requests_total),
+                "serve_episode_resets_total": float(self.episode_resets_total),
+                "serve_evictions_total": float(self.evictions_total),
+                "serve_weight_swaps_total": float(self.weight_swaps_total),
+                "serve_version": float(self._bundle[1]),
+                "serve_clients_connected": float(len(list(self._conns))),
+                "serve_carries_resident": float(
+                    sum(len(c.carries) for c in list(self._conns))
+                ),
+            }
+        )
+        return out
+
+    def info(self) -> dict:
+        """The S_INFO handshake body: what a client must agree with."""
+        return {
+            "role": "serve",
+            "arch": self.cfg.policy.arch,
+            "lstm_hidden": self.cfg.policy.lstm_hidden,
+            "max_batch": self.cfg.serve.max_batch,
+            "gather_window_s": self.cfg.serve.gather_window_s,
+            "version": self._bundle[1],
+        }
+
+    def _health(self) -> dict:
+        return {
+            "ok": True,
+            "role": "serve",
+            "version": self._bundle[1],
+            "clients": len(list(self._conns)),
+        }
+
+
+def main(argv=None):
+    from dotaclient_tpu.config import parse_config
+    from dotaclient_tpu.obs import ObsRuntime
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.base import connect as broker_connect
+
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_config(InferenceConfig(), argv)
+    if cfg.platform:
+        jax.config.update("jax_platforms", cfg.platform)
+    broker = broker_connect(cfg.broker_url, retry=RetryPolicy.from_config(cfg.retry))
+    if cfg.chaos.enabled:
+        from dotaclient_tpu.chaos import wrap_broker
+
+        broker = wrap_broker(broker, cfg.chaos)
+    obs = ObsRuntime.create(cfg.obs, role="serve")
+    server = InferenceServer(cfg, broker, obs_runtime=obs).start()
+    # The bench/orchestration contract: ONE parseable ready line with
+    # the bound port (--serve.port 0 picks a free one).
+    print(json.dumps({"serving": True, "port": server.port}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

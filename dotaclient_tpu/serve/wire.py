@@ -1,0 +1,217 @@
+"""Inference-service wire protocol (dotaclient_tpu/serve/).
+
+Framing is the tcp broker's (transport/tcp.py): every message is
+`u32 payload_len | u8 type | payload`, little-endian throughout.
+
+  0x01 S_STEP   one policy-step request            → 0x81 R_STEP
+  0x02 S_STATS  no payload                         → 0x82 R_STATS (JSON)
+  0x03 S_INFO   no payload                         → 0x83 R_INFO  (JSON)
+
+S_STEP payload:
+  u64    client_key  — names this client's server-resident LSTM carry.
+         Carries are scoped PER CONNECTION (two pods reusing actor_id 0
+         can never alias), so a disconnect evicts exactly this
+         connection's carries.
+  u8     flags       — bit0 EPISODE_START: reset the carry to zeros
+                       before stepping (the per-row episode-boundary
+                       reset the vector fleet does locally);
+                       bit1 WANT_CARRY: return the post-step (c, h) —
+                       clients set it on chunk-fill steps, where the
+                       carry becomes the next chunk's wire initial_state.
+  u8     obs_code    — float-leaf wire dtype of the obs block: 0 = f32
+                       (exact), 3 = bf16 (the PR-8 DTR3 code; halves
+                       request bandwidth, server upcasts exactly).
+  u8[8]  rng         — the client's jax PRNGKey (u32 x 2). The client
+         OWNS its rng stream (seeded exactly like a standalone actor),
+         the server advances it inside the jit step and returns it —
+         so sampled actions are bitwise those of the local path, and a
+         server restart never desynchronizes anyone's stream.
+  bytes  obs         — transport/serialize.py single-observation frame.
+
+R_STEP payload:
+  u64    client_key  — echo (responses interleave across a connection's
+                       concurrently-stepping envs; the client
+                       demultiplexes by key).
+  u8     status      — 0 OK; 1 UNKNOWN_CLIENT (no resident carry and no
+                       EPISODE_START flag — the server restarted or
+                       evicted; abandon the episode like a lost env
+                       session); 2 BAD_REQUEST. Non-OK responses end
+                       after this byte.
+  u32    version     — model version of the param tree that served this
+                       row's TICK (every row of a tick shares it — the
+                       no-mixed-batch hot-swap invariant). Clients stamp
+                       chunks with it under the PR-5 chunk-boundary
+                       rule.
+  u64    tick        — serving tick ordinal (observability + the
+                       mixed-tick test's grouping key).
+  u8[8]  rng'        — advanced PRNGKey.
+  i32[4] action      — sampled head indices (type, move_x, move_y,
+                       target), the [1]-row values of the local step.
+  f32    logp, value
+  u8     has_carry
+  f32[H] c, f32[H] h — present iff has_carry (H = lstm_hidden).
+
+Compat note: this protocol is NEW in this build — there are no old
+peers to stay compatible with. The rolling-upgrade order is therefore
+purely operational (MIGRATION.md): deploy servers first, then actors
+opt in via --serve.endpoint; rollback is flag-off (actors fall back to
+local inference, no server needed).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.transport.serialize import (
+    _WIRE_BF16,
+    _WIRE_F32,
+    deserialize_obs,
+    obs_wire_nbytes,
+    serialize_obs,
+)
+
+_LEN = struct.Struct("<I")
+_TYPE = struct.Struct("<B")
+
+S_STEP, S_STATS, S_INFO = 0x01, 0x02, 0x03
+R_STEP, R_STATS, R_INFO = 0x81, 0x82, 0x83
+
+FLAG_EPISODE_START = 1
+FLAG_WANT_CARRY = 2
+
+OK, UNKNOWN_CLIENT, BAD_REQUEST = 0, 1, 2
+
+OBS_F32, OBS_BF16 = _WIRE_F32, _WIRE_BF16
+
+MAX_FRAME = 16 * 1024 * 1024  # a step request/reply is a few KB; 16M is "insane, drop"
+
+_REQ_HEAD = struct.Struct("<QBB8s")
+_RESP_HEAD = struct.Struct("<QB")
+_RESP_BODY = struct.Struct("<IQ8s4iffB")
+
+
+class StepRequest(NamedTuple):
+    client_key: int
+    episode_start: bool
+    want_carry: bool
+    obs_bf16: bool
+    rng: np.ndarray  # u32 [2]
+    obs: F.Observation
+
+
+class StepResponse(NamedTuple):
+    client_key: int
+    status: int
+    version: int = 0
+    tick: int = 0
+    rng: Optional[np.ndarray] = None  # u32 [2]
+    action: Optional[np.ndarray] = None  # i32 [4] (type, move_x, move_y, target)
+    logp: float = 0.0
+    value: float = 0.0
+    carry: Optional[Tuple[np.ndarray, np.ndarray]] = None  # (c, h) each f32 [H]
+
+
+def frame(mtype: int, payload: bytes) -> bytes:
+    return _LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload
+
+
+def encode_step_request(
+    client_key: int,
+    obs: F.Observation,
+    rng,
+    episode_start: bool = False,
+    want_carry: bool = False,
+    obs_bf16: bool = False,
+) -> bytes:
+    flags = (FLAG_EPISODE_START if episode_start else 0) | (
+        FLAG_WANT_CARRY if want_carry else 0
+    )
+    code = OBS_BF16 if obs_bf16 else OBS_F32
+    rng_b = np.ascontiguousarray(np.asarray(rng), np.uint32).tobytes()
+    return _REQ_HEAD.pack(client_key, flags, code, rng_b) + serialize_obs(obs, obs_bf16)
+
+
+def decode_step_request(payload: bytes) -> StepRequest:
+    if len(payload) < _REQ_HEAD.size:
+        raise ValueError("truncated step request")
+    client_key, flags, code, rng_b = _REQ_HEAD.unpack_from(payload)
+    if code not in (OBS_F32, OBS_BF16):
+        raise ValueError(f"unknown obs wire dtype code {code}")
+    obs_bf16 = code == OBS_BF16
+    expect = _REQ_HEAD.size + obs_wire_nbytes(obs_bf16)
+    if len(payload) != expect:
+        raise ValueError(f"step request size {len(payload)} != {expect}")
+    obs, _ = deserialize_obs(payload, _REQ_HEAD.size, obs_bf16)
+    return StepRequest(
+        client_key=client_key,
+        episode_start=bool(flags & FLAG_EPISODE_START),
+        want_carry=bool(flags & FLAG_WANT_CARRY),
+        obs_bf16=obs_bf16,
+        rng=np.frombuffer(rng_b, np.uint32),
+        obs=obs,
+    )
+
+
+def encode_step_response(r: StepResponse) -> bytes:
+    head = _RESP_HEAD.pack(r.client_key, r.status)
+    if r.status != OK:
+        return head
+    rng_b = np.ascontiguousarray(r.rng, np.uint32).tobytes()
+    a = [int(x) for x in r.action]
+    body = _RESP_BODY.pack(
+        r.version, r.tick, rng_b, a[0], a[1], a[2], a[3],
+        float(r.logp), float(r.value), 1 if r.carry is not None else 0,
+    )
+    if r.carry is not None:
+        c, h = r.carry
+        body += np.ascontiguousarray(c, np.float32).tobytes()
+        body += np.ascontiguousarray(h, np.float32).tobytes()
+    return head + body
+
+
+def decode_step_response(payload: bytes, lstm_hidden: int) -> StepResponse:
+    if len(payload) < _RESP_HEAD.size:
+        raise ValueError("truncated step response")
+    client_key, status = _RESP_HEAD.unpack_from(payload)
+    if status != OK:
+        return StepResponse(client_key=client_key, status=status)
+    off = _RESP_HEAD.size
+    version, tick, rng_b, a0, a1, a2, a3, logp, value, has_carry = _RESP_BODY.unpack_from(
+        payload, off
+    )
+    off += _RESP_BODY.size
+    carry = None
+    if has_carry:
+        n = lstm_hidden * 4
+        if len(payload) < off + 2 * n:
+            raise ValueError("truncated carry in step response")
+        c = np.frombuffer(payload, np.float32, count=lstm_hidden, offset=off)
+        h = np.frombuffer(payload, np.float32, count=lstm_hidden, offset=off + n)
+        carry = (c, h)
+    return StepResponse(
+        client_key=client_key,
+        status=status,
+        version=version,
+        tick=tick,
+        rng=np.frombuffer(rng_b, np.uint32),
+        action=np.asarray([a0, a1, a2, a3], np.int32),
+        logp=logp,
+        value=value,
+        carry=carry,
+    )
+
+
+async def read_frame(reader) -> Tuple[int, bytes]:
+    """(type, payload) from an asyncio StreamReader; raises
+    IncompleteReadError on EOF like the tcp broker's handler."""
+    hdr = await reader.readexactly(_LEN.size + _TYPE.size)
+    (n,) = _LEN.unpack_from(hdr)
+    (mtype,) = _TYPE.unpack_from(hdr, _LEN.size)
+    if n > MAX_FRAME:
+        raise ValueError("frame too large")
+    payload = await reader.readexactly(n) if n else b""
+    return mtype, payload

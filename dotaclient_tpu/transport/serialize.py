@@ -413,6 +413,74 @@ def deserialize_rollout(data: bytes) -> Rollout:
     )
 
 
+# --- single-observation frames (inference-service wire) ---------------
+#
+# The serve tier (dotaclient_tpu/serve/) ships ONE featurized
+# observation per request — no time axis, no actions/rewards — on the
+# same dtype-code convention as the DTR3 rollout wire: float leaves
+# travel f32 (exact) or bf16 (the PR-8 cast, halving request bandwidth;
+# the server upcasts bf16→f32 exactly, so one jit signature serves a
+# mixed fleet). Array order matches the rollout wire's obs block.
+
+
+def obs_wire_layout(obs_bf16: bool = False):
+    """(shape, dtype) per array of a single-observation frame, in
+    serialization order (the rollout obs block minus the time axis)."""
+    fdt = _bf16_dtype() if obs_bf16 else np.float32
+    return [
+        ((F.GLOBAL_FEATURES,), fdt),
+        ((F.HERO_FEATURES,), fdt),
+        ((F.MAX_UNITS, F.UNIT_FEATURES), fdt),
+        ((F.MAX_UNITS,), np.uint8),
+        ((F.MAX_UNITS,), np.uint8),
+        ((F.N_ACTION_TYPES,), np.uint8),
+    ]
+
+
+def obs_wire_nbytes(obs_bf16: bool = False) -> int:
+    return sum(
+        int(np.prod(shape)) * np.dtype(dt).itemsize
+        for shape, dt in obs_wire_layout(obs_bf16)
+    )
+
+
+def serialize_obs(obs: F.Observation, obs_bf16: bool = False) -> bytes:
+    """One unbatched Observation → raw wire bytes. The bf16 cast is the
+    exact RNE astype of cast_rollout_obs_bf16, so a bf16-wire request
+    stepped by a bf16-compute policy is bitwise identical to the local
+    f32 step (the serve parity contract, tests/test_serve.py)."""
+    if obs_bf16:
+        with np.errstate(invalid="ignore", over="ignore"):
+            return b"".join(a.tobytes() for a in _obs_arrays(obs, True))
+    return b"".join(a.tobytes() for a in _obs_arrays(obs, False))
+
+
+def deserialize_obs(
+    data: bytes, offset: int = 0, obs_bf16: bool = False
+) -> Tuple[F.Observation, int]:
+    """(Observation, next offset) from raw wire bytes. Float leaves come
+    back in their WIRE dtype — the serve server upcasts bf16→f32 (exact)
+    at intake to keep one jit signature."""
+    arrays = []
+    for shape, dtype in obs_wire_layout(obs_bf16):
+        n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if offset + n > len(data):
+            raise ValueError("truncated observation frame")
+        arrays.append(
+            np.frombuffer(data, dtype, count=int(np.prod(shape)), offset=offset).reshape(shape)
+        )
+        offset += n
+    obs = F.Observation(
+        global_feats=arrays[0],
+        hero_feats=arrays[1],
+        unit_feats=arrays[2],
+        unit_mask=arrays[3].astype(bool),
+        target_mask=arrays[4].astype(bool),
+        action_mask=arrays[5].astype(bool),
+    )
+    return obs, offset
+
+
 # --- weights -----------------------------------------------------------
 
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.uint8}

@@ -1,5 +1,7 @@
 """Nightly obs smoke: drive a short real learner and curl its whole HTTP
-surface — GET /metrics, GET /healthz, POST /profile?seconds=N.
+surface — GET /metrics, GET /healthz, POST /profile?seconds=N — then
+stand up an inference server (dotaclient_tpu/serve/), push one remote
+policy step through it, and curl ITS /metrics + /healthz too.
 
 The tier-1 tests cover each endpoint in isolation; this exercises the
 deployed composition: one learner process with --obs.enabled, the
@@ -8,7 +10,7 @@ on-demand profiler capture taken mid-run (the thing an oncall actually
 does). Prints ONE JSON line (the repo's bench/script contract):
 
   {"ok": true, "steps": N, "metrics_scalars": M, "healthz": {...},
-   "profile_trace_dir": "...", ...}
+   "profile_trace_dir": "...", "serve": {...}, ...}
 
 Run: JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 Wrapped for the nightly lane by
@@ -152,8 +154,83 @@ def main() -> int:
         finally:
             stop.set()
             learner.close()
+
+    # ---- inference-service surface (dotaclient_tpu/serve/) ------------
+    # Same oncall story for the serving tier: a live server with a real
+    # remote step through it, scraped while serving.
+    serve_out = {"ok": False}
+    try:
+        serve_out = _serve_smoke()
+    except Exception as e:
+        serve_out = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    out["serve"] = serve_out
+    out["ok"] = bool(out.get("ok")) and bool(serve_out.get("ok"))
     print(json.dumps(out))
     return 0 if out["ok"] else 1
+
+
+def _serve_smoke() -> dict:
+    import asyncio
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from dotaclient_tpu.config import InferenceConfig, ObsConfig, PolicyConfig, ServeConfig
+    from dotaclient_tpu.models import policy as P
+    from dotaclient_tpu.obs import ObsRuntime
+    from dotaclient_tpu.serve.client import RemotePolicyClient
+    from dotaclient_tpu.serve.server import InferenceServer
+
+    sock = socket.socket()
+    sock.bind(("", 0))
+    mport = sock.getsockname()[1]
+    sock.close()
+
+    cfg = InferenceConfig(
+        serve=ServeConfig(port=0, max_batch=2, gather_window_s=0.005),
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"),
+        obs=ObsConfig(enabled=True, metrics_port=mport, install_handlers=False),
+        seed=1,
+    )
+    obs_rt = ObsRuntime.create(cfg.obs, role="serve")
+    server = InferenceServer(cfg, obs_runtime=obs_rt).start()
+    try:
+        # one real remote step so the request/reset counters are live
+        from dotaclient_tpu.env import featurizer as F
+
+        async def one_step():
+            client = RemotePolicyClient(f"127.0.0.1:{server.port}", cfg.policy)
+            try:
+                return await client.step(
+                    7, F.zeros_observation(), np.asarray(jax.random.PRNGKey(0)),
+                    episode_start=True,
+                )
+            finally:
+                await client.close()
+
+        resp = asyncio.new_event_loop().run_until_complete(one_step())
+        base = f"http://127.0.0.1:{mport}"
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+        health = json.loads(urllib.request.urlopen(f"{base}/healthz", timeout=10).read())
+        names = {ln.split()[0] for ln in body.splitlines() if ln and not ln.startswith("#")}
+        required = {
+            "dotaclient_serve_requests_total",
+            "dotaclient_serve_carries_resident",
+            "dotaclient_serve_version",
+            "dotaclient_actor_batch_occupancy",
+            "dotaclient_actor_tick_rows_1",
+        }
+        missing = sorted(required - names)
+        return {
+            "ok": resp.status == 0 and not missing and health.get("ok") is True
+            and health.get("role") == "serve",
+            "metrics_scalars": len(names),
+            "missing_required_scalars": missing,
+            "healthz": health,
+        }
+    finally:
+        server.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,248 @@
+"""bf16 experience-wire soak → WIRE_SOAK.json (the PR-8 sign-off).
+
+PR 8 shipped the DTR3 quantized wire behind `--wire.obs_dtype` with the
+prod actor manifests PINNED to f32 "until the bf16 soak signs off"
+(k8s/actors.yaml, MIGRATION item 9). This is that soak: a closed loop —
+real tcp BrokerServer, real learner (staging + native packer + obs
+meters), real actors (genuine featurize/policy/chunking against the
+in-process fake env) — driven through the THREE fleet states a rolling
+upgrade traverses:
+
+  phase 1  all-f32   (today's fleet; the control)
+  phase 2  MIXED     (mid-rollout: half the actors flipped to bf16)
+  phase 3  all-bf16  (the post-flip fleet)
+
+Invariants asserted per phase (the sign-off bar):
+  - zero staging quarantines and zero dropped_bad deltas — no frame of
+    either wire dtype is ever filed as poison;
+  - the wire meters walk exactly as the fleet state says they should
+    (f32 phase ships no bf16 frames, bf16 phase ships no f32 frames,
+    the mixed phase ships both — the upgrade-progress gauge operators
+    will watch);
+  - the learner trains through every phase (steps advance, loss
+    finite) and weight fanout keeps hot-swapping into the actors;
+  - bytes-per-frame on the bf16 wire lands in the expected band
+    (obs dominate the frame, so ~0.5-0.7x of f32 — the WIRE_QUANT_AB
+    bandwidth claim reproduced end-to-end through the broker).
+
+Run: python scripts/soak_wire_bf16.py            # committed artifact
+     python scripts/soak_wire_bf16.py --quick    # nightly wrapper scale
+(tests/test_transport.py guards the committed verdict and wraps --quick
+nightly.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _tiny_policy():
+    from dotaclient_tpu.config import PolicyConfig
+
+    return PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+def _run_actor_phase(args, port, duration, n_actors, id_base, obs_dtypes, min_published=0):
+    """ActorPool of genuine actors publishing with the given per-actor
+    wire dtypes (the chaos_soak actor-phase shape, minus the chaos).
+    `min_published` extends the phase until that many chunks were
+    actually ACKED (the warm phase must outlast actor jit compile —
+    a fixed 2s window can end before the first chunk exists)."""
+    from dotaclient_tpu.config import ActorConfig, WireConfig
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.env.service import LocalDotaServiceStub
+    from dotaclient_tpu.runtime.actor import Actor
+    from dotaclient_tpu.runtime.harness import ActorPool
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.tcp import TcpBroker
+
+    policy = _tiny_policy()
+
+    def make_actor(i):
+        acfg = ActorConfig(
+            env_addr="local",
+            rollout_len=args.seq_len,
+            max_dota_time=4.0,
+            policy=policy,
+            seed=100 + id_base + i,
+            max_weight_age_s=0.0,
+            wire=WireConfig(obs_dtype=obs_dtypes[i % len(obs_dtypes)]),
+        )
+        return Actor(
+            acfg,
+            TcpBroker(port=port, retry=RetryPolicy(window_s=8.0)),
+            actor_id=id_base + i,
+            stub=LocalDotaServiceStub(FakeDotaService()),
+        )
+
+    pool = ActorPool(make_actor, n_actors).start()
+    time.sleep(duration)
+    if min_published:
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            if pool.publish_stats()["published"] >= min_published:
+                break
+            time.sleep(0.25)
+    pool.stop(timeout=30.0, raise_on_dead=True)
+    ledger = pool.publish_stats()
+    ledger["attempted"] = ledger["published"] + ledger["shed"] + ledger["failed"]
+    return ledger
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="WIRE_SOAK.json")
+    p.add_argument("--actors", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", dest="seq_len", type=int, default=8)
+    p.add_argument("--phase-s", dest="phase_s", type=float, default=25.0)
+    p.add_argument("--quick", action="store_true", help="nightly scale, same invariants")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.phase_s = 8.0
+        args.actors = 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dotaclient_tpu.config import LearnerConfig, ObsConfig, PPOConfig
+    from dotaclient_tpu.runtime.learner import Learner
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.tcp import BrokerServer, TcpBroker
+
+    lcfg = LearnerConfig(
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        policy=_tiny_policy(),
+        publish_every=1,
+        metrics_every=5,
+        # wide window: the tiny-policy learner advances versions faster
+        # than any real deployment; staleness drops would be a config
+        # artifact, not a wire property (the chaos_soak precedent)
+        ppo=PPOConfig(max_staleness=256),
+        obs=ObsConfig(enabled=True, install_handlers=False, step_phases=False),
+    )
+    srv = BrokerServer(port=0).start()
+    port = srv.port
+    artifact = {
+        "generated_by": "scripts/soak_wire_bf16.py",
+        "topology": "real tcp broker, CPU learner (tiny policy), genuine actors (fake env)",
+        "batch": f"{lcfg.batch_size}x{lcfg.seq_len}",
+        "phase_s": args.phase_s,
+        "actors": args.actors,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    phases = [
+        ("phase_1_all_f32", ["f32"]),
+        ("phase_2_mixed", ["f32", "bf16"]),
+        ("phase_3_all_bf16", ["bf16"]),
+    ]
+    ok = True
+    problems = []
+    try:
+        learner = Learner(lcfg, TcpBroker(port=port, retry=RetryPolicy()))
+
+        # Warm the compile outside the measured phases (extends itself
+        # until a full batch's worth of chunks is durably in the broker).
+        warm = _run_actor_phase(
+            args, port, 2.0, 1, 900, ["f32"], min_published=args.batch_size + 4
+        )
+        learner.run(num_steps=1, batch_timeout=120.0)
+        print("learner warm", flush=True)
+
+        def snap():
+            s = learner.staging.stats()
+            return {
+                k: s[k]
+                for k in (
+                    "consumed",
+                    "dropped_stale",
+                    "dropped_bad",
+                    "quarantined",
+                    "wire_bytes",
+                    "wire_frames_obs_bf16",
+                    "wire_frames_obs_f32",
+                )
+            }
+
+        for name, dtypes in phases:
+            s0 = snap()
+            v0, steps0 = learner.version, learner.version
+            ledger_box = {}
+
+            def run_actors(box=ledger_box, dt=dtypes):
+                box["ledger"] = _run_actor_phase(
+                    args, port, args.phase_s, args.actors, 200, dt
+                )
+
+            th = threading.Thread(target=run_actors)
+            th.start()
+            learner.run(max_seconds=args.phase_s + 2.0, batch_timeout=2.0)
+            th.join(timeout=60)
+            # drain the phase's tail so its frames are counted under it
+            learner.run(max_seconds=2.0, batch_timeout=0.5)
+            s1 = snap()
+            d = {k: s1[k] - s0[k] for k in s0}
+            frames = d["wire_frames_obs_bf16"] + d["wire_frames_obs_f32"]
+            loss = learner.metrics.latest().get("loss")
+            phase = {
+                "wire_dtypes": dtypes,
+                "publish": ledger_box["ledger"],
+                "consumed_delta": d["consumed"],
+                "quarantined_delta": d["quarantined"],
+                "dropped_bad_delta": d["dropped_bad"],
+                "dropped_stale_delta": d["dropped_stale"],
+                "frames_f32": d["wire_frames_obs_f32"],
+                "frames_bf16": d["wire_frames_obs_bf16"],
+                "bytes_per_frame": round(d["wire_bytes"] / frames, 1) if frames else None,
+                "versions_advanced": learner.version - v0,
+                "loss": None if loss is None else float(loss),
+            }
+            checks = {
+                "no_quarantine": d["quarantined"] == 0 and d["dropped_bad"] == 0,
+                "trained": d["consumed"] > 0 and phase["versions_advanced"] > 0,
+                "loss_finite": loss is not None and bool(abs(float(loss)) < 1e9),
+                "meters_match_fleet": (
+                    (d["wire_frames_obs_bf16"] == 0)
+                    if dtypes == ["f32"]
+                    else (d["wire_frames_obs_f32"] == 0)
+                    if dtypes == ["bf16"]
+                    else (d["wire_frames_obs_bf16"] > 0 and d["wire_frames_obs_f32"] > 0)
+                ),
+            }
+            phase["checks"] = checks
+            artifact[name] = phase
+            if not all(checks.values()):
+                ok = False
+                problems.append(f"{name}: {[k for k, v in checks.items() if not v]}")
+            print(json.dumps({name: phase}), flush=True)
+
+        bpf_f32 = artifact["phase_1_all_f32"]["bytes_per_frame"]
+        bpf_bf16 = artifact["phase_3_all_bf16"]["bytes_per_frame"]
+        ratio = round(bpf_bf16 / bpf_f32, 3) if (bpf_f32 and bpf_bf16) else None
+        bandwidth_ok = ratio is not None and 0.4 <= ratio <= 0.8
+        if not bandwidth_ok:
+            ok = False
+            problems.append(f"bf16/f32 bytes-per-frame ratio {ratio} outside [0.4, 0.8]")
+        artifact["wire_bytes_per_frame_ratio_bf16_vs_f32"] = ratio
+        learner.close()
+    finally:
+        srv.stop()
+    artifact["verdict"] = {"ok": ok, "problems": problems}
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}: {'ALL GREEN' if ok else problems}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

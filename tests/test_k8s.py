@@ -42,6 +42,7 @@ def test_manifests_exist():
         "actors.yaml",
         "evaluator.yaml",
         "rabbitmq.yaml",
+        "inference.yaml",
     } <= names
     assert (K8S / "Dockerfile").exists()
 
@@ -95,10 +96,13 @@ def test_flags_are_real_config_fields():
     from dotaclient_tpu.config import ActorConfig, EvalConfig, LearnerConfig, add_flags
     import argparse
 
+    from dotaclient_tpu.config import InferenceConfig
+
     known = {
         "dotaclient_tpu.runtime.learner": LearnerConfig(),
         "dotaclient_tpu.runtime.actor": ActorConfig(),
         "dotaclient_tpu.eval.evaluator": EvalConfig(),
+        "dotaclient_tpu.serve.server": InferenceConfig(),
     }
     for fname, c in _our_containers():
         cmd = c.get("command")
@@ -242,13 +246,20 @@ def test_chaos_pinned_off_in_all_prod_manifests():
     assert checked >= 4  # learner, learner-multihost, actors, evaluator
 
 
-def test_wire_obs_dtype_pinned_f32_on_actors():
-    """The quantized-wire flag ships EXPLICITLY pinned to the
-    byte-identical f32 default on the actor fleet (the chaos-flag
-    precedent): prod stays on the legacy wire until the bf16 soak signs
-    off, and a copy-pasted bench flag can't flip the fleet early. The
-    broker is wire-agnostic by design — it must NOT grow the flag
-    (opaque bytes; no restart in the consumers-first upgrade)."""
+def test_wire_obs_dtype_pinned_bf16_on_actors():
+    """The quantized-wire flag ships EXPLICITLY pinned on the actor
+    fleet (the chaos-flag precedent) — and since the bf16 soak signed
+    off (WIRE_SOAK.json, all green: zero quarantines across f32/mixed/
+    bf16 fleet states, meters walk with the fleet, 0.54x bytes/frame),
+    the pin IS bf16: the fleet ships the quantized wire. This test is
+    the flip's paper trail — changing the pin again must touch the soak
+    verdict too. The broker stays wire-agnostic by design — it must NOT
+    grow the flag (opaque bytes; no restart in the consumers-first
+    upgrade)."""
+    import json
+
+    verdict = json.loads((K8S.parent / "WIRE_SOAK.json").read_text())["verdict"]
+    assert verdict["ok"] is True, "bf16 pin requires a green WIRE_SOAK verdict"
     actor_containers = [
         (fname, c)
         for fname, c in _our_containers()
@@ -258,13 +269,56 @@ def test_wire_obs_dtype_pinned_f32_on_actors():
     for fname, c in actor_containers:
         args = c.get("args", [])
         assert "--wire.obs_dtype" in args, f"{fname}: wire.obs_dtype not pinned"
-        assert args[args.index("--wire.obs_dtype") + 1] == "f32", (
-            f"{fname}: wire.obs_dtype must stay f32 until the bf16 soak"
+        assert args[args.index("--wire.obs_dtype") + 1] == "bf16", (
+            f"{fname}: the fleet ships the soak-approved bf16 wire"
         )
     for fname, c in _our_containers():
         if c.get("command") and c["command"][2] == "dotaclient_tpu.transport.tcp_server":
             assert "--wire.obs_dtype" not in c.get("args", []), (
                 f"{fname}: the broker is wire-format agnostic; no wire flag"
+            )
+
+
+def test_inference_service_manifest():
+    """The serving tier's deployment shell: probes on /healthz (liveness
+    delayed past the boot compile), a Service exposing serve + metrics
+    ports, the broker weight subscription wired to the broker Service,
+    the serve-endpoint opt-in pinned EMPTY on the actor fleet (flip is
+    a deliberate act, server-first), and obs enabled so the serve_*
+    scalars actually scrape."""
+    (_, doc), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "inference" and d["kind"] == "Deployment"
+    ]
+    c = doc["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][2] == "dotaclient_tpu.serve.server"
+    args = c["args"]
+    assert args[args.index("--broker_url") + 1] == "tcp://broker:13370"
+    assert args[args.index("--obs.enabled") + 1] == "true"
+    mport = int(args[args.index("--obs.metrics_port") + 1])
+    assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    assert c["readinessProbe"]["httpGet"]["port"] == mport
+    live = c["livenessProbe"]
+    assert live["httpGet"]["path"] == "/healthz"
+    assert live["initialDelaySeconds"] >= 60, (
+        "liveness must outwait the boot-time tick compile"
+    )
+    svc = [
+        d for _, d in DOCS
+        if d["kind"] == "Service" and d["metadata"]["name"] == "inference"
+    ]
+    assert svc, "inference Deployment needs its Service"
+    ports = {p["port"] for p in svc[0]["spec"]["ports"]}
+    sport = int(args[args.index("--serve.port") + 1])
+    assert {sport, mport} <= ports
+    # actor fleet: the opt-in flag is pinned EMPTY (local inference)
+    for fname, ac in _our_containers():
+        if ac.get("command") and ac["command"][2] == "dotaclient_tpu.runtime.actor":
+            a = ac.get("args", [])
+            assert "--serve.endpoint" in a, f"{fname}: serve.endpoint not pinned"
+            assert a[a.index("--serve.endpoint") + 1] == "", (
+                f"{fname}: actors opt into the serve tier deliberately, "
+                f"server-first (MIGRATION)"
             )
 
 

@@ -464,7 +464,44 @@ def test_actor_fleet_scalars_are_registered():
         "actor_batch_occupancy",
         "actor_gather_wait_s",
         "actor_jit_step_s",
+        # rows-per-fired-tick occupancy histogram (registry PREFIXES
+        # family actor_tick_rows_): one bucket per k in 1..capacity
+        "actor_tick_rows_1",
+        "actor_tick_rows_2",
     }
+
+
+def test_serve_scalars_are_registered():
+    """The serve_* family (inference-service meters) is scrape-only like
+    actor_* — pin InferenceServer.stats() names against the registry
+    (the serve /metrics surface emits exactly these plus the batcher
+    family above)."""
+    from dotaclient_tpu.config import InferenceConfig, PolicyConfig, ServeConfig
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.serve.server import InferenceServer
+
+    server = InferenceServer(
+        InferenceConfig(
+            serve=ServeConfig(port=0, max_batch=2),
+            policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"),
+        )
+    )
+    stats = server.stats()  # constructed, never started: names only
+    missing = registry.unregistered(stats.keys())
+    assert not missing, f"serve scalars not in obs/registry.py: {missing}"
+    assert {
+        "serve_requests_total",
+        "serve_unknown_client_total",
+        "serve_bad_requests_total",
+        "serve_episode_resets_total",
+        "serve_evictions_total",
+        "serve_weight_swaps_total",
+        "serve_version",
+        "serve_clients_connected",
+        "serve_carries_resident",
+        "actor_batch_occupancy",  # the shared batcher family rides along
+        "actor_tick_rows_1",
+    } <= set(stats)
 
 
 def test_wire_scalars_are_registered_and_emitted_names_pinned():

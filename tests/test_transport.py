@@ -346,6 +346,56 @@ def test_wire_quant_ab_artifact_verdict():
     assert data["packer_only"]["speedup_x"] >= 1.5
 
 
+def test_wire_soak_artifact_verdict():
+    """Guard the COMMITTED WIRE_SOAK.json — the sign-off PR 8 gated the
+    prod bf16 flip on (k8s/actors.yaml now pins bf16; test_k8s ties the
+    pin to this verdict). All three fleet states must be green: zero
+    quarantines/bad drops, training through every phase, wire meters
+    walking exactly with the fleet, and the bytes-per-frame ratio in
+    the quantization band."""
+    import json
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "WIRE_SOAK.json"
+    data = json.loads(path.read_text())
+    assert data["verdict"]["ok"] is True, data["verdict"]
+    for phase in ("phase_1_all_f32", "phase_2_mixed", "phase_3_all_bf16"):
+        checks = data[phase]["checks"]
+        assert all(checks.values()), f"{phase}: {checks}"
+        assert data[phase]["quarantined_delta"] == 0
+    assert data["phase_2_mixed"]["frames_bf16"] > 0
+    assert data["phase_2_mixed"]["frames_f32"] > 0
+    assert 0.4 <= data["wire_bytes_per_frame_ratio_bf16_vs_f32"] <= 0.8
+
+
+@pytest.mark.nightly
+@pytest.mark.slow  # nightly AND slow: the tier-1 -m 'not slow' override
+def test_wire_soak_quick_nightly(tmp_path):
+    """Re-run the bf16 wire soak (--quick) in a clean subprocess: the
+    same invariants the committed artifact froze, at nightly scale."""
+    import json
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    from tests.conftest import clean_subprocess_env
+
+    script = pathlib.Path(__file__).resolve().parent.parent / "scripts" / "soak_wire_bf16.py"
+    out = tmp_path / "wire_soak.json"
+    proc = subprocess.run(
+        [sys.executable, str(script), "--quick", "--out", str(out)],
+        capture_output=True,
+        text=True,
+        timeout=570,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    data = json.loads(out.read_text())
+    assert data["verdict"]["ok"] is True, data["verdict"]
+
+
 @pytest.mark.nightly
 @pytest.mark.slow  # nightly AND slow: the tier-1 -m 'not slow' override
 def test_ab_wire_quant_nightly():
