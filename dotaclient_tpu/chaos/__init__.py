@@ -13,9 +13,10 @@ the env stub) in deterministic scheduled faults:
                       latency/stall around any Broker;
 - chaos/env.py        ChaosEnvStub: env latency + session-loss faults
                       inside the protocol the actor already handles;
-- chaos/controller.py broker AND learner kill/restart execution
-                      (kill@T:D@broker|learner[:term|kill] routing) +
-                      exact per-incarnation conservation ledgers.
+- chaos/controller.py broker, learner AND inference-server kill/restart
+                      execution (kill@T:D@broker|learner[:term|kill]|
+                      server routing) + exact per-incarnation
+                      conservation ledgers.
 
 Production inertness is a hard contract: binaries import this package
 ONLY under `--chaos.enabled` (k8s manifests pin it false), so the off
@@ -36,6 +37,7 @@ from dotaclient_tpu.chaos.controller import (
     BrokerIncarnations,
     LearnerIncarnations,
     ScheduleRunner,
+    ServeIncarnations,
 )
 from dotaclient_tpu.chaos.env import ChaosEnvStub
 from dotaclient_tpu.chaos.schedule import FaultSchedule, OpFaults, TimedEvent
@@ -48,6 +50,7 @@ __all__ = [
     "LearnerIncarnations",
     "OpFaults",
     "ScheduleRunner",
+    "ServeIncarnations",
     "TimedEvent",
     "wrap_broker",
     "wrap_env_stub",

@@ -1,4 +1,4 @@
-"""Kill/restart execution + post-mortem ledger harvesting, for the two
+"""Kill/restart execution + post-mortem ledger harvesting, for the
 stateful processes a client-side fault wrapper cannot kill.
 
 `BrokerIncarnations` owns a sequence of in-process tcp BrokerServer
@@ -12,9 +12,13 @@ SIGKILL (abort mid-flight, discard queued saves, nothing persisted
 beyond what already hit disk) variants — in-process for the same reason
 the broker is: a real kill -9 vaporizes the very counters the
 conservation proof needs, while the abandoned object still holds them.
-`ScheduleRunner` executes a FaultSchedule's kill events against either
-controller on a side thread, routed by the spec's kill-target selector
-(`kill@T:D@broker|learner[:sig]`, chaos/schedule.py).
+`ServeIncarnations` is the serving-tier third: sequential in-process
+InferenceServer lives on one port, with per-life ledgers (requests
+served, carries stranded at kill = episodes the kill abandoned,
+evictions, weight swaps). `ScheduleRunner` executes a FaultSchedule's
+kill events against any of the three on a side thread, routed by the
+spec's kill-target selector
+(`kill@T:D@broker|learner[:sig]|server`, chaos/schedule.py).
 
 Recovery-time probes: a broker incarnation records the monotonic time
 of its first post-boot enqueue (transport/tcp.py `first_enqueue_t`);
@@ -22,7 +26,9 @@ recovery after a broker kill = that minus the restart completion time —
 how long the fleet's jittered reconnect/backoff took to actually land a
 frame in the reborn broker. A learner incarnation's recovery = restart
 completion to its first post-restore trained step (the version counter
-advancing past the resumed high-water mark).
+advancing past the resumed high-water mark). A serve incarnation's
+recovery = restart completion to its first post-restart SERVED step
+(`first_request_t`, serve/server.py).
 """
 
 from __future__ import annotations
@@ -107,6 +113,124 @@ class BrokerIncarnations:
             }
             total["incarnations"] = len(self.ledgers)
             return total
+
+class ServeIncarnations:
+    """Sequential in-process InferenceServer lives on ONE port — the
+    serving-tier sibling of BrokerIncarnations, and the controller the
+    PR-9 `kill@T:D@server` routing stub existed for.
+
+    `make_server(port)` builds AND starts a fresh InferenceServer bound
+    to `port` (0 on the first boot picks a free one; every restart
+    reuses that port, so client endpoint lists stay valid across
+    lives). In-process for the same reason the broker/learner
+    controllers are: a real kill -9 vaporizes the counters the
+    conservation proof needs, while the abandoned object still holds
+    them — and stop() joins the serve loop, so each harvested ledger is
+    exact. A kill abandons every in-flight episode on that replica:
+    their resident carries die with the life. `carries_resident_at_kill`
+    is the server-side UPPER BOUND on those abandons (a carry also
+    stays resident between a client's episodes until reset/disconnect),
+    which the soak reconciles against the clients' exact
+    episodes_abandoned counters.
+
+    Recovery probe: `wait_first_request()` polls the reborn server's
+    `first_request_t` (the first SERVED post-restart step — the
+    first_enqueue_t analog); ScheduleRunner reports it as recovery_s.
+    """
+
+    def __init__(self, make_server: Callable[[int], object], port: int = 0):
+        self.make_server = make_server
+        self.server = make_server(port)
+        self.port = self.server.port
+        self.ledgers: List[dict] = []  # one per DEAD incarnation
+        self.kill_times: List[float] = []
+        self.restart_times: List[float] = []
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _harvest(server, chaos_kill: bool) -> dict:
+        """Stop `server` and read its exact counters. The resident-carry
+        count is snapshotted BEFORE stop(): the shutdown path runs the
+        handlers' eviction code, which would fold the carries this kill
+        stranded into the ordinary eviction counter."""
+        resident = sum(len(c.carries) for c in list(server._conns))
+        server.stop()
+        # The controller owns the life end-to-end: make_server built a
+        # fresh weights-broker client for it, so the kill closes it
+        # (stop() only joins the poll thread).
+        broker = getattr(server, "broker", None)
+        if broker is not None:
+            try:
+                broker.close()
+            except Exception:
+                pass
+        return {
+            "requests": int(server.requests_total),
+            "bad_requests": int(server.bad_requests_total),
+            "episode_resets": int(server.episode_resets_total),
+            "unknown_client": int(server.unknown_client_total),
+            "evictions": int(server.evictions_total),
+            "weight_swaps": int(server.weight_swaps_total),
+            "version": int(server.version),
+            "carries_resident_at_kill": int(resident),
+            "killed_at": time.monotonic() if chaos_kill else None,
+        }
+
+    def kill(self) -> dict:
+        """Stop the live incarnation and harvest its exact ledger."""
+        with self._lock:
+            if self.server is None:
+                raise RuntimeError("kill() with no live incarnation")
+            led = self._harvest(self.server, chaos_kill=True)
+            self.server = None
+            self.ledgers.append(led)
+            self.kill_times.append(led["killed_at"])
+            return led
+
+    def restart(self) -> None:
+        """Bring a fresh incarnation up on the SAME port. Bounded retry:
+        the dead server's socket can linger briefly, and start() raises
+        through the boot-error path when the bind fails."""
+        with self._lock:
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    self.server = self.make_server(self.port)
+                    break
+                except (RuntimeError, OSError):
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.2)
+            self.restart_times.append(time.monotonic())
+
+    def wait_first_request(self, timeout: float = 30.0, stop: Optional[threading.Event] = None):
+        """Monotonic time of the reborn incarnation's first served step
+        (None if none arrived in time) — the serve recovery probe."""
+        server = self.server
+        if server is None:
+            return None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and (stop is None or not stop.is_set()):
+            t = server.first_request_t
+            if t is not None:
+                return t
+            time.sleep(0.02)
+        return None
+
+    def final_ledger(self) -> dict:
+        """Stop the last incarnation (if live) and sum every life."""
+        with self._lock:
+            if self.server is not None:
+                self.ledgers.append(self._harvest(self.server, chaos_kill=False))
+                self.server = None
+            keys = (
+                "requests", "bad_requests", "episode_resets", "unknown_client",
+                "evictions", "weight_swaps", "carries_resident_at_kill",
+            )
+            total = {k: sum(l[k] for l in self.ledgers) for k in keys}
+            total["incarnations"] = len(self.ledgers)
+            return total
+
 
 class LearnerIncarnations:
     """Sequential in-process Learner lives sharing one checkpoint dir.
@@ -288,11 +412,10 @@ class ScheduleRunner:
         self.schedule = schedule
         self.broker = broker
         self.learner_inc = learner
-        # Routing STUB for kill@T:D@server (the inference service): any
-        # object with kill()/restart() routes; the real ServeIncarnations
-        # controller (in-process InferenceServer lives + carry-loss
-        # recovery probes) belongs to the serve chaos soak, out of scope
-        # this build (chaos/schedule.py grammar note).
+        # kill@T:D@server routing: ServeIncarnations is the real
+        # controller; any object with kill()/restart() still routes
+        # (duck-typed — the recovery probe engages only when the
+        # controller exposes wait_first_request).
         self.server_inc = server
         self.t0 = t0
         for ev in schedule.kills():
@@ -303,8 +426,8 @@ class ScheduleRunner:
             if ev.target == "server" and server is None:
                 raise ValueError(
                     "schedule kills the inference server but no server "
-                    "controller given (kill@..@server is a routing stub: "
-                    "supply an object with kill()/restart())"
+                    "controller given (supply a ServeIncarnations, or any "
+                    "object with kill()/restart())"
                 )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -326,7 +449,8 @@ class ScheduleRunner:
         return False
 
     def _run(self) -> None:
-        for k, ev in enumerate(self.schedule.kills()):
+        kills = self.schedule.kills()
+        for k, ev in enumerate(kills):
             if not self._sleep_until(ev.at_s):
                 return
             if ev.target == "learner":
@@ -348,21 +472,36 @@ class ScheduleRunner:
                 )
                 continue
             if ev.target == "server":
-                # Routing stub (see __init__): kill/restart the supplied
-                # controller; no recovery probe is defined yet — the
-                # serve soak will add one (first-post-restart tick, the
-                # first_enqueue_t analog).
                 self.server_inc.kill()
                 if not self._sleep_until(ev.at_s + ev.duration_s):
                     return
                 self.server_inc.restart()
+                restarted = time.monotonic()
+                # Recovery probe = first post-restart SERVED step
+                # (ServeIncarnations.wait_first_request); a bare
+                # kill()/restart() object (tests) reports None. The wait
+                # is bounded by the NEXT scheduled event: in a
+                # multi-replica topology sticky clients stay on the
+                # survivor, so a reborn replica can legitimately idle —
+                # a full 30s probe would silently push every later kill
+                # off its schedule.
+                probe = getattr(self.server_inc, "wait_first_request", None)
+                first = None
+                if probe is not None:
+                    budget = 30.0
+                    if k + 1 < len(kills):
+                        budget = max(
+                            0.5,
+                            min(budget, (self.t0 + kills[k + 1].at_s) - time.monotonic()),
+                        )
+                    first = probe(timeout=budget, stop=self._stop)
                 self.recovery.append(
                     {
                         "kill_index": k,
                         "target": "server",
                         "at_s": ev.at_s,
                         "down_s": round(ev.duration_s, 3),
-                        "recovery_s": None,
+                        "recovery_s": None if first is None else round(first - restarted, 3),
                     }
                 )
                 continue

@@ -29,18 +29,16 @@ Timed one-shots (wall-clock offsets from the schedule epoch `t0`):
                     or `term` (SIGTERM drain: train out staged batches,
                     full-state save, clean exit) — executed against a
                     LearnerIncarnations controller — or `server` (the
-                    inference service, dotaclient_tpu/serve/): the
-                    GRAMMAR and ScheduleRunner routing exist today, but
-                    only a routing stub backs them — a ServeIncarnations
-                    controller (sequential in-process InferenceServer
-                    lives + carry-loss/recovery probes) is the serve
-                    chaos soak's job, not this build's; a spec with a
-                    server kill therefore requires the caller to supply
-                    a controller with kill()/restart(). Timed events
-                    never consume per-op rate draws, so the selector
-                    leaves the canonical draw order of every existing
-                    spec untouched (pinned by the golden
-                    decision-sequence test in tests/test_chaos.py).
+                    inference service, dotaclient_tpu/serve/), executed
+                    against a ServeIncarnations controller (sequential
+                    in-process InferenceServer lives on one port,
+                    per-life ledgers, first-served-step recovery probe;
+                    scripts/soak_serve_chaos.py is the closed-loop
+                    proof). Timed events never consume per-op rate
+                    draws, so the selector leaves the canonical draw
+                    order of every existing spec untouched (pinned by
+                    the golden decision-sequence test in
+                    tests/test_chaos.py — including the server target).
 
 Determinism contract: the decision for operation index i draws from
 `random.Random(seed * 1_000_003 + i)` in a FIXED canonical order, for

@@ -199,12 +199,39 @@ class ServeClientConfig:
     path (the serve package is never imported — subprocess inertness
     proof in tests/test_serve.py)."""
 
-    # host:port of the inference service. "" (default) = local
-    # inference, exactly the pre-serve actor.
+    # Inference-service endpoint(s): "host:port" or a comma-separated
+    # failover list "h1:p1,h2:p2,...". Each client STICKS to one replica
+    # (server-side carry residency demands affinity) and fails over to
+    # the next healthy one on connection loss or reply-deadline expiry
+    # — in-flight episodes are abandoned (the UNKNOWN_CLIENT semantics),
+    # never split across replicas. "" (default) = local inference,
+    # exactly the pre-serve actor. Malformed lists fail loudly at boot.
     endpoint: str = ""
     # Per-request reply timeout, seconds: a server that dies without RST
     # must surface as a retryable RemoteInferenceError, not a hung env.
     timeout_s: float = 30.0
+    # Per-dial TCP connect + handshake timeout, seconds. Deliberately
+    # much shorter than timeout_s: a failover pass tries every healthy
+    # endpoint in sequence, and each dead-but-blackholed replica costs
+    # one of these.
+    connect_timeout_s: float = 5.0
+    # Seconds a failed endpoint sits out of the rotation before it is
+    # probed again — a flapping replica is not hammered, and a fleet's
+    # return-to-remote probes pace at this cadence.
+    cooldown_s: float = 5.0
+    # Graceful degradation: keep a broker-fanout-refreshed LOCAL param
+    # tree warm, and when EVERY endpoint has been down for longer than
+    # fallback_after_s, step episodes locally (versions stamped from the
+    # local tree under the PR-5 chunk-boundary rule) until an endpoint
+    # recovers — the fleet never stops generating experience, it just
+    # pays local compute. Default off: remote-only actors keep params=()
+    # and never pay a local init/compile.
+    fallback_local: bool = False
+    # All-endpoints-down budget before the local fallback engages,
+    # seconds. Size it to ride out a single replica restart (failover
+    # already covers those when a sibling replica is up): engaging is
+    # cheap but flips the fleet off the accelerator tier.
+    fallback_after_s: float = 10.0
 
 
 @dataclass

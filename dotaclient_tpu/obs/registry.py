@@ -128,6 +128,24 @@ SCALARS: Dict[str, str] = {
     "serve_version": "model version of the currently-serving param tree",
     "serve_clients_connected": "live client connections",
     "serve_carries_resident": "LSTM carries held server-side across all connections",
+    # --- serve-tier resilience, CLIENT side (serve/client.py
+    #     RemoteFleet.stats; scrape-only like actor_*) ------------------
+    "serve_failover_endpoints": "configured inference endpoints in the failover list",
+    "serve_failover_endpoints_down": "endpoints currently sitting out a health cooldown",
+    "serve_failover_total": "failovers to a different endpoint (cumulative)",
+    "serve_failover_reconnects_total": "reconnect dials attempted (cumulative)",
+    "serve_failover_episodes_abandoned_total": (
+        "episodes abandoned on remote-inference failure — connection "
+        "loss, reply deadline, UNKNOWN_CLIENT (the serve chaos soak's "
+        "explicit abandon ledger)"
+    ),
+    "serve_fallback_engaged": "1 while the local-policy fallback is stepping episodes",
+    "serve_fallback_engagements_total": (
+        "distinct fallback engagements — counted per outage, not per "
+        "return-to-remote probe cycle"
+    ),
+    "serve_fallback_steps_total": "policy steps served by the warm local tree (cumulative)",
+    "serve_fallback_version": "model version of the broker-fanout-refreshed local tree",
     # --- full-state checkpointing (runtime/checkpoint.py aux manifests,
     #     runtime/learner.py CheckpointWorker) — emitted only when
     #     --ckpt.full_state / --ckpt.async_save are on -----------------

@@ -8,6 +8,21 @@ stale-weights kill switch and the shed throttle are all the unchanged
 local code; `RemoteFleet` drives M remote env slots on one loop (the
 VectorActor topology with the batcher replaced by the server).
 
+Resilience (PR 10): `--serve.endpoint` accepts a comma-separated
+FAILOVER LIST. A client holds one live connection at a time — carry
+residency demands replica affinity — and on connection loss or reply
+deadline it abandons in-flight episodes (the UNKNOWN_CLIENT semantics),
+marks the endpoint down for `--serve.cooldown_s`, and reconnects to the
+next healthy endpoint through the shared transport/base.py RetryPolicy
+(jittered backoff, so a fleet's clients never stampede a reborn
+replica). When every endpoint has been down past
+`--serve.fallback_after_s` and `--serve.fallback_local` is on, episodes
+step LOCALLY against a broker-fanout-refreshed warm param tree
+(`LocalFallback`) until an endpoint recovers — engagement is
+episode-granular because mid-episode the true carry lives only on the
+dead server. Meters: the serve_failover_* / serve_fallback_* scalar
+families (obs/registry.py), exported by RemoteFleet.stats().
+
 What stays client-side vs moves server-side:
 
 - client OWNS: featurization, its rng stream (sent/advanced/returned
@@ -38,11 +53,14 @@ import time
 from typing import Dict, Optional
 
 import grpc
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dotaclient_tpu.config import ActorConfig
 from dotaclient_tpu.ops import action_dist as ad
-from dotaclient_tpu.runtime.actor import Actor, reset_env_stub
+from dotaclient_tpu.runtime.actor import Actor, apply_weight_frame, reset_env_stub
+from dotaclient_tpu.transport.base import RetryPolicy
 from dotaclient_tpu.serve import wire as W
 
 _log = logging.getLogger(__name__)
@@ -55,10 +73,44 @@ class RemoteInferenceError(ConnectionError):
     fresh one, exactly the lost-env-session path."""
 
 
+def parse_endpoints(spec: str):
+    """`host:port` or a comma-separated list of them → [(host, port)].
+
+    The config boundary for `--serve.endpoint`: a malformed list must
+    fail the actor LOUDLY at boot (ValueError), never degrade into a
+    silently-shorter failover rotation. Empty segments (``a:1,,b:2`` or
+    a trailing comma) are malformed for the same reason — they are
+    almost always a typo'd replica. An empty host defaults to 127.0.0.1
+    (the single-endpoint behavior since PR 9)."""
+    parts = str(spec).split(",")
+    out = []
+    for part in (p.strip() for p in parts):
+        if not part:
+            raise ValueError(
+                f"serve endpoint list has an empty entry: {spec!r} "
+                f"(expected host:port[,host:port...])"
+            )
+        host, sep, port = part.partition(":")
+        if not sep or not port:
+            raise ValueError(f"serve endpoint must be host:port, got {part!r}")
+        try:
+            port_n = int(port)
+        except ValueError:
+            raise ValueError(f"serve endpoint port is not an integer: {part!r}") from None
+        if not 0 < port_n < 65536:
+            raise ValueError(f"serve endpoint port out of range: {part!r}")
+        out.append((host or "127.0.0.1", port_n))
+    if not out:
+        raise ValueError("serve endpoint list is empty")
+    return out
+
+
 class RemotePolicyClient:
-    """One multiplexed connection to the inference server. All use is
-    single-event-loop asyncio (the actor process's loop); `step()` may
-    be in flight for many client_keys at once, at most one per key."""
+    """One multiplexed connection to the inference server (at most one
+    live replica at a time — affinity; see module docstring for the
+    failover rules). All use is single-event-loop asyncio (the actor
+    process's loop); `step()` may be in flight for many client_keys at
+    once, at most one per key."""
 
     def __init__(
         self,
@@ -66,11 +118,11 @@ class RemotePolicyClient:
         policy_cfg,
         wire_obs_dtype: str = "f32",
         timeout_s: float = 30.0,
+        connect_timeout_s: float = 5.0,
+        cooldown_s: float = 5.0,
+        retry: Optional[RetryPolicy] = None,
     ):
-        host, _, port = endpoint.partition(":")
-        if not port:
-            raise ValueError(f"serve endpoint must be host:port, got {endpoint!r}")
-        self.addr = (host or "127.0.0.1", int(port))
+        self.endpoints = parse_endpoints(endpoint)
         self.lstm_hidden = int(policy_cfg.lstm_hidden)
         if wire_obs_dtype in ("f32", "float32"):
             self._obs_bf16 = False
@@ -79,12 +131,41 @@ class RemotePolicyClient:
         else:
             raise ValueError(f"wire obs_dtype must be f32|bf16, got {wire_obs_dtype!r}")
         self.timeout_s = timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self.cooldown_s = cooldown_s
+        self.retry = retry if retry is not None else RetryPolicy()
+        # Per-endpoint health: monotonic time before which the endpoint
+        # sits out of the rotation. Sticky affinity: _ep is the index the
+        # client currently prefers; it only moves on failover.
+        self._down_until = [0.0] * len(self.endpoints)
+        self._ep = 0
+        # First monotonic instant at which the whole tier was known bad
+        # — the clock the local-fallback budget runs against. Latched
+        # when every endpoint is in cooldown at once AND when a full
+        # failover pass fails on every dialable candidate (slow
+        # blackholed dials stagger the cooldowns, so the simultaneous
+        # condition alone can never fire when cooldown_s <= dial time).
+        # Cleared ONLY by a successful connect — cooldown expiry makes
+        # an endpoint eligible again, it proves nothing recovered.
+        self.all_down_since: Optional[float] = None
+        self.failovers = 0
+        self.reconnects = 0
+        self._reconnect_backoff = self.retry.backoff_base_s
         self._reader = None
         self._writer = None
         self._reader_task: Optional[asyncio.Task] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._wlock: Optional[asyncio.Lock] = None
+        # Connect lock: persists ACROSS teardowns (nulling it with the
+        # connection let a sibling env start a second concurrent
+        # failover pass while one was mid-dial — two passes would race
+        # to commit _reader/_writer and the loser's orphan demux loop
+        # could later tear down the winner's healthy connection). It is
+        # replaced only when a DIFFERENT event loop drives the client
+        # (drivers that asyncio.run() per phase): asyncio primitives
+        # bind to their creating loop.
         self._connect_lock: Optional[asyncio.Lock] = None
+        self._connect_lock_loop = None
         # close() is TERMINAL: afterwards every step fails fast with
         # RemoteInferenceError instead of reconnecting. This is the
         # teardown backstop for the Python 3.10 wait_for cancel-swallow
@@ -100,6 +181,32 @@ class RemotePolicyClient:
         self.errors = 0
         self.latency_s = collections.deque(maxlen=100_000)
 
+    # --------------------------------------------------- endpoint health
+
+    @property
+    def addr(self):
+        """(host, port) the client currently prefers (sticky)."""
+        return self.endpoints[self._ep]
+
+    def has_healthy_endpoint(self) -> bool:
+        """True if any endpoint is in rotation (cooldown expired).
+        'Healthy' means ELIGIBLE, not proven — only a successful connect
+        proves recovery (and clears all_down_since)."""
+        now = time.monotonic()
+        return any(t <= now for t in self._down_until)
+
+    def endpoints_down(self) -> int:
+        now = time.monotonic()
+        return sum(1 for t in self._down_until if t > now)
+
+    def _mark_down(self, idx: int) -> None:
+        now = time.monotonic()
+        self._down_until[idx] = now + self.cooldown_s
+        if self.all_down_since is None and not any(t <= now for t in self._down_until):
+            self.all_down_since = now
+
+    # ------------------------------------------------------- connection
+
     async def _ensure_connected(self) -> None:
         if self._closed:
             raise RemoteInferenceError("client is closed")
@@ -108,35 +215,104 @@ class RemotePolicyClient:
         # Serialize connection setup: M envs fire their first steps
         # concurrently, and without the lock each would dial its own
         # socket and clobber the others' reader/writer mid-handshake.
-        if self._connect_lock is None:
+        loop = asyncio.get_running_loop()
+        if self._connect_lock is None or self._connect_lock_loop is not loop:
             self._connect_lock = asyncio.Lock()
+            self._connect_lock_loop = loop
         async with self._connect_lock:
             if self._writer is not None:
                 return  # a sibling env connected while we waited
-            try:
-                self._reader, self._writer = await asyncio.wait_for(
-                    asyncio.open_connection(*self.addr), self.timeout_s
+            if self._closed:
+                raise RemoteInferenceError("client is closed")
+            # One failover pass: candidates in sticky-first rotation
+            # order, restricted to endpoints whose cooldown expired. No
+            # inner retry loop — the episode retry loop above this client
+            # is the outer loop, and each pass pays at most one jittered
+            # backoff sleep per additional candidate (the shared
+            # RetryPolicy shape, so a fleet never stampedes a replica).
+            now = time.monotonic()
+            n = len(self.endpoints)
+            candidates = [
+                i
+                for i in ((self._ep + k) % n for k in range(n))
+                if self._down_until[i] <= now
+            ]
+            if not candidates:
+                if self.all_down_since is None:
+                    self.all_down_since = now
+                raise RemoteInferenceError(
+                    f"all {n} serve endpoints down (cooldown {self.cooldown_s}s)"
                 )
-                # Handshake BEFORE the demux loop starts (sequential
-                # read): the server must agree on the carry width or
-                # every response would deframe wrong.
-                self._writer.write(W.frame(W.S_INFO, b""))
-                await self._writer.drain()
-                mtype, payload = await asyncio.wait_for(
-                    W.read_frame(self._reader), self.timeout_s
-                )
-            except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError, ValueError) as e:
-                await self._teardown()
-                raise RemoteInferenceError(f"connect to {self.addr} failed: {e}") from e
-            try:
-                self._finish_handshake(mtype, payload)
-            except ValueError:
-                # policy mismatch is NOT retryable — a config error, not
-                # an outage; tear down and let it propagate loudly
-                await self._teardown()
-                raise
+            last_err: Optional[BaseException] = None
+            for k, i in enumerate(candidates):
+                if k > 0:
+                    await asyncio.sleep(self.retry.sleep_for(self._reconnect_backoff))
+                    self._reconnect_backoff = self.retry.next_backoff(self._reconnect_backoff)
+                if self._closed:
+                    raise RemoteInferenceError("client is closed")
+                self.reconnects += 1
+                try:
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection(*self.endpoints[i]),
+                        self.connect_timeout_s,
+                    )
+                except (OSError, asyncio.TimeoutError) as e:
+                    self._mark_down(i)
+                    last_err = e
+                    continue
+                try:
+                    # Handshake BEFORE the demux loop starts (sequential
+                    # read): the server must agree on the carry width or
+                    # every response would deframe wrong.
+                    writer.write(W.frame(W.S_INFO, b""))
+                    await writer.drain()
+                    mtype, payload = await asyncio.wait_for(
+                        W.read_frame(reader), self.connect_timeout_s
+                    )
+                except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError, ValueError) as e:
+                    self._mark_down(i)
+                    last_err = e
+                    writer.close()
+                    continue
+                try:
+                    self._check_server_info(mtype, payload)
+                except ValueError:
+                    # policy mismatch is NOT retryable — a config error,
+                    # not an outage; fail loudly, don't rotate onward (a
+                    # mixed-policy endpoint list is operator error).
+                    writer.close()
+                    raise
+                if self._closed:
+                    # close() landed while we were dialing: a swallowed
+                    # cancel must not resurrect the connection (the PR-5
+                    # wait_for lesson) — drop the socket and fail fast.
+                    writer.close()
+                    raise RemoteInferenceError("client is closed")
+                if i != self._ep:
+                    self.failovers += 1
+                    _log.warning(
+                        "serve client: failed over %s -> %s",
+                        self.endpoints[self._ep],
+                        self.endpoints[i],
+                    )
+                self._ep = i
+                self._down_until[i] = 0.0
+                self.all_down_since = None
+                self._reconnect_backoff = self.retry.backoff_base_s
+                self._reader, self._writer = reader, writer
+                self._wlock = asyncio.Lock()
+                self._reader_task = asyncio.ensure_future(self._read_loop(reader, writer))
+                return
+            # Every dialable candidate just failed and the rest sit in
+            # cooldown: the tier is down NOW, whatever the staggered
+            # cooldown clocks say — latch the fallback budget's epoch.
+            if self.all_down_since is None:
+                self.all_down_since = time.monotonic()
+            raise RemoteInferenceError(
+                f"connect failed on every healthy endpoint (last: {last_err})"
+            )
 
-    def _finish_handshake(self, mtype: int, payload: bytes) -> None:
+    def _check_server_info(self, mtype: int, payload: bytes) -> None:
         import json
 
         info = json.loads(payload) if mtype == W.R_INFO else {}
@@ -146,10 +322,8 @@ class RemotePolicyClient:
                 f"expects lstm_hidden={self.lstm_hidden}"
             )
         self.server_info = info
-        self._wlock = asyncio.Lock()
-        self._reader_task = asyncio.ensure_future(self._read_loop(self._reader))
 
-    async def _read_loop(self, reader) -> None:
+    async def _read_loop(self, reader, writer) -> None:
         import struct
 
         try:
@@ -164,20 +338,46 @@ class RemotePolicyClient:
         except asyncio.CancelledError:
             pass
         except Exception as e:
+            if self._writer is not writer:
+                # Stale loop: the connection it served was already
+                # replaced, and whoever replaced it failed this loop's
+                # pending futures — cleaning up here would tear down
+                # the SUCCESSOR's healthy connection.
+                return
+            # The replica died under us (mid-tick kill, RST): take the
+            # endpoint out of rotation and drop the connection NOW —
+            # synchronous cleanup, since this IS the reader task and
+            # cannot await its own cancellation via _teardown — so the
+            # very next step() fails over instead of burning one more
+            # write+drain against a dead socket.
             exc = RemoteInferenceError(f"server connection lost: {e}")
+            if not self._closed:
+                self._mark_down(self._ep)
+            self._writer = None
+            self._reader = None
+            self._wlock = None
+            self._reader_task = None
+            try:
+                writer.close()
+            except Exception:
+                pass
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(exc)
             self._pending.clear()
 
     async def _teardown(self) -> None:
+        if not self._closed and self._writer is not None:
+            # A live connection died under us (reply deadline, RST,
+            # demux failure): the endpoint serving it is suspect — take
+            # it out of rotation so the next connect prefers a sibling.
+            # Deliberate close() marks nothing (the endpoints are fine).
+            self._mark_down(self._ep)
         task, self._reader_task = self._reader_task, None
         writer, self._writer = self._writer, None
         self._reader = None
-        # Drop the asyncio primitives with the connection: they bind to
-        # the loop that created them, and a reconnect may happen on a
-        # different loop (drivers that asyncio.run() per phase).
-        self._connect_lock = None
+        # The write lock dies with its connection; the CONNECT lock
+        # survives (see __init__ — cross-loop reuse replaces it there).
         self._wlock = None
         if task is not None:
             task.cancel()
@@ -266,6 +466,64 @@ class RemotePolicyClient:
         }
 
 
+def _client_from_cfg(cfg: ActorConfig) -> RemotePolicyClient:
+    """Build the wire client from the --serve.* / --retry.* surface (the
+    one place config names map onto client kwargs)."""
+    return RemotePolicyClient(
+        cfg.serve.endpoint,
+        cfg.policy,
+        wire_obs_dtype=cfg.wire.obs_dtype,
+        timeout_s=cfg.serve.timeout_s,
+        connect_timeout_s=cfg.serve.connect_timeout_s,
+        cooldown_s=cfg.serve.cooldown_s,
+        retry=RetryPolicy.from_config(cfg.retry),
+    )
+
+
+class LocalFallback:
+    """The graceful-degradation half of `--serve.fallback_local`: a warm
+    LOCAL param tree (init'd from cfg.seed, the actor-boot convention)
+    refreshed from the broker weight fanout at chunk boundaries, plus
+    the one shared B=1 jit step that serves every env slot of a process
+    when the serve tier is unreachable. One instance per process (fleet
+    slots share their owner's): one tree, one compile, one weight poll
+    stream. Engagement state lives here too so a fleet engages/disengages
+    as a unit and the serve_fallback_* meters read one truth."""
+
+    def __init__(self, cfg: ActorConfig, broker):
+        from dotaclient_tpu.models import policy as P
+        from dotaclient_tpu.runtime.actor import make_actor_step
+
+        self.broker = broker
+        # apply_weight_frame contract: params/version/weight_epoch/
+        # last_weight_time live on this object.
+        self.params = P.init_params(cfg.policy, jax.random.PRNGKey(cfg.seed))
+        self.version = 0
+        self.weight_epoch = None
+        self.last_weight_time = time.monotonic()
+        # jit is lazy: nothing compiles until the first engaged step.
+        self.step_fn = make_actor_step(cfg)
+        self.engaged = False
+        self.engagements = 0
+        self.steps_total = 0
+        # Return-to-remote probe pacing clock (see
+        # RemoteActor._decide_local_episode): one probe episode per
+        # cooldown_s while engaged, fleet-wide (shared instance).
+        self.last_probe_t = 0.0
+
+    def poll(self) -> bool:
+        """Apply a pending weight-fanout frame to the warm tree (the
+        actor hot-swap rules: epoch resync, never-regress)."""
+        try:
+            frame = self.broker.poll_weights()
+        except Exception as e:  # broker outage: keep the current tree warm
+            _log.warning("serve-fallback: weight poll failed (%s); retrying", e)
+            return False
+        if frame is None:
+            return False
+        return apply_weight_frame(self, frame, "serve-fallback")
+
+
 class RemoteActor(Actor):
     """The classic Actor with inference served remotely. Everything else
     — featurize, chunking, publish path (including the PR-8 wire cast),
@@ -273,27 +531,40 @@ class RemoteActor(Actor):
 
     _RETRYABLE_EPISODE_ERRORS = (grpc.aio.AioRpcError, RemoteInferenceError)
 
-    def __init__(self, cfg: ActorConfig, broker, actor_id: int = 0, stub=None, client=None):
+    def __init__(
+        self, cfg: ActorConfig, broker, actor_id: int = 0, stub=None, client=None,
+        fallback: Optional[LocalFallback] = None,
+    ):
         if cfg.policy.arch != "lstm":
             raise ValueError(
                 "remote inference requires policy.arch='lstm' (server-side "
                 "carry residency)"
             )
         self._owns_client = client is None
-        self.remote_policy = (
-            client
-            if client is not None
-            else RemotePolicyClient(
-                cfg.serve.endpoint,
-                cfg.policy,
-                wire_obs_dtype=cfg.wire.obs_dtype,
-                timeout_s=cfg.serve.timeout_s,
-            )
-        )
+        self.remote_policy = client if client is not None else _client_from_cfg(cfg)
         # params=(): the server owns the tree; nothing local ever applies
         # it (maybe_update_weights is overridden) and init_params here
-        # would burn a full net init per env slot for nothing.
+        # would burn a full net init per env slot for nothing. The
+        # fallback tree (when configured) lives on LocalFallback, shared
+        # fleet-wide — never on self.params.
         super().__init__(cfg, broker, actor_id=actor_id, stub=stub, params=())
+        # Graceful degradation: fleet env slots share their owner's
+        # LocalFallback (one tree/compile per process); a standalone
+        # remote actor owns its own when configured.
+        self._fallback = (
+            fallback
+            if fallback is not None
+            else (LocalFallback(cfg, broker) if cfg.serve.fallback_local else None)
+        )
+        # Mode is decided ONCE per episode (at the episode_start step):
+        # mid-episode the true carry lives server-side only, so a
+        # mid-episode switch has nothing correct to resume from — the
+        # failure path is abandon-and-restart, never migrate.
+        self._episode_local = False
+        # Episodes abandoned on remote-inference failure (connection
+        # loss, reply deadline, UNKNOWN_CLIENT) — the explicit ledger the
+        # serve chaos soak reconciles against server lives.
+        self.episodes_abandoned = 0
         # Version stamping state (the PR-5 chunk-boundary rule):
         # responses report the version their TICK was served by;
         # self.version — what chunks are stamped with — syncs to it only
@@ -306,6 +577,68 @@ class RemoteActor(Actor):
         # there); a stand-in mid-chunk, where nothing consumes it.
         self._episode_state = None
 
+    def _decide_local_episode(self) -> bool:
+        """Episode-start mode decision for --serve.fallback_local. Local
+        once the tier has been down (all_down_since latched) longer than
+        the fallback budget. While engaged, return-to-remote PROBES pace
+        on their own clock (one per cooldown_s, and only when some
+        endpoint's cooldown expired) WITHOUT disengaging: a successful
+        probe clears all_down_since and the next decision disengages; a
+        failed probe re-marks and fallback resumes — so `engagements`
+        counts real outages, not probe cycles. The probe clock is
+        deliberately NOT per-endpoint health (slow blackholed dials
+        stagger the cooldowns so that some endpoint is almost always
+        'eligible' — pacing on that would turn the whole fleet into a
+        probe loop and starve the fallback)."""
+        fb = self._fallback
+        if fb is None:
+            return False
+        client = self.remote_policy
+        since = client.all_down_since
+        now = time.monotonic()
+        if since is None:
+            if fb.engaged:
+                fb.engaged = False
+                _log.warning(
+                    "actor %d: serve fallback DISENGAGED (endpoint recovered)",
+                    self.actor_id,
+                )
+            return False
+        if now - since < self.cfg.serve.fallback_after_s:
+            return False  # pre-budget: keep trying remote
+        if not fb.engaged:
+            fb.engaged = True
+            fb.engagements += 1
+            fb.last_probe_t = now  # first probe one cooldown from engage
+            _log.warning(
+                "actor %d: serve fallback ENGAGED (all %d endpoints down > %.1fs) "
+                "— stepping locally at v%d",
+                self.actor_id,
+                len(client.endpoints),
+                self.cfg.serve.fallback_after_s,
+                fb.version,
+            )
+        elif client.has_healthy_endpoint() and now - fb.last_probe_t >= client.cooldown_s:
+            fb.last_probe_t = now
+            return False  # probe remote this episode (see docstring)
+        # Episode start is a chunk boundary and nothing of this episode
+        # exists yet: snap the stamp to the tree that will actually
+        # generate it (the PR-5 rule's degenerate safe case — a stale
+        # _seen_version stamp here could UNDER-age local rows).
+        self.version = int(fb.version)
+        return True
+
+    async def _local_step(self, state, obs):
+        """One B=1 local step against the warm fallback tree — bitwise
+        the standalone Actor's step for the same (params, state, obs,
+        rng), because it IS that step (LocalFallback.step_fn is
+        make_actor_step)."""
+        fb = self._fallback
+        fb.steps_total += 1
+        obs_b = jax.tree.map(lambda x: jnp.asarray(x)[None], obs)
+        state, action, logp, value, self.rng = fb.step_fn(fb.params, state, obs_b, self.rng)
+        return state, action, logp, value
+
     async def _policy_step(
         self, state, obs, chunk_len: int = 0, episode_start: bool = False
     ):
@@ -315,13 +648,29 @@ class RemoteActor(Actor):
         start and chunk-fill steps, whose value becomes the next chunk's
         wire initial_state). The one place a stand-in reaches next_chunk
         — an episode that ends mid-chunk — builds a chunk run_episode
-        provably discards (the while-not-done loop exits)."""
+        provably discards (the while-not-done loop exits).
+
+        With the local fallback engaged the episode steps locally
+        instead: state threading is then the classic Actor's (every
+        returned carry real)."""
+        if episode_start:
+            self._episode_local = self._decide_local_episode()
+        if self._episode_local:
+            return await self._local_step(state, obs)
         if episode_start:
             self._episode_state = state  # the true zero carry, [1, H] pair
         want_carry = chunk_len + 1 >= self.cfg.rollout_len
-        res = await self.remote_policy.step(
-            self.actor_id, obs, self.rng, episode_start=episode_start, want_carry=want_carry
-        )
+        try:
+            res = await self.remote_policy.step(
+                self.actor_id, obs, self.rng, episode_start=episode_start, want_carry=want_carry
+            )
+        except RemoteInferenceError:
+            # This episode is now abandoned (the exception exits
+            # run_episode): ledger it explicitly — the serve chaos soak
+            # reconciles these against server lives, and silence here
+            # would make a kill's cost invisible.
+            self.episodes_abandoned += 1
+            raise
         self.rng = res.rng
         if res.version != self._seen_version:
             # A version ADVANCE observed through serving is the weight
@@ -348,10 +697,24 @@ class RemoteActor(Actor):
         return self._episode_state, action, logp, value
 
     def maybe_update_weights(self) -> bool:
-        """No broker weight subscription in remote mode — the server
-        owns the tree. This is the chunk-boundary STAMP sync only."""
-        changed = self.version != self._seen_version
-        self.version = self._seen_version
+        """No broker weight subscription for the SERVED tree — the
+        server owns it; this is the chunk-boundary STAMP sync. With the
+        local fallback configured it additionally refreshes the warm
+        tree from the broker fanout (params swap immediately, stamps
+        sync here — the VectorActor immediate-swap/boundary-stamp
+        semantics), and in a local episode the stamp tracks the local
+        tree's version instead of the last served one."""
+        fb = self._fallback
+        if fb is not None:
+            fb.poll()
+            # Fallback weight arrivals count as freshness for the kill
+            # switch: a dead serve tier with a live learner fanout must
+            # not kill actors that are still generating (locally).
+            if fb.last_weight_time > self.last_weight_time:
+                self.last_weight_time = fb.last_weight_time
+        target = fb.version if (fb is not None and self._episode_local) else self._seen_version
+        changed = self.version != target
+        self.version = int(target)
         return changed
 
     async def run(self, num_episodes: Optional[int] = None) -> None:
@@ -371,7 +734,11 @@ class _RemoteEnvActor(RemoteActor):
     def __init__(self, owner: "RemoteFleet", actor_id: int):
         self.owner = owner  # before super().__init__: _make_obs_runtime reads it
         super().__init__(
-            owner.cfg, owner.broker, actor_id=actor_id, client=owner.client
+            owner.cfg,
+            owner.broker,
+            actor_id=actor_id,
+            client=owner.client,
+            fallback=owner.fallback,
         )
 
     def _make_obs_runtime(self):
@@ -386,22 +753,20 @@ class RemoteFleet:
     `actor_id * M + j`, the same id scheme as VectorActor, so frames are
     byte-identical to standalone actors with those ids."""
 
-    def __init__(self, cfg: ActorConfig, broker, actor_id: int = 0, envs: Optional[int] = None, client=None, obs_runtime=None):
+    def __init__(self, cfg: ActorConfig, broker, actor_id: int = 0, envs: Optional[int] = None, client=None, obs_runtime=None, fallback: Optional[LocalFallback] = None):
         M = int(envs if envs is not None else getattr(cfg, "envs_per_process", 1))
         if M < 1:
             raise ValueError(f"envs must be >= 1, got {M}")
         self.cfg = cfg
         self.broker = broker
         self.actor_id = actor_id
-        self.client = (
-            client
-            if client is not None
-            else RemotePolicyClient(
-                cfg.serve.endpoint,
-                cfg.policy,
-                wire_obs_dtype=cfg.wire.obs_dtype,
-                timeout_s=cfg.serve.timeout_s,
-            )
+        self.client = client if client is not None else _client_from_cfg(cfg)
+        # ONE warm fallback tree per process, shared by every env slot
+        # (the VectorActor shared-params topology).
+        self.fallback = (
+            fallback
+            if fallback is not None
+            else (LocalFallback(cfg, broker) if cfg.serve.fallback_local else None)
         )
         if obs_runtime is not None:
             self.obs = obs_runtime
@@ -416,7 +781,8 @@ class RemoteFleet:
     @classmethod
     def from_actor(cls, actor: RemoteActor, envs: Optional[int] = None) -> "RemoteFleet":
         """Wrap a constructed RemoteActor (ActorPool's envs-per-actor
-        mode): same cfg/broker/actor_id, shared client + ObsRuntime."""
+        mode): same cfg/broker/actor_id, shared client + ObsRuntime +
+        warm fallback tree (when configured)."""
         return cls(
             actor.cfg,
             actor.broker,
@@ -424,6 +790,7 @@ class RemoteFleet:
             envs=envs,
             client=actor.remote_policy,
             obs_runtime=actor.obs,
+            fallback=actor._fallback,
         )
 
     # aggregate counters (driver/bench surface, the VectorActor shape)
@@ -448,17 +815,34 @@ class RemoteFleet:
         return sum(e.publish_throttle.failed for e in self.envs)
 
     def stats(self) -> dict:
-        shed = failed = 0
+        shed = failed = abandoned = 0
         throttle_s = 0.0
         for e in self.envs:
             t = e.publish_throttle
             shed += t.shed
             failed += t.failed
             throttle_s += t.throttle_s
+            abandoned += e.episodes_abandoned
+        c = self.client
+        fb = self.fallback
         return {
             "broker_shed_observed_total": float(shed),
             "broker_shed_publish_failed_total": float(failed),
             "broker_shed_throttle_s": throttle_s,
+            # Failover health (serve_failover_* family, obs/registry.py):
+            # endpoint rotation state + the explicit abandoned-episode
+            # ledger the serve chaos soak reconciles.
+            "serve_failover_endpoints": float(len(c.endpoints)),
+            "serve_failover_endpoints_down": float(c.endpoints_down()),
+            "serve_failover_total": float(c.failovers),
+            "serve_failover_reconnects_total": float(c.reconnects),
+            "serve_failover_episodes_abandoned_total": float(abandoned),
+            # Local-fallback engagement (serve_fallback_* family): all
+            # zero when --serve.fallback_local is off.
+            "serve_fallback_engaged": 1.0 if (fb is not None and fb.engaged) else 0.0,
+            "serve_fallback_engagements_total": float(fb.engagements) if fb else 0.0,
+            "serve_fallback_steps_total": float(fb.steps_total) if fb else 0.0,
+            "serve_fallback_version": float(fb.version) if fb else 0.0,
         }
 
     async def _env_loop(self, env: _RemoteEnvActor, results: "asyncio.Queue") -> None:
@@ -471,16 +855,32 @@ class RemoteFleet:
             except env._RETRYABLE_EPISODE_ERRORS as e:
                 if self._stopping:
                     return  # teardown: the failure IS the closed client
+                # Fallback-aware pacing: once every endpoint is down and
+                # the budget has run out, the next episode steps LOCALLY
+                # — backing off here would idle an env the fallback
+                # exists to keep generating. Before the budget expires,
+                # sleep only up to its remainder (the pre-engagement
+                # failures are cheap fail-fasts, not reconnect storms).
+                delay = backoff
+                if self.fallback is not None and isinstance(e, RemoteInferenceError):
+                    since = self.client.all_down_since
+                    if since is not None:
+                        remaining = self.cfg.serve.fallback_after_s - (
+                            time.monotonic() - since
+                        )
+                        if remaining <= 0:
+                            continue  # fallback serves the next episode now
+                        delay = min(backoff, remaining)
                 _log.warning(
                     "remote env %d: episode failed (%s: %s); retrying in %.1fs",
                     env.actor_id,
                     type(e).__name__,
                     e.code() if isinstance(e, grpc.aio.AioRpcError) else e,
-                    backoff,
+                    delay,
                 )
                 if isinstance(e, grpc.aio.AioRpcError):
                     await reset_env_stub(env)  # drop the dead env subchannel
-                await asyncio.sleep(backoff)
+                await asyncio.sleep(delay)
                 backoff = min(backoff * 2.0, 30.0)
                 continue
             except asyncio.CancelledError:

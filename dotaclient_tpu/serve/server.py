@@ -134,6 +134,11 @@ class InferenceServer:
         self.batcher = _ServeBatcher(bcfg, lambda: self._bundle, capacity=cfg.serve.max_batch)
         # Loop-thread-written counters; stats() takes GIL-atomic single
         # reads (the BrokerServer ledger pattern — exact after stop()).
+        # first_request_t is the recovery probe (the broker
+        # first_enqueue_t analog): monotonic time of the first SERVED
+        # step since boot — ServeIncarnations turns kill-restart-this
+        # into a failover recovery_s.
+        self.first_request_t: Optional[float] = None
         self.requests_total = 0
         self.unknown_client_total = 0
         self.bad_requests_total = 0
@@ -250,6 +255,8 @@ class InferenceServer:
         row, version, tick = await self.batcher.step(
             state, self._canon_obs(req.obs), req.rng
         )
+        if self.first_request_t is None:
+            self.first_request_t = time.monotonic()
         new_state, action, logp, value, rng2 = row
         new_state = jax.tree.map(np.asarray, new_state)
         conn.carries[req.client_key] = new_state

@@ -1,0 +1,649 @@
+"""Serve chaos soak: the resilient-serving proof → SERVE_CHAOS_SOAK.json.
+
+The PR-9 inference tier made the server a single point of failure; this
+soak proves the PR-10 resilience story end-to-end against REAL
+in-process InferenceServer kills (chaos/controller.py ServeIncarnations
+behind the `kill@T:D@server` grammar), in three phases:
+
+1. PARITY — two identical remote-fleet arms (M envs sharing one
+   multiplexed client against a serve replica, deterministic local fake
+   envs, no weight fanout so both arms serve version 0): arm A runs
+   undisturbed, arm B takes scheduled server kills mid-stream. Every
+   frame an env published BEFORE its first kill-induced abandon must be
+   BITWISE identical to arm A's (rows untouched by any kill), and the
+   abandons themselves are explicitly ledgered client-side
+   (episodes_abandoned) and server-side (carries stranded at kill).
+
+2. FAILOVER — TWO serve replicas, a live learner (real tcp broker:
+   experience in, weight fanout out, both replicas hot-swapping), and a
+   ScheduleRunner alternating kills across the replicas: the fleet
+   must fail over to the surviving replica within the recovery budget
+   (client-side probe: first successful remote step after each kill)
+   and the frame-conservation ledger must balance with ZERO unaccounted
+   frames — a kill abandons episodes (ledgered), it never silently
+   loses published frames.
+
+3. FALLBACK — one replica, `--serve.fallback_local` armed: a kill
+   longer than the budget must ENGAGE the local fallback no earlier
+   than `fallback_after_s` after the outage starts, the fleet must keep
+   publishing during the outage from the broker-fanout-refreshed warm
+   tree (version > 0 — the tree really was refreshed), and the restart
+   must DISENGAGE it (remote steps resume, engaged drops to 0) —
+   exactly one engagement for exactly one outage.
+
+Conservation (phases 2+3, one broker lineage): every producer counts
+attempted = acked + shed + failed; the experience broker's exact
+post-stop ledger satisfies enqueued = popped + dropped_oldest +
+resident; and unaccounted := popped - reply_lost - staging_consumed is
+asserted ZERO.
+
+Run: python scripts/soak_serve_chaos.py                        # committed artifact
+     python scripts/soak_serve_chaos.py --quick --out /tmp/x   # nightly wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import struct
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SENTINEL_WARM_ID = 999_999
+
+
+def _tiny_policy():
+    from dotaclient_tpu.config import PolicyConfig
+
+    return PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+def _make_serve_inc(policy, seed, max_batch, weights_port=None):
+    """ServeIncarnations whose lives poll the shared weight fanout
+    (weights_port=None ⇒ version-0 serving, the parity phase)."""
+    from dotaclient_tpu.chaos import ServeIncarnations
+    from dotaclient_tpu.config import InferenceConfig, ServeConfig
+    from dotaclient_tpu.serve.server import InferenceServer
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.tcp import TcpBroker
+
+    def make_server(port):
+        cfg = InferenceConfig(
+            serve=ServeConfig(
+                port=port, max_batch=max_batch, gather_window_s=0.002, weight_poll_s=0.05
+            ),
+            policy=policy,
+            seed=seed,
+        )
+        broker = (
+            TcpBroker(port=weights_port, retry=RetryPolicy(window_s=5.0))
+            if weights_port
+            else None
+        )
+        return InferenceServer(cfg, broker=broker).start()
+
+    return ServeIncarnations(make_server, port=0)
+
+
+def _acfg(
+    policy,
+    endpoint,
+    env_addr="local",
+    seed=100,
+    cooldown_s=0.4,
+    fallback_local=False,
+    fallback_after_s=1.0,
+):
+    from dotaclient_tpu.config import ActorConfig, RetryConfig, ServeClientConfig
+
+    return ActorConfig(
+        env_addr=env_addr,
+        rollout_len=8,
+        max_dota_time=4.0,
+        policy=policy,
+        seed=seed,
+        max_weight_age_s=0.0,  # kills legitimately pause version advance
+        serve=ServeClientConfig(
+            endpoint=endpoint,
+            timeout_s=6.0,
+            connect_timeout_s=1.5,
+            cooldown_s=cooldown_s,
+            fallback_local=fallback_local,
+            fallback_after_s=fallback_after_s,
+        ),
+        retry=RetryConfig(window_s=5.0, backoff_base_s=0.05, backoff_cap_s=0.5),
+    )
+
+
+class _ReplicaRouter:
+    """kill()/restart() router over N ServeIncarnations: ScheduleRunner
+    drives ONE controller, the router fans its sequential kill events
+    across replicas round-robin (kill rep0, restart rep0, kill rep1,
+    ...) so one schedule exercises a kill of EACH replica. Kill events
+    never overlap (the runner is a single thread), so the pending index
+    is a simple stack."""
+
+    def __init__(self, incs):
+        self.incs = incs
+        self._next = 0
+        self._pending = []
+
+    def kill(self):
+        i = self._next % len(self.incs)
+        self._next += 1
+        self._pending.append(i)
+        return self.incs[i].kill()
+
+    def restart(self):
+        self.incs[self._pending[-1]].restart()
+
+    def wait_first_request(self, timeout=30.0, stop=None):
+        # ScheduleRunner already bounds the probe by its next scheduled
+        # event; client-side recovery (first successful remote step) is
+        # the failover phase's actual criterion.
+        return self.incs[self._pending[-1]].wait_first_request(timeout, stop)
+
+
+# --------------------------------------------------------------- phase 1
+
+
+def _run_parity_arm(policy, envs, episodes_per_env, kills_spec, seed, mem_name, deadline_s):
+    """One parity arm: M RemoteActors sharing one multiplexed client
+    against a fresh serve replica; returns (frames by actor_id,
+    per-env first-abandon frame counts, abandons, ledgers)."""
+    from dotaclient_tpu.chaos import FaultSchedule, ScheduleRunner
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.env.service import LocalDotaServiceStub
+    from dotaclient_tpu.serve.client import (
+        RemoteActor,
+        RemoteInferenceError,
+        _client_from_cfg,
+    )
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+    from dotaclient_tpu.transport.serialize import deserialize_rollout
+
+    inc = _make_serve_inc(policy, seed=1, max_batch=envs)
+    mem.reset(mem_name)
+    broker = connect(f"mem://{mem_name}")
+    cfg = _acfg(policy, f"127.0.0.1:{inc.port}", seed=seed, cooldown_s=0.3)
+    client = _client_from_cfg(cfg)
+    actors = [
+        RemoteActor(
+            cfg,
+            broker,
+            actor_id=j,
+            stub=LocalDotaServiceStub(FakeDotaService()),
+            client=client,
+        )
+        for j in range(envs)
+    ]
+    # first_abandon[actor_id] = frames published BEFORE the first
+    # kill-induced abandon — the exact bitwise-parity cut for that env.
+    first_abandon = {}
+    deadline = time.monotonic() + deadline_s
+
+    runner = None
+    if kills_spec:
+        schedule = FaultSchedule.parse(kills_spec, seed=0)
+        runner = ScheduleRunner(schedule, broker=None, t0=time.monotonic(), server=inc)
+
+    async def drive():
+        async def one(env):
+            while env.episodes_done < episodes_per_env and time.monotonic() < deadline:
+                try:
+                    await env.run_episode()
+                    # Pace episodes a little so the scheduled kills land
+                    # MID-RUN on every host speed; wall time never feeds
+                    # the rng/env streams, so pacing cannot perturb the
+                    # bitwise comparison (both arms pace identically).
+                    await asyncio.sleep(0.04)
+                except RemoteInferenceError:
+                    first_abandon.setdefault(env.actor_id, env.rollouts_published)
+                    await asyncio.sleep(0.05)
+
+        if runner is not None:
+            runner.start()
+        try:
+            await asyncio.gather(*(one(a) for a in actors))
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(drive())
+    if runner is not None:
+        runner.stop()
+    serve_ledger = inc.final_ledger()
+    frames = {}
+    for f in broker.consume_experience(1_000_000, timeout=0.2):
+        frames.setdefault(deserialize_rollout(f).actor_id, []).append(f)
+    return {
+        "frames": frames,
+        "first_abandon": first_abandon,
+        "episodes_done": {a.actor_id: a.episodes_done for a in actors},
+        "abandons": {a.actor_id: a.episodes_abandoned for a in actors},
+        "inflight_step_failures": client.errors,
+        "reconnects": client.reconnects,
+        "serve": serve_ledger,
+        "serve_lives": inc.ledgers,
+        "recovery": None if runner is None else runner.recovery,
+        "finished_all": all(a.episodes_done >= episodes_per_env for a in actors),
+    }
+
+
+# ------------------------------------------------------------------ main
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="SERVE_CHAOS_SOAK.json")
+    p.add_argument("--envs", type=int, default=4)
+    p.add_argument("--parity-episodes", type=int, default=24)
+    p.add_argument("--parity-kills", default="kill@0.9:0.8@server,kill@3.3:0.8@server")
+    p.add_argument("--failover-s", type=float, default=14.0)
+    p.add_argument("--failover-kills", default="kill@3:1.2@server,kill@8:1.2@server")
+    p.add_argument("--failover-budget-s", type=float, default=5.0)
+    p.add_argument("--fallback-warm-s", type=float, default=3.0)
+    p.add_argument("--fallback-down-s", type=float, default=6.0)
+    p.add_argument("--fallback-post-s", type=float, default=6.0)
+    p.add_argument("--fallback-after-s", type=float, default=1.0)
+    p.add_argument("--quick", action="store_true", help="nightly-wrapper scale: shorter phases, 1 failover kill, same invariants")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.parity_episodes = 12
+        args.parity_kills = "kill@0.9:0.8@server"
+        args.failover_s = 9.0
+        args.failover_kills = "kill@3:1.2@server"
+        args.fallback_down_s = 4.0
+        args.fallback_post_s = 5.0
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench as bench_mod
+    from dotaclient_tpu.chaos import FaultSchedule, ScheduleRunner
+    from dotaclient_tpu.config import LearnerConfig, ObsConfig, PPOConfig, WatchdogConfig
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.env.service import serve as env_serve
+    from dotaclient_tpu.runtime.learner import Learner
+    from dotaclient_tpu.serve.client import RemoteFleet
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.tcp import BrokerServer, TcpBroker
+
+    policy = _tiny_policy()
+    artifact = {
+        "host": "single host, in-process serve replicas, real tcp experience/weights broker, CPU learner (tiny policy)",
+        "envs": args.envs,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+    # ---------------- phase 1: parity under server kills -----------------
+    base = _run_parity_arm(
+        policy, args.envs, args.parity_episodes, None, 100, "svchaos_base", 120.0
+    )
+    chaos = _run_parity_arm(
+        policy, args.envs, args.parity_episodes, args.parity_kills, 100, "svchaos_kill", 180.0
+    )
+    per_env = []
+    parity_ok = True
+    matched_frames = 0
+    for aid in range(args.envs):
+        a = base["frames"].get(aid, [])
+        b = chaos["frames"].get(aid, [])
+        cut = chaos["first_abandon"].get(aid)
+        n = min(len(a), len(b)) if cut is None else min(cut, len(a), len(b))
+        env_ok = (cut is None or n == cut) and a[:n] == b[:n]
+        parity_ok = parity_ok and env_ok
+        matched_frames += n
+        per_env.append(
+            {
+                "actor_id": aid,
+                "baseline_frames": len(a),
+                "chaos_frames": len(b),
+                "first_abandon_at_frame": cut,
+                "abandons": chaos["abandons"].get(aid, 0),
+                "untouched_prefix_bitwise": env_ok,
+            }
+        )
+    total_abandons_p1 = sum(chaos["abandons"].values())
+    stranded_p1 = sum(l["carries_resident_at_kill"] for l in chaos["serve_lives"])
+    artifact["phase_1_parity"] = {
+        "episodes_per_env": args.parity_episodes,
+        "kills": chaos["recovery"],
+        "per_env": per_env,
+        "matched_frames_bitwise": matched_frames,
+        "episodes_abandoned_total": total_abandons_p1,
+        "carries_stranded_at_kills": stranded_p1,
+        "inflight_step_failures": chaos["inflight_step_failures"],
+        "serve_lives": chaos["serve_lives"],
+        "baseline_serve": base["serve"],
+        "chaos_serve": chaos["serve"],
+        "both_arms_finished": base["finished_all"] and chaos["finished_all"],
+    }
+    print(json.dumps({k: v for k, v in artifact["phase_1_parity"].items() if k not in ("per_env", "serve_lives")}), flush=True)
+
+    # ---------------- shared phase-2/3 plumbing --------------------------
+    exp_broker_server = BrokerServer(port=0, maxlen=8192).start()
+    bport = exp_broker_server.port
+    env_server, env_port = env_serve(FakeDotaService())
+    env_addr = f"127.0.0.1:{env_port}"
+    lcfg = LearnerConfig(
+        batch_size=8,
+        seq_len=8,
+        policy=policy,
+        publish_every=1,
+        metrics_every=5,
+        # Wide window: the tiny-policy learner advances versions far
+        # faster than any real cadence (the chaos_soak precedent) — keep
+        # the ledgers about transport, not config-artifact staleness.
+        ppo=PPOConfig(max_staleness=4096),
+        obs=ObsConfig(
+            enabled=True,
+            install_handlers=False,
+            step_phases=False,
+            watchdog=WatchdogConfig(enabled=True, interval_s=2.0, stall_s=30.0),
+        ),
+    )
+    producers = {}
+    learner_crashed = None
+    fleet_errors = []
+    try:
+        learner = Learner(lcfg, TcpBroker(port=bport, retry=RetryPolicy()))
+        frames = bench_mod._make_frames(lcfg, 32)
+        warm_pub = TcpBroker(port=bport)
+        n_warm = lcfg.batch_size + 4
+        for i in range(n_warm):
+            fr = bytearray(frames[i % len(frames)])
+            struct.pack_into("<I", fr, 13, SENTINEL_WARM_ID)
+            warm_pub.publish_experience(bytes(fr))
+        producers["warmup"] = {"attempted": n_warm, "acked": n_warm, "shed": 0, "failed": 0}
+        learner.run(num_steps=1, batch_timeout=120.0)
+        warm_pub.close()
+        print("learner warm", flush=True)
+
+        def run_fleet_phase(cfg, duration_s, runner_spec, router, sample_extra=None):
+            """Drive a RemoteFleet for duration_s while a ScheduleRunner
+            (optional) executes server kills; the learner trains in THIS
+            thread. Returns (fleet, samples, runner recovery)."""
+            fleet = RemoteFleet(cfg, TcpBroker(port=bport, retry=RetryPolicy(window_s=8.0)), actor_id=0, envs=args.envs)
+            stop_ev = threading.Event()
+            samples = []
+
+            def fleet_main():
+                async def go():
+                    agen = fleet.episode_stream()
+                    try:
+                        async for _ in agen:
+                            if stop_ev.is_set():
+                                return
+                    except Exception as e:  # surfaced fleet death = red verdict
+                        fleet_errors.append(f"{type(e).__name__}: {e}")
+                    finally:
+                        # Explicit aclose: breaking out of async-for
+                        # leaves the generator suspended — teardown
+                        # (stop flag, client close, worker gather) runs
+                        # HERE, deterministically, not at GC time.
+                        await agen.aclose()
+
+                asyncio.run(go())
+
+            def sampler():
+                while not stop_ev.is_set():
+                    row = {
+                        "t": time.monotonic(),
+                        "remote_steps": fleet.client.steps,
+                        "published": fleet.rollouts_published,
+                    }
+                    if sample_extra:
+                        row.update(sample_extra(fleet))
+                    samples.append(row)
+                    time.sleep(0.03)
+
+            ft = threading.Thread(target=fleet_main, daemon=True)
+            st = threading.Thread(target=sampler, daemon=True)
+            t0 = time.monotonic()
+            ft.start()
+            st.start()
+            runner = None
+            if runner_spec:
+                runner = ScheduleRunner(
+                    FaultSchedule.parse(runner_spec, seed=0), broker=None, t0=t0, server=router
+                ).start()
+            learner.run(max_seconds=duration_s, batch_timeout=2.0)
+            if runner is not None:
+                runner.stop()
+            stop_ev.set()
+            ft.join(timeout=60)
+            st.join(timeout=10)
+            if ft.is_alive():
+                fleet_errors.append("fleet thread failed to join (teardown wedge)")
+            fleet.broker.close()
+            ledger = {
+                "attempted": fleet.rollouts_published + fleet.rollouts_shed + fleet.rollouts_failed,
+                "acked": fleet.rollouts_published,
+                "shed": fleet.rollouts_shed,
+                "failed": fleet.rollouts_failed,
+            }
+            return fleet, samples, (None if runner is None else runner.recovery), ledger, t0
+
+        # ---------------- phase 2: failover across two replicas ----------
+        inc_a = _make_serve_inc(policy, seed=0, max_batch=args.envs, weights_port=bport)
+        inc_b = _make_serve_inc(policy, seed=0, max_batch=args.envs, weights_port=bport)
+        router = _ReplicaRouter([inc_a, inc_b])
+        cfg2 = _acfg(
+            policy,
+            f"127.0.0.1:{inc_a.port},127.0.0.1:{inc_b.port}",
+            env_addr=env_addr,
+            seed=200,
+        )
+        fleet2, samples2, recovery2, ledger2, t0_2 = run_fleet_phase(
+            cfg2, args.failover_s, args.failover_kills, router
+        )
+        producers["failover_fleet"] = ledger2
+        stats2 = fleet2.stats()
+        kill_ts = sorted(inc_a.kill_times + inc_b.kill_times)
+        failover_recoveries = []
+        for kt in kill_ts:
+            before = [s for s in samples2 if s["t"] <= kt]
+            steps_at_kill = before[-1]["remote_steps"] if before else 0
+            after = [s for s in samples2 if s["t"] > kt and s["remote_steps"] > steps_at_kill]
+            failover_recoveries.append(
+                None if not after else round(after[0]["t"] - kt, 3)
+            )
+        serve2 = {"a": inc_a.final_ledger(), "b": inc_b.final_ledger()}
+        stranded_p2 = sum(
+            l["carries_resident_at_kill"] for l in inc_a.ledgers + inc_b.ledgers
+        )
+        artifact["phase_2_failover"] = {
+            "duration_s": args.failover_s,
+            "endpoints": 2,
+            "kills": recovery2,
+            "client_recovery_s": failover_recoveries,
+            "recovery_budget_s": args.failover_budget_s,
+            "failovers": stats2["serve_failover_total"],
+            "reconnects": stats2["serve_failover_reconnects_total"],
+            "episodes_abandoned": stats2["serve_failover_episodes_abandoned_total"],
+            "carries_stranded_at_kills": stranded_p2,
+            "fallback_engaged_ever": stats2["serve_fallback_engagements_total"],
+            "publish": ledger2,
+            "serve": serve2,
+        }
+        print(json.dumps(artifact["phase_2_failover"]), flush=True)
+
+        # ---------------- phase 3: local fallback ------------------------
+        inc_c = _make_serve_inc(policy, seed=0, max_batch=args.envs, weights_port=bport)
+        cfg3 = _acfg(
+            policy,
+            f"127.0.0.1:{inc_c.port}",
+            env_addr=env_addr,
+            seed=300,
+            fallback_local=True,
+            fallback_after_s=args.fallback_after_s,
+        )
+        spec3 = f"kill@{args.fallback_warm_s}:{args.fallback_down_s}@server"
+        dur3 = args.fallback_warm_s + args.fallback_down_s + args.fallback_post_s
+
+        def fb_extra(fleet):
+            fb = fleet.fallback
+            return {
+                "fb_engaged": 1 if (fb is not None and fb.engaged) else 0,
+                "fb_steps": fb.steps_total if fb else 0,
+                "fb_engagements": fb.engagements if fb else 0,
+                "fb_version": fb.version if fb else 0,
+            }
+
+        fleet3, samples3, recovery3, ledger3, t0_3 = run_fleet_phase(
+            cfg3, dur3, spec3, inc_c, sample_extra=fb_extra
+        )
+        producers["fallback_fleet"] = ledger3
+        stats3 = fleet3.stats()
+        kill_t = inc_c.kill_times[0] if inc_c.kill_times else None
+        # restart_times records restart() calls only (construction is
+        # not one), so the post-kill restart is the FIRST entry.
+        restart_t = inc_c.restart_times[0] if inc_c.restart_times else None
+        pre_kill = [s for s in samples3 if kill_t is None or s["t"] <= kill_t]
+        engaged_samples = [s for s in samples3 if s["fb_steps"] > 0]
+        first_fb_t = engaged_samples[0]["t"] if engaged_samples else None
+        pub_at_kill = pre_kill[-1]["published"] if pre_kill else 0
+        outage = [s for s in samples3 if restart_t is not None and kill_t is not None and kill_t < s["t"] <= restart_t]
+        pub_during_outage = (outage[-1]["published"] - pub_at_kill) if outage else 0
+        post = [s for s in samples3 if restart_t is not None and s["t"] > restart_t]
+        steps_at_restart = outage[-1]["remote_steps"] if outage else 0
+        remote_resumed = bool(post) and post[-1]["remote_steps"] > steps_at_restart
+        fb3 = {
+            "warm_s": args.fallback_warm_s,
+            "down_s": args.fallback_down_s,
+            "budget_s": args.fallback_after_s,
+            "kills": recovery3,
+            "pre_kill_fallback_steps": pre_kill[-1]["fb_steps"] if pre_kill else 0,
+            "engage_delay_s": None if (first_fb_t is None or kill_t is None) else round(first_fb_t - kill_t, 3),
+            "engagements_total": stats3["serve_fallback_engagements_total"],
+            "fallback_steps_total": stats3["serve_fallback_steps_total"],
+            "fallback_version_at_engage": engaged_samples[0]["fb_version"] if engaged_samples else 0,
+            "published_during_outage": pub_during_outage,
+            "engaged_at_end": stats3["serve_fallback_engaged"],
+            "remote_steps_resumed_after_restart": remote_resumed,
+            "episodes_abandoned": stats3["serve_failover_episodes_abandoned_total"],
+            "publish": ledger3,
+            "serve": inc_c.final_ledger(),
+        }
+        artifact["phase_3_fallback"] = fb3
+        print(json.dumps(fb3), flush=True)
+
+        # final drain so late publishes get consumed before the ledger
+        learner.run(max_seconds=3.0, batch_timeout=0.5)
+        watchdog = learner.obs.watchdog.verdict() if learner.obs and learner.obs.watchdog else {}
+        learner.staging.stop()
+        staging_stats = learner.staging.stats()
+        learner.close()
+        learner_crashed = False
+    except Exception as e:
+        learner_crashed = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        exp_broker_server.stop()
+        env_server.stop(0)
+
+    # ---------------- conservation ledger --------------------------------
+    broker_led = exp_broker_server.ledger()
+    producer_totals = {
+        k: sum(int(p.get(k, 0)) for p in producers.values())
+        for k in ("attempted", "acked", "shed", "failed")
+    }
+    producer_ledgers_ok = all(
+        int(p["attempted"]) == int(p["acked"]) + int(p["shed"]) + int(p["failed"])
+        for p in producers.values()
+    )
+    unaccounted = (
+        broker_led["popped"] - broker_led["reply_lost"] - staging_stats["consumed"]
+    )
+    conservation = {
+        "producers": producers,
+        "producer_totals": producer_totals,
+        "broker": broker_led,
+        "staging": {
+            k: int(staging_stats[k])
+            for k in ("consumed", "dropped_stale", "dropped_bad", "quarantined", "rows_packed")
+        },
+        "staging_pending_leftover": int(staging_stats["pending_rollouts"]),
+        "broker_identity_holds": broker_led["enqueued"]
+        == broker_led["popped"] + broker_led["dropped_oldest"] + broker_led["resident"],
+        "producer_ledgers_balance": producer_ledgers_ok,
+        "died_with_broker": broker_led["resident"] + broker_led["reply_lost"],
+        "unaccounted_frames": unaccounted,
+    }
+    artifact["conservation"] = conservation
+    artifact["learner"] = {
+        "versions_trained": int(staging_stats["batches"]),
+        "crashed": learner_crashed,
+        "fleet_errors": fleet_errors,
+        "watchdog": watchdog,
+    }
+
+    p1 = artifact["phase_1_parity"]
+    p2 = artifact["phase_2_failover"]
+    p3 = artifact["phase_3_fallback"]
+    parity_kill_count = sum(1 for l in chaos["serve_lives"] if l.get("killed_at") is not None)
+    n_server_kills = parity_kill_count + len(kill_ts) + len(inc_c.kill_times)
+    verdict = {
+        # phase 1
+        "parity_untouched_rows_bitwise": parity_ok and matched_frames > 0,
+        "parity_both_arms_finished": p1["both_arms_finished"],
+        "kills_disturbed_episodes": total_abandons_p1 >= 1 and stranded_p1 >= 1,
+        "kills_hit_inflight_steps": p1["inflight_step_failures"] >= 1,
+        # phase 2
+        "failover_switched_endpoints": p2["failovers"] >= 1,
+        "failover_recovered_under_budget": bool(p2["client_recovery_s"])
+        and all(r is not None and r <= args.failover_budget_s for r in p2["client_recovery_s"]),
+        "failover_no_fallback_when_off": p2["fallback_engaged_ever"] == 0,
+        # phase 3
+        "fallback_engaged_once": p3["engagements_total"] == 1,
+        "fallback_respected_budget": p3["engage_delay_s"] is not None
+        and p3["engage_delay_s"] >= args.fallback_after_s * 0.95
+        and p3["pre_kill_fallback_steps"] == 0,
+        "fallback_generated_during_outage": p3["published_during_outage"] >= 1
+        and p3["fallback_steps_total"] >= 1,
+        "fallback_tree_was_warm": p3["fallback_version_at_engage"] > 0,
+        "fallback_disengaged_after_recovery": p3["engaged_at_end"] == 0.0
+        and p3["remote_steps_resumed_after_restart"],
+        # cross-phase: every kill produced EXPLICITLY ledgered abandons
+        # (client episodes_abandoned counters; the server-side
+        # carries_resident_at_kill rides the artifact as the upper
+        # bound — a carry also stays resident between episodes, so it
+        # over-counts mid-episode abandons and is not the gate)
+        "abandoned_episodes_ledgered": (
+            total_abandons_p1 >= parity_kill_count
+            and p2["episodes_abandoned"] >= len(kill_ts)
+            and p3["episodes_abandoned"] >= len(inc_c.kill_times)
+        ),
+        "server_kills_executed": n_server_kills,
+        # Server-side recovery probe gates only the single-replica
+        # phases: in the failover phase the reborn replica legitimately
+        # idles while the sticky client stays on the survivor (the
+        # client_recovery_s budget is that phase's gate).
+        "all_kills_recovered_serverside": all(
+            r["recovery_s"] is not None
+            for r in (p1["kills"] or []) + (p3["kills"] or [])
+        ),
+        "conservation_zero_unaccounted": unaccounted == 0,
+        "broker_identity_holds": conservation["broker_identity_holds"],
+        "producer_ledgers_balance": producer_ledgers_ok,
+        "learner_clean_finish": learner_crashed is False
+        and not fleet_errors
+        and not watchdog.get("tripped", False)
+        and int(watchdog.get("trips_total", 0) or 0) == 0,
+    }
+    artifact["verdict"] = verdict
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(artifact, indent=2))
+    return 0 if all(v for v in verdict.values() if isinstance(v, bool)) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

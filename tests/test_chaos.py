@@ -121,9 +121,12 @@ def test_golden_decision_sequence_pinned():
 
     assert seq(_GOLDEN_SPEC) == _GOLDEN_SEQ
     # Kill-target selectors are timed events: they must not consume (or
-    # shift) a single rate draw.
+    # shift) a single rate draw — for EVERY target the grammar knows,
+    # including the PR-10 server target (the ARG-side extension rule).
     assert seq(_GOLDEN_SPEC + ",kill@10:2@learner:term,kill@20:2@learner") == _GOLDEN_SEQ
     assert seq(_GOLDEN_SPEC + ",kill@5:1") == _GOLDEN_SEQ
+    assert seq(_GOLDEN_SPEC + ",kill@7:2@server") == _GOLDEN_SEQ
+    assert seq(_GOLDEN_SPEC + ",kill@3:1@server,kill@9:2@broker,kill@12:1@server") == _GOLDEN_SEQ
     # latency draw position pinned too (it follows the five rate draws)
     s = FaultSchedule.parse(_GOLDEN_SPEC + ",kill@9:1@learner", seed=3)
     assert round(s.decide(0).latency_s, 9) == 0.00253577

@@ -235,8 +235,11 @@ def test_chaos_pinned_off_in_all_prod_manifests():
     checked = 0
     for fname, c in _our_containers():
         cmd = c.get("command")
-        if cmd is None or cmd[2] == "dotaclient_tpu.transport.tcp_server":
-            continue  # the broker binary has no chaos surface
+        if cmd is None or cmd[2] in (
+            "dotaclient_tpu.transport.tcp_server",  # broker: no chaos surface
+            "dotaclient_tpu.env.fake_dotaservice",  # env stub: no flags at all
+        ):
+            continue
         args = c.get("args", [])
         flags = [a for a in args if a.endswith("chaos.enabled")]
         assert flags, f"{fname}: chaos.enabled not pinned"
@@ -280,16 +283,20 @@ def test_wire_obs_dtype_pinned_bf16_on_actors():
 
 
 def test_inference_service_manifest():
-    """The serving tier's deployment shell: probes on /healthz (liveness
-    delayed past the boot compile), a Service exposing serve + metrics
-    ports, the broker weight subscription wired to the broker Service,
-    the serve-endpoint opt-in pinned EMPTY on the actor fleet (flip is
-    a deliberate act, server-first), and obs enabled so the serve_*
-    scalars actually scrape."""
+    """The serving tier's deployment shell (PR 10 multi-replica): a
+    StatefulSet behind a HEADLESS Service — carry residency demands
+    replica affinity, so clients address replicas by per-pod DNS, never
+    a load-balanced virtual IP — with probes on /healthz (liveness
+    delayed past the boot compile), the broker weight subscription
+    wired to the broker Service, and obs enabled so the serve_* scalars
+    actually scrape."""
     (_, doc), = [
         (f, d) for f, d in DOCS
-        if d["metadata"]["name"] == "inference" and d["kind"] == "Deployment"
+        if d["metadata"]["name"] == "inference" and d["kind"] == "StatefulSet"
     ]
+    assert doc["spec"]["replicas"] >= 2, "multi-replica serving (PR 10)"
+    assert doc["spec"]["serviceName"] == "inference"
+    assert doc["spec"].get("podManagementPolicy") == "Parallel"
     c = doc["spec"]["template"]["spec"]["containers"][0]
     assert c["command"][2] == "dotaclient_tpu.serve.server"
     args = c["args"]
@@ -307,19 +314,60 @@ def test_inference_service_manifest():
         d for _, d in DOCS
         if d["kind"] == "Service" and d["metadata"]["name"] == "inference"
     ]
-    assert svc, "inference Deployment needs its Service"
+    assert svc, "inference StatefulSet needs its Service"
+    assert svc[0]["spec"].get("clusterIP") == "None", (
+        "inference Service must be HEADLESS: per-pod DNS is the affinity "
+        "contract (a round-robin VIP would strand resident carries)"
+    )
     ports = {p["port"] for p in svc[0]["spec"]["ports"]}
     sport = int(args[args.index("--serve.port") + 1])
     assert {sport, mport} <= ports
-    # actor fleet: the opt-in flag is pinned EMPTY (local inference)
-    for fname, ac in _our_containers():
-        if ac.get("command") and ac["command"][2] == "dotaclient_tpu.runtime.actor":
-            a = ac.get("args", [])
+
+
+def test_serve_endpoint_lists_match_replicas_and_league_stays_local():
+    """Actor-side serve wiring (PR 10), gated on a green
+    SERVE_CHAOS_SOAK verdict (the WIRE_SOAK flip pattern): the scripted
+    experience fleet lists EXACTLY one per-pod DNS endpoint per
+    inference replica (list drift = stranded capacity or a phantom
+    endpoint) plus the failover/fallback knobs; the league fleet stays
+    pinned EMPTY — its sessions step per-session snapshot params the
+    shared-tree service cannot serve, and the binary refuses the
+    combination loudly."""
+    import json
+
+    verdict = json.loads((K8S.parent / "SERVE_CHAOS_SOAK.json").read_text())["verdict"]
+    bad = [k for k, v in verdict.items() if isinstance(v, bool) and not v]
+    assert not bad, f"serve opt-in requires a green SERVE_CHAOS_SOAK verdict: {bad}"
+    (_, sts), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "inference" and d["kind"] == "StatefulSet"
+    ]
+    replicas = sts["spec"]["replicas"]
+    sts_args = sts["spec"]["template"]["spec"]["containers"][0]["args"]
+    sport = sts_args[sts_args.index("--serve.port") + 1]
+    expected = [f"inference-{i}.inference:{sport}" for i in range(replicas)]
+
+    by_deploy = {}
+    for fname, c in _our_containers():
+        if c.get("command") and c["command"][2] == "dotaclient_tpu.runtime.actor":
+            a = c.get("args", [])
             assert "--serve.endpoint" in a, f"{fname}: serve.endpoint not pinned"
-            assert a[a.index("--serve.endpoint") + 1] == "", (
-                f"{fname}: actors opt into the serve tier deliberately, "
-                f"server-first (MIGRATION)"
-            )
+            opp = a[a.index("--opponent") + 1]
+            by_deploy[opp] = a
+    league = by_deploy["league"]
+    assert league[league.index("--serve.endpoint") + 1] == "", (
+        "league actors must stay on local inference (per-session params)"
+    )
+    scripted = by_deploy["scripted_hard"]
+    eps = scripted[scripted.index("--serve.endpoint") + 1].split(",")
+    assert eps == expected, (
+        f"scripted fleet endpoint list {eps} must name every inference "
+        f"replica exactly: {expected}"
+    )
+    assert scripted[scripted.index("--serve.fallback_local") + 1] == "true", (
+        "the serve-tier fleet arms the local fallback (experience never stops)"
+    )
+    assert float(scripted[scripted.index("--serve.fallback_after_s") + 1]) > 0
 
 
 def test_actor_fleet_scale_and_kill_switch():

@@ -504,6 +504,44 @@ def test_serve_scalars_are_registered():
     } <= set(stats)
 
 
+def test_serve_failover_fallback_scalars_are_registered():
+    """The serve_failover_* / serve_fallback_* families (serve-tier
+    resilience, CLIENT side) are scrape-only like actor_* — pin
+    RemoteFleet.stats() names against the registry. Construction is
+    IO-free (the client dials lazily), so names can be pinned without a
+    live server."""
+    from dotaclient_tpu.config import ActorConfig, PolicyConfig, ServeClientConfig
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.serve.client import RemoteFleet
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+
+    mem.reset("obs-serve-client-pin")
+    cfg = ActorConfig(
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"),
+        serve=ServeClientConfig(endpoint="127.0.0.1:13380"),
+    )
+    fleet = RemoteFleet(cfg, connect("mem://obs-serve-client-pin"), envs=1)
+    stats = fleet.stats()
+    missing = registry.unregistered(stats.keys())
+    assert not missing, f"serve client scalars not in obs/registry.py: {missing}"
+    assert {
+        "serve_failover_endpoints",
+        "serve_failover_endpoints_down",
+        "serve_failover_total",
+        "serve_failover_reconnects_total",
+        "serve_failover_episodes_abandoned_total",
+        "serve_fallback_engaged",
+        "serve_fallback_engagements_total",
+        "serve_fallback_steps_total",
+        "serve_fallback_version",
+        "broker_shed_observed_total",  # publish degradation rides along
+    } <= set(stats)
+    # default-off surface: fallback meters read zero with no fallback
+    assert stats["serve_fallback_engaged"] == 0.0
+    assert stats["serve_failover_endpoints"] == 1.0
+
+
 def test_wire_scalars_are_registered_and_emitted_names_pinned():
     """The wire_* family (DTR3 quantized-wire meters): the learner
     emits exactly these names from staging's wire_ stats — pin them

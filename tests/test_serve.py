@@ -544,6 +544,9 @@ from dotaclient_tpu.transport.base import connect
 
 cfg = ActorConfig(policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"))
 assert cfg.serve.endpoint == ""
+# the PR-10 resilience surface defaults off with it: no fallback tree,
+# no endpoint-list machinery, nothing to import
+assert cfg.serve.fallback_local is False
 actor = Actor(cfg, connect("mem://inert"))
 state = jax.tree.map(np.asarray, __import__("dotaclient_tpu.models.policy", fromlist=["initial_state"]).initial_state(cfg.policy, (1,)))
 asyncio.new_event_loop().run_until_complete(actor._policy_step(state, F.zeros_observation()))
