@@ -284,10 +284,18 @@ def _start_producers(cfg, broker_name: str, n_threads: int = 2):
 
 
 def main() -> None:
+    import os
+
     devices, fallback_reason = _init_devices()
     n_dev = len(devices)
     on_cpu_fallback = devices[0].platform == "cpu"
     cfg = LearnerConfig(batch_size=256, seq_len=16, mesh_shape="dp=-1")
+    # Parallel host feed (--staging.pack_workers): opt-in via env so the
+    # number of record stays comparable across rounds until the flag
+    # flips in production; scripts/ab_pack_scale.py owns the scaling
+    # artifact, this knob lets the prober run the full bench either way.
+    pack_workers = int(os.environ.get("DOTACLIENT_TPU_BENCH_PACK_WORKERS", "1") or 1)
+    cfg.staging.pack_workers = pack_workers
     mesh = mesh_lib.make_mesh(cfg.mesh_shape)
     # The production flagship path: fused 4-buffer H2D + host-side bf16
     # obs cast, exactly what the Learner runs with default config.
@@ -320,12 +328,25 @@ def main() -> None:
     staging = StagingBuffer(
         cfg, connect("mem://bench_pack"), version_fn=lambda: 0, fused_io=io
     ).start()
+    def _release_lease():
+        # Ring mode (pack_workers > 1): a popped batch carries a
+        # TransferRing lease that must return to the packers, or the
+        # host-pipeline rate would stall at transfer_depth batches. The
+        # batch is not device_put in this section, so release directly.
+        lease = staging.last_batch_lease
+        if lease is not None:
+            lease.release()
+
     staging.get_batch(timeout=120.0)  # pipe warm
+    _release_lease()
     pack_steps = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < 3.0:
         b = staging.get_batch(timeout=120.0)
+        # read BEFORE releasing: b's leaves are views into the slot, and
+        # a released slot may be re-zeroed/repacked immediately
         pack_steps += int(np.sum(b.mask))
+        _release_lease()
     packer_rate = pack_steps / (time.perf_counter() - t0)
     stop.set()
     staging.stop()
@@ -357,8 +378,15 @@ def main() -> None:
             # window must be a diagnosable error, not b.mask on None
             raise RuntimeError("staging starved (timeout)")
         steps = int(np.sum(b.mask))
+        lease = staging.last_batch_lease
         t1 = time.perf_counter()
         dev = jax.device_put(groups, io.shardings)
+        if lease is not None:
+            # ring mode: the slot may be repacked the moment it is
+            # released — wait for the transfer to retire first
+            # (runtime/learner.py _fetch_next is the production twin)
+            jax.block_until_ready(dev)
+            lease.release()
         return dev, steps, t1 - t0, time.perf_counter() - t1
 
     warm, _, _, _ = fetch()
@@ -392,8 +420,6 @@ def main() -> None:
     # transfer_layout_ab data give the 4-vs-1 decision real numbers on
     # the real link). Best-effort: failure degrades to an error field,
     # never touches the primary (already measured) rate.
-    import os
-
     e2e_single = e2e_single_err = None
     if os.environ.get("DOTACLIENT_TPU_BENCH_SINGLE") == "1":
         stop_s = s_staging = None
@@ -695,6 +721,9 @@ def main() -> None:
         },
         "device_only_steps_per_sec": round(device_rate, 1),
         "packer_only_steps_per_sec": round(packer_rate, 1),
+        # host-feed topology of this run (scripts/ab_pack_scale.py owns
+        # the 1/2/4-worker scaling artifact, PACK_SCALE_AB.json)
+        "pack_workers": pack_workers,
         "e2e_over_device_only": round(e2e_rate / device_rate, 3),
         # Utilization accounting (SURVEY §6): analytic matmul FLOPs/step
         # (ops/flops.py, fwd+bwd), XLA's compiled count when the backend

@@ -148,6 +148,36 @@ class ReplayConfig:
 
 
 @dataclass
+class StagingConfig:
+    """Parallel host feed (runtime/staging.py): multi-worker sharded
+    pack into a ring of preallocated transfer buffers. Default
+    pack_workers=1 keeps the single-consumer-thread staging path
+    byte-for-byte (no pool threads, no ring — the inertness contract;
+    tests/test_staging.py proves it in a subprocess)."""
+
+    # Packer worker threads. 1 (default) = the classic path: one
+    # consumer thread pops, parses, and packs inline. N>1 = the parallel
+    # feed: a dedicated pop thread keeps draining the broker, an
+    # assembler thread parses/filters (the batched C header parse
+    # releases the GIL), and N pool workers each pack a disjoint
+    # row-slice of the SAME transfer buffer concurrently (the C packer
+    # releases the GIL — real parallelism). Output is BITWISE identical
+    # to the single-thread pack for any worker count and any row split.
+    # Sizing rule (README "Host feed pipeline"): ~1 worker per 4 host
+    # cores feeding the learner, capped at 4 — pack is memcpy-bound, so
+    # workers beyond the memory bandwidth knee only add contention.
+    pack_workers: int = 1
+    # Transfer-buffer ring depth (fused-H2D mode, pack_workers > 1
+    # only): preallocated buffer sets with explicit ownership handoff
+    # (free → packing → ready → in-transfer → free), so pack of batch
+    # N+1 overlaps the H2D of N and the device step of N-1. The
+    # learner's fetch returns a lease released once the device_put
+    # retires. 2 = classic double buffering; raise it only if H2D
+    # latency (not pack) is the longest stage.
+    transfer_depth: int = 2
+
+
+@dataclass
 class WireConfig:
     """Experience-wire quantization (transport/serialize.py DTR3).
     Producer-side only — consumers (staging, the native packer) accept
@@ -451,6 +481,8 @@ class LearnerConfig:
     # C++ batch packer on the staging path (falls back to python when the
     # build/load fails or DOTACLIENT_TPU_NO_NATIVE=1 is set)
     native_packer: bool = True
+    # Parallel host feed (--staging.pack_workers / --staging.transfer_depth).
+    staging: StagingConfig = field(default_factory=StagingConfig)
     # Stage obs floats in the policy compute dtype (bf16) on the host:
     # numerically identical (the policy's first op is the same cast) and
     # halves the dominant host→device transfer (runtime/staging.py

@@ -295,6 +295,155 @@ def frame_headers(lib: ctypes.CDLL, frames: List[bytes]) -> FrameHeaders:
     )
 
 
+def _ordered_out_leaves(batch):
+    """The 20 output arrays in C-ABI order (aux slots None-padded)."""
+    aux_leaves = (
+        (batch.aux.win, batch.aux.last_hit, batch.aux.net_worth)
+        if batch.aux is not None
+        else (None, None, None)
+    )
+    return (
+        batch.obs.global_feats, batch.obs.hero_feats, batch.obs.unit_feats,
+        batch.obs.unit_mask, batch.obs.target_mask, batch.obs.action_mask,
+        batch.actions.type, batch.actions.move_x, batch.actions.move_y,
+        batch.actions.target,
+        batch.behavior_logp, batch.behavior_value, batch.rewards,
+        batch.dones, batch.mask,
+        batch.initial_state[0], batch.initial_state[1],
+    ) + aux_leaves
+
+
+def _validate_out_strides(batch, obs_bf16: bool, n: int, row_offset: int, want_rows: int):
+    """Validate a caller-owned `out` batch against the C writer's fixed
+    widths and return the 20-entry row-stride ctypes array. Raises
+    BatchLayoutError (fatal to staging — a template/config mismatch
+    fails every batch, not this one) on any disagreement."""
+    from dotaclient_tpu.ops.batch import BatchLayoutError
+
+    if row_offset < 0 or row_offset + n > want_rows:
+        raise BatchLayoutError(
+            f"row shard [{row_offset}, {row_offset + n}) outside the "
+            f"{want_rows}-row out batch"
+        )
+    # Row stride in ELEMENTS per output, C-ABI order. Rows must be
+    # internally contiguous; only the row-to-row distance may differ
+    # from dense (the group-buffer column-block case).
+    ordered = _ordered_out_leaves(batch)
+    # Expected dtype per output, same order as `ordered` — the C
+    # writer's widths are fixed, so a template/flag mismatch (e.g. an
+    # uncast f32 template with obs_bf16=True) must fail HERE, not
+    # silently reinterpret the storage and ship garbage obs.
+    expect_dtypes = _expect_dtypes(obs_bf16)
+    stride_vals = []
+    for arr, want in zip(ordered, expect_dtypes):
+        if arr is None:
+            stride_vals.append(0)
+            continue
+        if arr.dtype != want:
+            raise BatchLayoutError(
+                f"out leaf dtype {np.dtype(arr.dtype).name} != {want} "
+                f"(obs_bf16={obs_bf16}; template/flag mismatch)"
+            )
+        if arr.shape[0] != want_rows:
+            raise BatchLayoutError(
+                f"out batch rows {arr.shape[0]} != {want_rows} "
+                f"({n} frames at row_offset {row_offset})"
+            )
+        stride_elems, rem = divmod(arr.strides[0], arr.itemsize)
+        if rem:
+            raise BatchLayoutError("out leaf row stride not a multiple of itemsize")
+        # within-row contiguity: trailing dims must be C-contiguous
+        expect = arr.itemsize
+        for dim, st_b in zip(arr.shape[:0:-1], arr.strides[:0:-1]):
+            if st_b != expect:
+                raise BatchLayoutError("out leaf rows must be internally contiguous")
+            expect *= dim
+        stride_vals.append(stride_elems)
+    return (ctypes.c_int64 * 20)(*stride_vals)
+
+
+class PackPlan:
+    """Prebuilt dt_pack_batch call template: pack exactly `n` frames
+    into rows [row_offset, row_offset+n) of ONE long-lived `out` batch,
+    repeatedly.
+
+    The sharded host feed (--staging.pack_workers) packs every batch
+    into reused TransferRing slots, so the expensive per-call glue —
+    the 20-leaf stride/dtype validation and the 24 output-pointer
+    marshals (~0.06 ms per shard call, GIL-held, measured on the bench
+    host) — is identical call after call. A plan pays it ONCE; pack()
+    only marshals the per-batch frame pointers/lengths and makes the
+    (GIL-released) C call. Output is byte-identical to pack_frames with
+    the same arguments.
+
+    The plan holds references to `out`'s leaves; the caller must not
+    resize/replace them (ring slots never do — their buffers live as
+    long as the ring)."""
+
+    def __init__(
+        self,
+        lib: ctypes.CDLL,
+        out,
+        n: int,
+        seq_len: int,
+        lstm_hidden: int,
+        with_aux: bool,
+        obs_bf16: bool,
+        row_offset: int,
+        total_rows: int,
+    ):
+        self._lib = lib
+        self.n = n
+        self.row_offset = row_offset
+        strides_arg = _validate_out_strides(out, obs_bf16, n, row_offset, total_rows)
+        G, HF, U, UF, A = _schema_dims()
+        versions = np.empty(n, np.uint32)
+        actor_ids = np.empty(n, np.uint32)
+        ep_returns = np.empty(n, np.float32)
+
+        def ptr(a):
+            return ctypes.c_void_p(a.ctypes.data)
+
+        ordered = _ordered_out_leaves(out)
+        self._tail = (
+            ctypes.c_int64(n),
+            ctypes.c_int64(row_offset),
+            ctypes.c_int64(seq_len),
+            ctypes.c_int64(lstm_hidden),
+            ctypes.c_int64(1 if with_aux else 0),
+            ctypes.c_int64(1 if obs_bf16 else 0),
+            *(ctypes.c_int64(d) for d in (G, HF, U, UF, A)),
+            strides_arg,
+            *(ptr(a) if a is not None else None for a in ordered),
+            ptr(versions),
+            ptr(actor_ids),
+            ptr(ep_returns),
+        )
+        # keepalive: everything the prebuilt pointers reference
+        self._keep = (out, strides_arg, versions, actor_ids, ep_returns)
+
+    def pack(self, frames: List[bytes]) -> None:
+        """One C pack of len(frames)==n frames into the planned rows.
+        ValueError names the offending ABSOLUTE batch row on a malformed
+        frame (same contract as pack_frames)."""
+        n = len(frames)
+        if n != self.n:
+            from dotaclient_tpu.ops.batch import BatchLayoutError
+
+            raise BatchLayoutError(f"plan packs {self.n} frames, got {n}")
+        frame_ptrs = (ctypes.c_char_p * n)(*frames)
+        frame_lens = np.fromiter((len(f) for f in frames), np.int64, count=n)
+        rc = self._lib.dt_pack_batch(
+            ctypes.cast(frame_ptrs, ctypes.POINTER(_u8p)),
+            ctypes.c_void_p(frame_lens.ctypes.data),
+            *self._tail,
+        )
+        if rc != 0:
+            raise ValueError(
+                f"native packer rejected frame {self.row_offset - rc - 1}"
+            )
+
+
 def pack_frames(
     lib: ctypes.CDLL,
     frames: List[bytes],
@@ -303,6 +452,8 @@ def pack_frames(
     with_aux: bool,
     obs_bf16: bool = False,
     out=None,
+    row_offset: int = 0,
+    total_rows: Optional[int] = None,
 ):
     """Pack B wire frames into one padded TrainBatch (numpy leaves).
 
@@ -321,6 +472,15 @@ def pack_frames(
     caller owns initialization (zeros + NOOP-legal action-mask padding,
     exactly zeros_train_batch's contract).
 
+    `row_offset`/`total_rows` (require `out`): write the n frames at
+    batch rows [row_offset, row_offset+n) of an `out` holding
+    total_rows rows — the sharded host feed (--staging.pack_workers)
+    runs N such calls CONCURRENTLY against one buffer, each shard a
+    disjoint contiguous row range. Rows never overlap and each row
+    depends only on its own frame, so any split is bitwise identical to
+    the one-call pack. Defaults (0, None) are the classic whole-batch
+    call: total_rows=None means `out` must hold exactly n rows.
+
     Exception contract: a malformed FRAME raises plain ValueError (the
     staging consumer drops the batch and continues); an `out` template
     LAYOUT/CONFIG mismatch raises BatchLayoutError (a ValueError
@@ -331,6 +491,11 @@ def pack_frames(
 
     n = len(frames)
     if out is None:
+        if row_offset or total_rows is not None:
+            raise ValueError(
+                "row_offset/total_rows require a caller-owned `out` batch "
+                "(the sharded pack targets one shared buffer)"
+            )
         obs_dtype = None
         if obs_bf16:
             import ml_dtypes
@@ -340,65 +505,24 @@ def pack_frames(
         strides_arg = None
     else:
         batch = out
-        # Row stride in ELEMENTS per output, C-ABI order. Rows must be
-        # internally contiguous; only the row-to-row distance may differ
-        # from dense (the group-buffer column-block case).
-        aux_leaves = (
-            (batch.aux.win, batch.aux.last_hit, batch.aux.net_worth)
-            if batch.aux is not None
-            else (None, None, None)
-        )
-        ordered = (
-            batch.obs.global_feats, batch.obs.hero_feats, batch.obs.unit_feats,
-            batch.obs.unit_mask, batch.obs.target_mask, batch.obs.action_mask,
-            batch.actions.type, batch.actions.move_x, batch.actions.move_y,
-            batch.actions.target,
-            batch.behavior_logp, batch.behavior_value, batch.rewards,
-            batch.dones, batch.mask,
-            batch.initial_state[0], batch.initial_state[1],
-        ) + aux_leaves
-        # Expected dtype per output, same order as `ordered` — the C
-        # writer's widths are fixed, so a template/flag mismatch (e.g. an
-        # uncast f32 template with obs_bf16=True) must fail HERE, not
-        # silently reinterpret the storage and ship garbage obs.
-        expect_dtypes = _expect_dtypes(obs_bf16)
-        stride_vals = []
-        for arr, want in zip(ordered, expect_dtypes):
-            if arr is None:
-                stride_vals.append(0)
-                continue
-            if arr.dtype != want:
-                raise BatchLayoutError(
-                    f"out leaf dtype {np.dtype(arr.dtype).name} != {want} "
-                    f"(obs_bf16={obs_bf16}; template/flag mismatch)"
-                )
-            if arr.shape[0] != n:
-                raise BatchLayoutError(f"out batch rows {arr.shape[0]} != {n} frames")
-            stride_elems, rem = divmod(arr.strides[0], arr.itemsize)
-            if rem:
-                raise BatchLayoutError("out leaf row stride not a multiple of itemsize")
-            # within-row contiguity: trailing dims must be C-contiguous
-            expect = arr.itemsize
-            for dim, st_b in zip(arr.shape[:0:-1], arr.strides[:0:-1]):
-                if st_b != expect:
-                    raise BatchLayoutError("out leaf rows must be internally contiguous")
-                expect *= dim
-            stride_vals.append(stride_elems)
-        strides_arg = (ctypes.c_int64 * 20)(*stride_vals)
+        want_rows = n + row_offset if total_rows is None else total_rows
+        strides_arg = _validate_out_strides(batch, obs_bf16, n, row_offset, want_rows)
     G, HF, U, UF, A = _schema_dims()
 
     args, _keepalive = _pack_batch_args(
         frames, batch, seq_len, lstm_hidden, with_aux, obs_bf16, strides_arg,
-        (G, HF, U, UF, A),
+        (G, HF, U, UF, A), row_offset=row_offset,
     )
     rc = lib.dt_pack_batch(*args)
     if rc != 0:
-        raise ValueError(f"native packer rejected frame {-rc - 1}")
+        # absolute batch row (= shard-local index + row_offset), so a
+        # sharded-pack rejection points at the right frame in the batch
+        raise ValueError(f"native packer rejected frame {row_offset - rc - 1}")
     return batch
 
 
 def _pack_batch_args(frames, batch, seq_len, lstm_hidden, with_aux, obs_bf16,
-                     strides_arg, dims):
+                     strides_arg, dims, row_offset=0):
     """The dt_pack_batch argument vector for a (frames, batch) pair →
     (args, keepalive). Split from pack_frames so the ctypes glue — a
     fixed per-call cost the wire dtype cannot change — is separately
@@ -433,6 +557,7 @@ def _pack_batch_args(frames, batch, seq_len, lstm_hidden, with_aux, obs_bf16,
         ctypes.cast(frame_ptrs, ctypes.POINTER(_u8p)),
         ptr(frame_lens),
         ctypes.c_int64(n),
+        ctypes.c_int64(row_offset),
         ctypes.c_int64(seq_len),
         ctypes.c_int64(lstm_hidden),
         ctypes.c_int64(1 if with_aux else 0),

@@ -233,8 +233,20 @@ extern "C" {
 // pack writes the device-transfer layout directly and the python-side
 // regroup copy disappears. Within a row a block is contiguous either
 // way — only the row-to-row stride differs.
+//
+// `row_offset`: first batch row this call writes — frame b lands at
+// output row (row_offset + b). The sharded host feed
+// (runtime/staging.py, --staging.pack_workers) splits one batch into
+// disjoint contiguous row ranges and runs N of these calls
+// CONCURRENTLY against the SAME output buffers (each releases the
+// GIL); rows never overlap and each row's bytes depend only on its own
+// frame, so any split is bitwise identical to one row_offset=0 call.
+// The per-frame metadata outputs (versions/actor_ids/ep_returns) are
+// indexed by b, not row_offset+b — each shard call passes its own
+// n-sized arrays.
 int64_t dt_pack_batch(
     const uint8_t** frames, const int64_t* frame_lens, int64_t n,
+    int64_t row_offset,
     int64_t T, int64_t H, int64_t want_aux,
     // When 1, the three float obs outputs are bf16 (uint16) storage;
     // f32-wire frames convert f32->bf16 in the copy loop (RNE, bitwise
@@ -276,36 +288,37 @@ int64_t dt_pack_batch(
     if (L > T || hdr.H != H) return -(b + 1);
     const bool frame_aux = (hdr.flags & kFlagAux) != 0;
     const int64_t T1 = L + 1;
+    const int64_t row = row_offset + b;  // output batch row for frame b
 
     Reader r{p + hdr.body_off, p + len, true};
-    r.copy_obs(global_f, b * st[0], T1 * G, obs_bf16, hdr.wire_obs_bf16);
-    r.copy_obs(hero_f, b * st[1], T1 * HF, obs_bf16, hdr.wire_obs_bf16);
-    r.copy_obs(unit_f, b * st[2], T1 * U * UF, obs_bf16, hdr.wire_obs_bf16);
-    r.copy_bool(unit_m + b * st[3], T1 * U);
-    r.copy_bool(target_m + b * st[4], T1 * U);
-    r.copy_bool(action_m + b * st[5], T1 * A);
-    r.copy(act_type + b * st[6], L * 4);
-    r.copy(act_mx + b * st[7], L * 4);
-    r.copy(act_my + b * st[8], L * 4);
-    r.copy(act_tg + b * st[9], L * 4);
-    r.copy(logp + b * st[10], L * 4);
-    r.copy(value + b * st[11], L * 4);
-    r.copy(rewards + b * st[12], L * 4);
-    r.copy(dones + b * st[13], L * 4);
-    r.copy(init_c + b * st[15], H * 4);
-    r.copy(init_h + b * st[16], H * 4);
+    r.copy_obs(global_f, row * st[0], T1 * G, obs_bf16, hdr.wire_obs_bf16);
+    r.copy_obs(hero_f, row * st[1], T1 * HF, obs_bf16, hdr.wire_obs_bf16);
+    r.copy_obs(unit_f, row * st[2], T1 * U * UF, obs_bf16, hdr.wire_obs_bf16);
+    r.copy_bool(unit_m + row * st[3], T1 * U);
+    r.copy_bool(target_m + row * st[4], T1 * U);
+    r.copy_bool(action_m + row * st[5], T1 * A);
+    r.copy(act_type + row * st[6], L * 4);
+    r.copy(act_mx + row * st[7], L * 4);
+    r.copy(act_my + row * st[8], L * 4);
+    r.copy(act_tg + row * st[9], L * 4);
+    r.copy(logp + row * st[10], L * 4);
+    r.copy(value + row * st[11], L * 4);
+    r.copy(rewards + row * st[12], L * 4);
+    r.copy(dones + row * st[13], L * 4);
+    r.copy(init_c + row * st[15], H * 4);
+    r.copy(init_h + row * st[16], H * 4);
     if (frame_aux) {
       if (want_aux && aux_win != nullptr) {
-        r.copy(aux_win + b * st[17], L * 4);
-        r.copy(aux_lh + b * st[18], L * 4);
-        r.copy(aux_nw + b * st[19], L * 4);
+        r.copy(aux_win + row * st[17], L * 4);
+        r.copy(aux_lh + row * st[18], L * 4);
+        r.copy(aux_nw + row * st[19], L * 4);
       } else {
         r.skip(L * 3 * 4);
       }
     }
     if (!r.ok) return -(b + 1);
 
-    float* m = mask + b * st[14];
+    float* m = mask + row * st[14];
     for (int64_t t = 0; t < L; ++t) m[t] = 1.0f;
     versions[b] = hdr.version;
     actor_ids[b] = hdr.actor_id;

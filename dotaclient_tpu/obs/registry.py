@@ -200,6 +200,18 @@ PREFIXES: Dict[str, str] = {
     # actor_batch_occupancy. Exported by vector actors AND the
     # inference service (same batcher, same distribution semantics).
     "actor_tick_rows_": "rows-per-fired-tick occupancy histogram (runtime/actor.py InferenceBatcher)",
+    # parallel host feed scoreboard (runtime/staging.py _PackPool +
+    # parallel/fused_io.py TransferRing, emitted by the learner loop
+    # only when --staging.pack_workers > 1):
+    # staging_pack_workers, staging_pack_worker_busy_s_<i>,
+    # staging_pack_worker_stall_s_<i> (per-worker seconds executing /
+    # idle — the worker-count sizing signal), staging_pack_ring_depth,
+    # staging_pack_ring_occupancy (slots packing/ready/in-transfer),
+    # staging_pack_ring_wait_s (assembler blocked on a free slot —
+    # nonzero means H2D/device, not pack, is the longest stage),
+    # staging_pack_wall_s, staging_pack_rows_per_s (packer-proper rate).
+    # The per-worker tail is why this is a family, not exact names.
+    "staging_pack_": "parallel host feed scoreboard (sharded pack pool + transfer ring)",
     # broker admission control + actor publish degradation:
     # broker_shed_observed_total, broker_shed_publish_failed_total,
     # broker_shed_throttle_s (runtime/actor.py ShedThrottle /

@@ -26,7 +26,8 @@ Mechanics:
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, NamedTuple, Tuple
+import queue
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -275,6 +276,13 @@ class FusedBatchIO:
                 leaves[s.index] = x
         return jax.tree.unflatten(self.treedef, leaves)
 
+    # ------------------------------------------------------- transfer ring
+
+    def make_ring(self, depth: int) -> "TransferRing":
+        """A ring of `depth` preallocated transfer-buffer sets in this
+        io's current mode (groups or single). See TransferRing."""
+        return TransferRing(self, depth)
+
     def unpack_single(self, buf: jnp.ndarray):  # graftlint: jit-region
         """[B, row_bytes] u8 → TrainBatch, inside jit: slice each group's
         byte segment, bitcast u8[..., k] to the group dtype, then the
@@ -298,3 +306,101 @@ class FusedBatchIO:
                     x = x != 0
                 leaves[s.index] = x
         return jax.tree.unflatten(self.treedef, leaves)
+
+
+class RingSlot:
+    """One preallocated transfer-buffer set with explicit ownership.
+
+    Lifecycle (TransferRing docstring): acquire() hands the slot to the
+    packer freshly RE-ZEROED to the alloc_views contract (all-zero
+    leaves + NOOP-legal action-mask padding — a reused buffer must not
+    leak the previous batch into this batch's padding); release() hands
+    it back to the free queue. release() is idempotent — a double
+    release must not duplicate the slot in the free queue (two packers
+    would then write one buffer concurrently)."""
+
+    __slots__ = ("_ring", "index", "payload", "batch", "_held")
+
+    def __init__(self, ring: "TransferRing", index: int, payload, batch):
+        self._ring = ring
+        self.index = index
+        self.payload = payload  # groups dict, or the single u8 buffer
+        self.batch = batch  # TrainBatch of leaf VIEWS into payload
+        self._held = False
+
+    def _reset(self) -> None:
+        """Zero the backing buffer(s) and restore the NOOP action-mask
+        padding — exactly zeros_train_batch's initialization contract,
+        so a reused slot packs bitwise like a fresh allocation."""
+        from dotaclient_tpu.env import featurizer as F
+
+        bufs = (
+            self.payload.values()
+            if isinstance(self.payload, dict)
+            else (self.payload,)
+        )
+        for arr in bufs:
+            arr[...] = 0
+        self.batch.obs.action_mask[:] = F.zeros_observation().action_mask
+
+    def release(self) -> None:
+        """Return the slot to the free queue (in-transfer → free). Call
+        only after the device_put of `payload` has RETIRED
+        (jax.block_until_ready on the put result): jax may defer the
+        host read of a put'd numpy buffer, and re-zeroing a buffer whose
+        transfer is still in flight ships garbage (observed on the CPU
+        backend — runtime/learner.py _fetch_next is the release site)."""
+        if self._held:
+            self._held = False
+            self._ring._free.put(self)
+
+
+class TransferRing:
+    """Ring of preallocated transfer-buffer sets with explicit ownership
+    handoff: free → packing (acquire) → ready/in-transfer (staging ready
+    queue → learner fetch → device_put) → free (release).
+
+    Replaces the one-shot alloc_transfer per batch on the parallel host
+    feed (--staging.pack_workers > 1): pack of batch N+1 proceeds into a
+    free slot while batch N's buffers are crossing H2D and batch N-1 is
+    still on device — the pipeline-overlap gap OPPO (PAPERS.md
+    2509.25762) names for PPO loops. Depth 2 (default) is classic double
+    buffering; the learner's fetch returns the slot as a lease and
+    releases it once the device_put retires, which is what makes buffer
+    REUSE safe (RingSlot.release).
+
+    Thread contract: acquire() is called by the ONE staging assembler
+    thread; release() by the ONE learner loop thread; the free queue is
+    the synchronization point. A starved acquire (every slot ready or
+    in transfer) blocks — that is the ring's backpressure, bounded by
+    depth, exactly like the ready queue's maxsize."""
+
+    def __init__(self, io: FusedBatchIO, depth: int):
+        if depth < 1:
+            raise ValueError(f"transfer ring depth must be >= 1, got {depth}")
+        self.io = io
+        self.depth = depth
+        self._free: "queue.Queue[RingSlot]" = queue.Queue()
+        self.slots = []
+        for i in range(depth):
+            payload, batch = io.alloc_transfer()
+            slot = RingSlot(self, i, payload, batch)
+            self.slots.append(slot)
+            self._free.put(slot)
+
+    def acquire(self, timeout: Optional[float] = None) -> Optional[RingSlot]:
+        """Next free slot, re-zeroed and ready to pack into; None on
+        timeout (caller re-checks its stop flag and retries)."""
+        try:
+            slot = self._free.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        slot._held = True
+        slot._reset()
+        return slot
+
+    @property
+    def occupancy(self) -> int:
+        """Slots currently out of the free queue (packing, ready, or in
+        transfer) — the staging_pack_ring_occupancy gauge."""
+        return self.depth - self._free.qsize()

@@ -858,6 +858,11 @@ class Learner:
         if batch is None:
             return None, 0, t1 - t0, 0.0, None
         trace = self.staging.last_batch_trace
+        # Ring lease (--staging.pack_workers > 1, fused mode): the batch
+        # lives in a TransferRing slot that must go back to the packers
+        # once — and only once — its device_put has retired. None on the
+        # classic path.
+        lease = self.staging.last_batch_lease
         env_steps = int(np.sum(batch.mask))
         if self.fused_io is not None:
             # Staging packed straight into the transfer buffers (groups
@@ -887,6 +892,18 @@ class Learner:
                 # Fence: the phase is the real transfer, not its dispatch.
                 jax.block_until_ready(batch_dev)
                 timer.add("h2d", time.perf_counter() - t2)
+            if lease is not None:
+                # Release the ring slot only after the device_put RETIRES:
+                # jax may defer the host read of a put numpy buffer, and a
+                # released slot is re-zeroed and repacked immediately —
+                # an in-flight transfer would ship the next batch's bytes
+                # (or zeros) to the device. The block waits on the H2D
+                # stream only, and this fetch already overlaps the
+                # in-flight device step, so the wait hides behind compute
+                # (the ParamFlattener stream-ordering argument, applied
+                # on the host side).
+                jax.block_until_ready(batch_dev)
+                lease.release()
             if self.obs is not None and trace is not None:
                 self.obs.tracer.hop_batch("h2d", trace)
             return batch_dev, env_steps, t2 - t0, time.perf_counter() - t2, trace
@@ -1082,6 +1099,14 @@ class Learner:
                     scalars["wire_bytes_consumed_total"] = stats["wire_bytes"]
                     scalars["wire_frames_obs_bf16_total"] = stats["wire_frames_obs_bf16"]
                     scalars["wire_frames_obs_f32_total"] = stats["wire_frames_obs_f32"]
+                    # Parallel host feed scoreboard (staging_pack_*,
+                    # registry prefix family): per-worker busy/stall
+                    # seconds, ring occupancy/wait, packer-proper rows/s.
+                    # The pack_* keys exist only when --staging.pack_workers
+                    # > 1, so default runs emit nothing new here.
+                    for k, v in stats.items():
+                        if k.startswith("pack_"):
+                            scalars[f"staging_{k}"] = float(v)
                     # Replay reservoir health (replay.enabled only):
                     # occupancy, hit ratio, replayed-frame age histogram
                     # buckets, bytes spilled — all pre-flattened scalars.

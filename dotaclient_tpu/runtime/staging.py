@@ -66,12 +66,20 @@ from dotaclient_tpu.transport.serialize import (
 )
 
 
-def fill_rollouts(batch: TrainBatch, rollouts: List[Rollout], seq_len: int) -> None:
+def fill_rollouts(
+    batch: TrainBatch, rollouts: List[Rollout], seq_len: int, row_offset: int = 0
+) -> None:
     """Fill a pre-zeroed TrainBatch (zeros_train_batch contract) with B
     variable-length rollouts, in place. The leaves may be strided views
     (the fused-H2D group buffers) or dense arrays; numpy assignment
     handles both, including the f32→bf16 cast when the obs leaves are
-    staged in the compute dtype."""
+    staged in the compute dtype.
+
+    `row_offset`: rollout i lands at batch row row_offset+i — the
+    python-fallback half of the sharded pack (--staging.pack_workers):
+    N workers fill disjoint contiguous row ranges of the SAME batch
+    concurrently; rows never overlap and each row depends only on its
+    own rollout, so any split is bitwise identical to one call."""
     T = seq_len
     obs, actions, aux = batch.obs, batch.actions, batch.aux
     # np.errstate: same untrusted-float story as cast_obs_to_compute_dtype
@@ -79,7 +87,8 @@ def fill_rollouts(batch: TrainBatch, rollouts: List[Rollout], seq_len: int) -> N
     # assignment IS the f32→bf16 cast, so NaN/inf/out-of-range wire
     # values would emit per-batch RuntimeWarnings here.
     with np.errstate(invalid="ignore", over="ignore"):
-        for b, r in enumerate(rollouts):
+        for i, r in enumerate(rollouts):
+            b = row_offset + i
             L = r.length
             if L > T:
                 raise ValueError(f"rollout length {L} exceeds learner seq_len {T}")
@@ -98,6 +107,144 @@ def fill_rollouts(batch: TrainBatch, rollouts: List[Rollout], seq_len: int) -> N
                 aux.win[b, :L] = r.aux.win
                 aux.last_hit[b, :L] = r.aux.last_hit
                 aux.net_worth[b, :L] = r.aux.net_worth
+
+
+def shard_rows(total: int, workers: int) -> List[tuple]:
+    """Contiguous (offset, count) row shards, as even as possible: the
+    first total%workers shards get one extra row. Fewer rows than
+    workers degenerates to one-row shards (never empty ones)."""
+    n = max(1, min(workers, total))
+    base, rem = divmod(total, n)
+    shards = []
+    off = 0
+    for i in range(n):
+        cnt = base + (1 if i < rem else 0)
+        shards.append((off, cnt))
+        off += cnt
+    return shards
+
+
+class _StagingStopped(Exception):
+    """Internal: a sharded pack was abandoned because stop() landed
+    mid-batch (ring acquire or pool join interrupted). Not a frame
+    error — the pack loop exits without counting dropped_bad."""
+
+
+class _ShardJob:
+    """Countdown latch for one sharded batch: N tasks share one event,
+    the last finisher sets it — the dispatcher pays ONE wait, not N."""
+
+    __slots__ = ("event", "errors", "_remaining", "_lock")
+
+    def __init__(self, n: int):
+        self.event = threading.Event()
+        self.errors: List[BaseException] = []
+        self._remaining = n
+        self._lock = threading.Lock()
+
+    def done_one(self, error: Optional[BaseException]) -> None:
+        with self._lock:
+            if error is not None:
+                self.errors.append(error)
+            self._remaining -= 1
+            last = self._remaining == 0
+        if last:
+            self.event.set()
+
+
+class _PackPool:
+    """--staging.pack_workers packer threads executing row-shard tasks.
+
+    Each task packs a disjoint row range of ONE shared output buffer
+    (native: dt_pack_batch with row_offset, GIL released → real
+    parallelism; python fallback: fill_rollouts with row_offset). The
+    meters feed the registry-pinned staging_pack_* scalars: per-worker
+    busy seconds (executing a shard) and stall seconds (idle, waiting
+    for work) — a pool whose stall dwarfs busy is oversized for the
+    offered batch rate. All meters live under one lock; workers touch it
+    twice per task, microseconds against a ~ms pack."""
+
+    def __init__(self, workers: int, name: str = "staging-pack"):
+        self.n = workers
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._meters_lock = threading.Lock()
+        self._busy_s = [0.0] * workers
+        self._stall_s = [0.0] * workers
+        self._tasks_done = 0
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True, name=f"{name}-{i}")
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self, i: int) -> None:
+        while True:
+            t0 = time.perf_counter()
+            try:
+                task = self._tasks.get(timeout=0.2)
+            except queue.Empty:
+                with self._meters_lock:
+                    self._stall_s[i] += time.perf_counter() - t0
+                if self._stop.is_set():
+                    return
+                continue
+            with self._meters_lock:
+                self._stall_s[i] += time.perf_counter() - t0
+            fn, job = task
+            t1 = time.perf_counter()
+            # (workers never see a None task: dispatch is run_tasks only,
+            # and shutdown rides the _stop event + empty-queue check)
+            error = None
+            try:
+                fn()
+            except BaseException as e:  # the dispatcher re-raises, typed
+                error = e
+            finally:
+                with self._meters_lock:
+                    self._busy_s[i] += time.perf_counter() - t1
+                    self._tasks_done += 1
+                job.done_one(error)
+
+    def run_tasks(self, thunks, stop: threading.Event):
+        """Dispatch the thunks (one per row shard) and wait for all.
+        Returns None on success, the most severe error otherwise
+        (BatchLayoutError outranks ValueError — fatal beats drop), or
+        _StagingStopped when teardown interrupted the batch."""
+        job = _ShardJob(len(thunks))
+        for fn in thunks:
+            self._tasks.put((fn, job))
+        while not job.event.wait(timeout=0.2):
+            # Workers only exit when stopped AND the task queue was
+            # empty at their last check; a task enqueued after every
+            # worker exited would wait forever — detect and abandon.
+            if stop.is_set() and not any(t.is_alive() for t in self._threads):
+                return _StagingStopped()
+        layout = other = None
+        for e in job.errors:
+            if isinstance(e, BatchLayoutError):
+                layout = layout or e
+            else:
+                other = other or e
+        return layout or other
+
+    def run_sharded(self, task_fn, shards, stop: threading.Event):
+        """run_tasks over task_fn(offset, count) thunks — the
+        convenience entry benches/tests use."""
+        return self.run_tasks(
+            [(lambda o=off, c=cnt: task_fn(o, c)) for off, cnt in shards], stop
+        )
+
+    def meters(self):
+        """(busy_s list, stall_s list, tasks_done) — one locked snapshot."""
+        with self._meters_lock:
+            return list(self._busy_s), list(self._stall_s), self._tasks_done
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
 
 
 def pack_rollouts(rollouts: List[Rollout], seq_len: int, with_aux: bool) -> TrainBatch:
@@ -201,9 +348,45 @@ class StagingBuffer:
         self._fused_io = fused_io
         # python path: Rollout objects; native path: raw frame bytes
         self._pending: List = []
-        # queue items: (TrainBatch, groups-dict-or-None)
+        # queue items: (TrainBatch, groups-dict-or-None, traces, lease)
         self._ready: "queue.Queue" = queue.Queue(maxsize=2)
         self._stop = threading.Event()
+        # Parallel host feed (--staging.pack_workers > 1): a dedicated
+        # pop thread drains the broker into a bounded intake queue, an
+        # ASSEMBLER thread owns everything the consumer thread owned
+        # (parse/filter/_pending/reservoir — the single-writer
+        # discipline moves wholesale, it never splits), and a pool of
+        # pack workers fills disjoint row shards of one output buffer
+        # concurrently. In fused mode the outputs come from a
+        # TransferRing of cfg.staging.transfer_depth preallocated
+        # buffer sets (pack N+1 overlaps H2D of N); the learner's fetch
+        # carries the slot as a lease (last_batch_lease) released after
+        # its device_put retires. pack_workers=1 (default) builds NONE
+        # of this — the classic one-consumer-thread path, byte-for-byte
+        # (the inertness contract, proven in a subprocess in
+        # tests/test_staging.py).
+        from dotaclient_tpu.config import StagingConfig
+
+        self._staging_cfg = getattr(cfg, "staging", None) or StagingConfig()
+        if self._staging_cfg.pack_workers < 1:
+            raise ValueError(
+                f"staging.pack_workers must be >= 1, got "
+                f"{self._staging_cfg.pack_workers}"
+            )
+        self._pool: Optional[_PackPool] = None
+        self._ring = None
+        # slot.index → per-shard native.PackPlan list (ring mode only)
+        self._slot_plans: Dict[int, List] = {}
+        self._intake: Optional["queue.Queue"] = None
+        self._assembler: Optional[threading.Thread] = None
+        # True while the pop thread holds a popped-but-not-yet-enqueued
+        # drain in its locals (set under _mutate_lock, the _packing
+        # pattern) — drained() must see those frames.
+        self._popping = False
+        # Lease of the batch most recently returned by a getter (None on
+        # the classic path). Single-consumer contract, like
+        # last_batch_trace: only the learner loop pops batches.
+        self.last_batch_lease = None
         # SIGTERM drain: once set, the consumer stops popping the broker
         # but keeps packing already-pending frames into full batches —
         # the learner trains those out, then checkpoints the (< B)
@@ -321,6 +504,13 @@ class StagingBuffer:
             "wire_frames_obs_bf16": 0,
             "wire_frames_obs_f32": 0,
         }
+        if self._staging_cfg.pack_workers > 1:
+            # Parallel-feed meters, present ONLY in pool mode so default
+            # runs emit no new scalars (stats() copies this dict and the
+            # learner re-emits pack_* as the registry-pinned
+            # staging_pack_* family).
+            self._stats["pack_wall_s"] = 0.0
+            self._stats["pack_ring_wait_s"] = 0.0
 
     @property
     def native(self) -> bool:
@@ -334,11 +524,103 @@ class StagingBuffer:
         # scripts/train_north_star.py) can reuse one buffer
         self._stop.clear()
         self._quiesce.clear()
+        if self._staging_cfg.pack_workers > 1:
+            # Pool mode: fresh intake/pool/ring per start — stop() joins
+            # the old threads, and ring slots may still be leased by a
+            # finished learner loop, so reuse would alias live buffers.
+            self._intake = queue.Queue(maxsize=4)
+            self._pool = _PackPool(self._staging_cfg.pack_workers)
+            if self._fused_io is not None:
+                self._ring = self._fused_io.make_ring(self._staging_cfg.transfer_depth)
+                self._slot_plans = {}  # plans point into the OLD ring's buffers
+            self._assembler = threading.Thread(
+                target=self._run_assembler, daemon=True, name="staging-assembler"
+            )
+            self._assembler.start()
+            self._thread = threading.Thread(
+                target=self._run_pop, daemon=True, name="staging-consumer"
+            )
+            self._thread.start()
+            return self
         self._thread = threading.Thread(target=self._run, daemon=True, name="staging-consumer")
         self._thread.start()
         return self
 
+    def _die_on_layout(self, e: BaseException) -> None:
+        """Persistent builder/staging config disagreement: crash the
+        consumer LOUDLY (ADVICE r5 item 1). The learner-side getters
+        re-raise _fatal so the failure is fast, not a silent per-batch
+        dropped_bad starvation."""
+        _log.critical("staging layout/config mismatch; consumer dying: %s", e)
+        if self._recorder is not None:
+            # Soak/nightly BatchLayoutError deaths were unreproducible —
+            # dump the recent pipeline events (incl. the offending
+            # chunks' trace hops) before dying.
+            self._recorder.record("batch_layout_error", error=str(e))
+            self._recorder.dump("batch_layout_error")
+        self._fatal = e
+        self._stop.set()
+
+    def _pack_pending_loop(self, B: int) -> None:
+        """Pack as many full batches as _pending affords into the ready
+        queue. Runs on the consumer thread (classic) or the assembler
+        thread (pool mode) — the thread that owns _pending either way."""
+        while not self._stop.is_set():
+            with self._mutate_lock:
+                items, staleness, traces = self._next_batch_items(B)
+                # In-flight marker, set under the SAME lock hold that
+                # popped the frames: between here and the ready-queue put
+                # the batch lives only in this thread's locals, and a
+                # quiesced drained() that ignored it would let a SIGTERM
+                # drain stop one batch early — silently losing popped
+                # frames.
+                self._packing = items is not None
+            if items is None:
+                break
+            t_pack = time.perf_counter()
+            try:
+                batch, groups, lease = self._pack(items)
+            except BatchLayoutError:
+                # layout/config mismatch: fails every batch, not this
+                # batch — propagate to the fatal handler in the caller
+                raise
+            except _StagingStopped:
+                # stop() landed mid-batch (ring acquire / pool join
+                # interrupted): not a frame error, just exit
+                self._packing = False
+                break
+            except ValueError:
+                # a frame passed ingest validation but failed the
+                # packer — drop the batch, never livelock on it
+                _log.exception("packer rejected a batch; dropping %d frames", len(items))
+                with self._stats_lock:
+                    self._stats["dropped_bad"] += len(items)
+                self._packing = False
+                continue
+            if staleness is not None:
+                batch = batch._replace(
+                    behavior_staleness=np.asarray(staleness, np.float32)
+                )
+            if self._tracer is not None and traces is not None:
+                self._tracer.hop_batch("pack", traces)
+            with self._stats_lock:
+                self._stats["batches"] += 1
+                self._stats["rows_packed"] += len(items)
+                if "pack_wall_s" in self._stats:
+                    self._stats["pack_wall_s"] += time.perf_counter() - t_pack
+                if staleness is not None:
+                    self._stats["rows_replayed"] += sum(1 for s in staleness if s > 0)
+            while not self._stop.is_set():
+                try:
+                    self._ready.put((batch, groups, traces, lease), timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            self._packing = False  # batch visible in _ready (or dead with _stop)
+
     def _run(self) -> None:
+        """Classic single consumer thread (pack_workers=1): pop → parse →
+        pack, all here — byte-for-byte the pre-pool behavior."""
         B = self.cfg.batch_size
         while not self._stop.is_set():
             try:
@@ -353,69 +635,79 @@ class StagingBuffer:
                 if frames:
                     with self._mutate_lock:
                         self._ingest(frames)
-                while not self._stop.is_set():
-                    with self._mutate_lock:
-                        items, staleness, traces = self._next_batch_items(B)
-                        # In-flight marker, set under the SAME lock hold
-                        # that popped the frames: between here and the
-                        # ready-queue put the batch lives only in this
-                        # thread's locals, and a quiesced drained() that
-                        # ignored it would let a SIGTERM drain stop one
-                        # batch early — silently losing popped frames.
-                        self._packing = items is not None
-                    if items is None:
-                        break
-                    try:
-                        batch, groups = self._pack(items)
-                    except BatchLayoutError:
-                        # layout/config mismatch: fails every batch, not
-                        # this batch — propagate to the fatal handler below
-                        raise
-                    except ValueError:
-                        # a frame passed ingest validation but failed the
-                        # packer — drop the batch, never livelock on it
-                        _log.exception("packer rejected a batch; dropping %d frames", len(items))
-                        with self._stats_lock:
-                            self._stats["dropped_bad"] += len(items)
-                        self._packing = False
-                        continue
-                    if staleness is not None:
-                        batch = batch._replace(
-                            behavior_staleness=np.asarray(staleness, np.float32)
-                        )
-                    if self._tracer is not None and traces is not None:
-                        self._tracer.hop_batch("pack", traces)
-                    with self._stats_lock:
-                        self._stats["batches"] += 1
-                        self._stats["rows_packed"] += len(items)
-                        if staleness is not None:
-                            self._stats["rows_replayed"] += sum(1 for s in staleness if s > 0)
-                    while not self._stop.is_set():
-                        try:
-                            self._ready.put((batch, groups, traces), timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
-                    self._packing = False  # batch visible in _ready (or dead with _stop)
+                self._pack_pending_loop(B)
             except BatchLayoutError as e:
-                # Persistent builder/staging config disagreement: crash the
-                # consumer LOUDLY (ADVICE r5 item 1). The learner-side
-                # getters re-raise _fatal so the failure is fast, not a
-                # silent per-batch dropped_bad starvation.
-                _log.critical("staging layout/config mismatch; consumer dying: %s", e)
-                if self._recorder is not None:
-                    # Soak/nightly BatchLayoutError deaths were
-                    # unreproducible — dump the recent pipeline events
-                    # (incl. the offending chunks' trace hops) before dying.
-                    self._recorder.record("batch_layout_error", error=str(e))
-                    self._recorder.dump("batch_layout_error")
-                self._fatal = e
-                self._stop.set()
+                self._die_on_layout(e)
                 raise
             except Exception:
                 # The consumer thread must never die silently — a dead
                 # consumer hangs the learner in get_batch forever.
                 _log.exception("staging consumer error; continuing")
+                with self._stats_lock:
+                    self._stats["consumer_errors"] += 1
+
+    def _run_pop(self) -> None:
+        """Pool-mode pop thread: drain the broker into the intake queue
+        and NOTHING else — broker pops never sit behind parse or pack
+        (the single-consumer serialization the parallel feed removes).
+        The intake bound (4 drains) is the backpressure that stops an
+        outrun learner from buffering the broker into learner RAM."""
+        B = self.cfg.batch_size
+        while not self._stop.is_set():
+            try:
+                if self._quiesce.is_set():
+                    time.sleep(0.02)
+                    continue
+                with self._mutate_lock:
+                    # drained() must account a drain held in this
+                    # thread's locals between pop and intake put — the
+                    # same visibility contract as _packing.
+                    self._popping = True
+                try:
+                    frames = self.broker.consume_experience(max_items=B, timeout=0.2)
+                    if frames:
+                        while not self._stop.is_set():
+                            try:
+                                self._intake.put(frames, timeout=0.2)
+                                break
+                            except queue.Full:
+                                continue
+                finally:
+                    with self._mutate_lock:
+                        self._popping = False
+            except Exception:
+                _log.exception("staging pop error; continuing")
+                with self._stats_lock:
+                    self._stats["consumer_errors"] += 1
+
+    def _run_assembler(self) -> None:
+        """Pool-mode assembler: the single-writer owner of _pending, the
+        reservoir, heartbeats, and quarantine (the whole consumer role
+        minus the broker pop). Parses each intake drain (the batched C
+        header parse releases the GIL, so this genuinely overlaps the
+        pop thread and the pack workers), forms batches, and dispatches
+        row-sharded packs to the worker pool."""
+        B = self.cfg.batch_size
+        while not self._stop.is_set():
+            try:
+                try:
+                    frames = self._intake.get(timeout=0.2)
+                except queue.Empty:
+                    frames = None
+                if frames is not None:
+                    try:
+                        with self._mutate_lock:
+                            self._ingest(frames)
+                    finally:
+                        # unfinished_tasks hits 0 only after the frames
+                        # are visible in _pending — the drained() handoff
+                        self._intake.task_done()
+                self._pack_pending_loop(B)
+            except BatchLayoutError as e:
+                self._die_on_layout(e)
+                raise
+            except Exception:
+                _log.exception("staging assembler error; continuing")
                 with self._stats_lock:
                     self._stats["consumer_errors"] += 1
 
@@ -469,15 +761,20 @@ class StagingBuffer:
         return items, staleness, traces
 
     def _pack(self, items: List):
-        """(TrainBatch, groups-or-None). Fused mode packs straight into
-        leaf views of the dtype-grouped transfer buffers (no regroup
-        copy later); dense mode matches the original layout."""
+        """(TrainBatch, groups-or-None, lease-or-None). Fused mode packs
+        straight into leaf views of the dtype-grouped transfer buffers
+        (no regroup copy later); dense mode matches the original layout.
+        Pool mode (pack_workers > 1) row-shards the same copy across the
+        worker pool — bitwise identical output for any split — and in
+        fused mode targets a TransferRing slot, returned as the lease."""
         # Fuse the compute-dtype obs cast into the copy when staging
         # targets bf16 (bitwise equal to the separate numpy astype pass
         # it replaces; ~1.1ms/batch at flagship shapes).
         obs_bf16 = (
             self.cfg.stage_obs_compute_dtype and self.cfg.policy.dtype == "bfloat16"
         )
+        if self._pool is not None:
+            return self._pack_sharded(items, obs_bf16)
         if self._fused_io is not None:
             # payload: groups dict, or ONE u8 buffer in single mode —
             # opaque here; the learner ships it with io.transfer_shardings()
@@ -499,7 +796,7 @@ class StagingBuffer:
                 # assignment cast) transparently; no post-cast — it
                 # would detach the leaves from the transfer buffers.
                 fill_rollouts(out, items, self.cfg.seq_len)
-            return out, groups
+            return out, groups, None
         if self._lib is not None:
             from dotaclient_tpu import native
 
@@ -512,10 +809,99 @@ class StagingBuffer:
                 obs_bf16=obs_bf16,
             )
             if obs_bf16:
-                return batch, None  # cast already applied in-copy
-            return cast_obs_to_compute_dtype(self.cfg, batch), None
+                return batch, None, None  # cast already applied in-copy
+            return cast_obs_to_compute_dtype(self.cfg, batch), None, None
         batch = pack_rollouts(items, self.cfg.seq_len, self.cfg.policy.aux_heads)
-        return cast_obs_to_compute_dtype(self.cfg, batch), None
+        return cast_obs_to_compute_dtype(self.cfg, batch), None, None
+
+    def _pack_sharded(self, items: List, obs_bf16: bool):
+        """Pool-mode pack: N workers each fill a disjoint contiguous row
+        range of ONE output buffer (native: dt_pack_batch row_offset,
+        GIL released; python: fill_rollouts row_offset). Fused mode
+        targets a re-zeroed TransferRing slot — returned as the lease
+        the learner releases once the device_put retires; dense mode
+        allocates fresh (exactly the classic layout/cast semantics)."""
+        B = len(items)
+        T = self.cfg.seq_len
+        H = self.cfg.policy.lstm_hidden
+        aux = self.cfg.policy.aux_heads
+        lease = None
+        if self._fused_io is not None:
+            t0 = time.perf_counter()
+            slot = None
+            while slot is None:
+                if self._stop.is_set():
+                    raise _StagingStopped()
+                # Ring backpressure: every slot packing/ready/in-transfer.
+                slot = self._ring.acquire(timeout=0.2)
+            with self._stats_lock:
+                self._stats["pack_ring_wait_s"] += time.perf_counter() - t0
+            out, payload, lease = slot.batch, slot.payload, slot
+            if self._lib is not None:
+                # Ring slots are long-lived: the per-shard ctypes glue
+                # (20-leaf stride validation + 24 pointer marshals,
+                # ~0.06 ms GIL-held per call) is identical every batch —
+                # prebuild one PackPlan per (slot, shard) and pay only
+                # the frame-pointer marshal per call (native.PackPlan).
+                plans = self._slot_plans.get(slot.index)
+                if plans is None:
+                    from dotaclient_tpu import native
+
+                    plans = [
+                        native.PackPlan(
+                            self._lib, out, cnt, T, H, aux, obs_bf16, off, B
+                        )
+                        for off, cnt in shard_rows(B, self._pool.n)
+                    ]
+                    self._slot_plans[slot.index] = plans
+                err = self._pool.run_tasks(
+                    [
+                        (lambda p=p: p.pack(items[p.row_offset : p.row_offset + p.n]))
+                        for p in plans
+                    ],
+                    self._stop,
+                )
+                if err is not None:
+                    lease.release()
+                    raise err
+                return out, payload, lease
+        else:
+            payload = None
+            obs_dtype = None
+            if obs_bf16 and self._lib is not None:
+                import ml_dtypes
+
+                obs_dtype = ml_dtypes.bfloat16
+            from dotaclient_tpu.ops.batch import zeros_train_batch
+
+            out = zeros_train_batch(B, T, H, aux, obs_dtype=obs_dtype)
+        if self._lib is not None:
+            from dotaclient_tpu import native
+
+            lib = self._lib
+
+            def task(off, cnt):
+                native.pack_frames(
+                    lib, items[off : off + cnt], T, H, aux,
+                    obs_bf16=obs_bf16, out=out, row_offset=off, total_rows=B,
+                )
+        else:
+
+            def task(off, cnt):
+                fill_rollouts(out, items[off : off + cnt], T, row_offset=off)
+
+        err = self._pool.run_sharded(task, shard_rows(B, self._pool.n), self._stop)
+        if err is not None:
+            if lease is not None:
+                # failed batch: the slot goes straight back to free —
+                # nothing downstream will ever release it
+                lease.release()
+            raise err
+        if self._fused_io is not None:
+            return out, payload, lease
+        if self._lib is not None and obs_bf16:
+            return out, None, None  # cast applied in-copy
+        return cast_obs_to_compute_dtype(self.cfg, out), None, None
 
     def _parse(self, frame: bytes):
         """PYTHON-fallback frame parse → ((Rollout, version, L, H,
@@ -776,28 +1162,46 @@ class StagingBuffer:
                 continue
 
     def get_batch(self, timeout: Optional[float] = None) -> Optional[TrainBatch]:
+        """One packed batch (or None on timeout). On the ring path
+        (pack_workers > 1 with fused_io) the batch's leaves are views
+        into a leased ring slot — the caller must release
+        `last_batch_lease` once done, exactly like get_batch_groups, or
+        the ring stalls after transfer_depth batches."""
         try:
-            return self._get_ready(timeout)[0]
+            item = self._get_ready(timeout)
         except queue.Empty:
+            self.last_batch_lease = None
             return None
+        self.last_batch_lease = item[3]
+        return item[0]
 
     def get_batch_groups(self, timeout: Optional[float] = None):
         """(TrainBatch, groups) — `groups` is the ready-to-ship fused-H2D
         buffer dict when the buffer was built with fused_io, else None
         (caller falls back to io.pack). The batch's leaves are views into
-        `groups`; consume before the next two batches overwrite nothing —
-        every batch allocates fresh buffers, so no aliasing hazard.
+        `groups`.
 
-        Side channel: `self.last_batch_trace` is set to the returned
+        Classic path (pack_workers=1): every batch allocates fresh
+        buffers, so no aliasing hazard. Ring path (pack_workers>1):
+        `groups` is a leased TransferRing slot — the caller must release
+        `self.last_batch_lease` AFTER the device_put of `groups` has
+        retired (jax.block_until_ready), at which point the slot may be
+        re-zeroed and repacked; holding leases is the ring's
+        backpressure.
+
+        Side channels: `self.last_batch_trace` is set to the returned
         batch's trace refs (or None) — the learner records the h2d/apply
-        hops from it. Single-consumer by contract (only the learner loop
-        pops batches), so the attribute read is race-free."""
+        hops from it — and `self.last_batch_lease` to the ring lease (or
+        None). Single-consumer by contract (only the learner loop pops
+        batches), so the attribute reads are race-free."""
         try:
-            batch, groups, traces = self._get_ready(timeout)
+            batch, groups, traces, lease = self._get_ready(timeout)
         except queue.Empty:
             self.last_batch_trace = None
+            self.last_batch_lease = None
             return None, None
         self.last_batch_trace = traces
+        self.last_batch_lease = lease
         return batch, groups
 
     # -- checkpoint / drain support --------------------------------------
@@ -820,7 +1224,15 @@ class StagingBuffer:
         run() call). `timeout` bounds the wait against a consumer
         wedged inside a mutation (e.g. a ready-queue put stuck behind a
         stalled learner): the checkpoint degrades to state-only rather
-        than stalling durability."""
+        than stalling durability.
+
+        Pool mode: the cut covers _pending + the reservoir (the
+        assembler holds this same lock at both its mutation sites).
+        Frames mid-flight in the intake queue are NOT snapshotted —
+        bounded by the intake depth (4 drains), the same exposure class
+        as the classic path's pop-to-ingest window; the SIGTERM drain is
+        unaffected (drained() accounts every upstream station, so a
+        drain trains those frames out before the final save)."""
         if not self._mutate_lock.acquire(timeout=timeout):
             return None
         try:
@@ -857,6 +1269,18 @@ class StagingBuffer:
         of consumer-owned counters (len/occupancy) are single GIL-atomic
         calls; a one-frame drift only delays the verdict by one poll."""
         if not self._quiesce.is_set():
+            return False
+        # Pool mode adds two upstream stations frames can occupy: the
+        # pop thread's locals (_popping, the _packing pattern) and the
+        # intake queue (unfinished_tasks stays nonzero until the
+        # assembler's ingest has made the frames visible in _pending).
+        # Check stations UPSTREAM-first — frames only move downstream
+        # (pop → intake → pending → in-flight pack → ready), so a frame
+        # crossing a boundary mid-check is seen at the later station.
+        with self._mutate_lock:
+            if self._popping:
+                return False
+        if self._intake is not None and self._intake.unfinished_tasks:
             return False
         # (packing, pending) must be observed atomically with the
         # consumer's pop — it sets _packing under this same lock hold
@@ -896,9 +1320,31 @@ class StagingBuffer:
             # Fraction of packed rows served from the reservoir — the
             # headline "how much previously-wasted work is being reused".
             out["replay_hit_ratio"] = out["rows_replayed"] / max(out["rows_packed"], 1)
+        if self._pool is not None:
+            # Parallel-feed scoreboard (staging_pack_* once the learner
+            # re-emits them): per-worker busy/stall seconds, ring
+            # occupancy, and packer-proper rows/s (rows over the summed
+            # per-batch pack walls — the sharded-pack rate itself, not
+            # the e2e rate).
+            busy, stall, _done = self._pool.meters()
+            out["pack_workers"] = float(self._pool.n)
+            for i in range(self._pool.n):
+                out[f"pack_worker_busy_s_{i}"] = round(busy[i], 4)
+                out[f"pack_worker_stall_s_{i}"] = round(stall[i], 4)
+            if self._ring is not None:
+                out["pack_ring_depth"] = float(self._ring.depth)
+                out["pack_ring_occupancy"] = float(self._ring.occupancy)
+            out["pack_rows_per_s"] = out["rows_packed"] / max(
+                out.get("pack_wall_s", 0.0), 1e-9
+            )
         return out
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._assembler is not None:
+            self._assembler.join(timeout=5)
+            self._assembler = None
+        if self._pool is not None:
+            self._pool.stop()

@@ -305,6 +305,14 @@ def main(argv=None) -> int:
         raise RuntimeError(
             f"--platform tpu but devices are {jax.devices()[0].platform!r}"
         )
+    # Stray-listener preflight (obs/preflight): this soak binds a FIXED
+    # broker port — an already-listening stray would swallow the spawn
+    # below and the soak would measure a foreign process. Fail loudly
+    # with the pid; the disclosure rides the artifact.
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+
+    host_preflight = preflight_check("aggregate_soak", ports=[PORT])
+
     policy = _policy_for(args.policy)
     lcfg = LearnerConfig(
         batch_size=args.batch_size, seq_len=16, policy=policy, publish_every=1
@@ -332,6 +340,7 @@ def main(argv=None) -> int:
         "host": "1 CPU core — see module docstring for why the claim splits "
         "into phases A (fan-in at the bar, no competing learner compute) and "
         "B (closed-loop stability under a live learner)",
+        "host_preflight": host_preflight,
         "learner_platform": args.platform,
         "policy": args.policy,
         "batch": f"{lcfg.batch_size}x{lcfg.seq_len}",

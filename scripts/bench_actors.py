@@ -186,6 +186,13 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     warmup_s = args.warmup_seconds or max(3.0, args.seconds / 2.0)
 
+    # Stray-listener preflight (obs/preflight): fail loudly before
+    # measuring if a leftover serve/broker process is eating the cores
+    # both arms compute on; the disclosure rides the artifact.
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+
+    host_preflight = preflight_check("bench_actors")
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # actors are CPU processes
@@ -263,6 +270,7 @@ def main(argv=None) -> int:
             "python": platform.python_version(),
             "jax": jax.__version__,
         },
+        "host_preflight": host_preflight,
         "policy": args.policy,
         "seconds_per_config": args.seconds,
         "baseline_single": baseline,

@@ -372,6 +372,14 @@ def main(argv=None) -> int:
 
     import jax  # host stamp only; the work happens in subprocesses
 
+    # Preflight BEFORE any server/child starts: a stray serve/broker
+    # process from an earlier run eats the measured arms' cores and
+    # silently skews the verdict (the r10 host-variance lesson). Fails
+    # loudly with the pid; the disclosure rides the artifact below.
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+
+    host_preflight = preflight_check("bench_serve")
+
     # The committed PR-5 per-process operating curve: the verdict's
     # baseline (and the ISSUE's). Missing file / unmatched N = no
     # anchor at that point (quick runs on other env counts).
@@ -484,6 +492,9 @@ def main(argv=None) -> int:
             "python": platform.python_version(),
             "jax": jax.__version__,
         },
+        # Stray-listener scan + load at measurement time (obs/preflight):
+        # the verdict is only as good as the host it ran on.
+        "host_preflight": host_preflight,
         "policy": args.policy,
         "seconds_per_config": args.seconds,
         "serve_config": {"gather_window_s": args.gather_window_s, "max_batch": "min(N, 8)"},

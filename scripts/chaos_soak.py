@@ -227,6 +227,13 @@ def main(argv=None) -> int:
     from dotaclient_tpu.transport.base import RetryPolicy
     from dotaclient_tpu.transport.tcp import TcpBroker
 
+    # Stray-listener preflight (obs/preflight): a leftover broker/serve
+    # process would both skew the soak's host budget and potentially
+    # cross-talk with this run's tcp traffic — fail loudly with the pid.
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+
+    host_preflight = preflight_check("chaos_soak")
+
     kill_clauses = ",".join(
         f"kill@{c.split(':')[0]}:{c.split(':')[1]}" for c in args.kills.split(",") if c
     )
@@ -260,6 +267,7 @@ def main(argv=None) -> int:
     port = inc.port
     artifact = {
         "host": "single host, real tcp transport, CPU learner (tiny policy)",
+        "host_preflight": host_preflight,
         "seed": args.seed,
         "spec": chaos_spec,
         "watermarks": {"maxlen": args.maxlen, "shed_high": args.shed_high, "shed_low": args.shed_low},

@@ -675,8 +675,11 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+
     artifact = {
         "host": "single host, CPU learner (tiny policy); part A mem transport, part B tcp",
+        "host_preflight": preflight_check("resume_soak"),
         "seed": args.seed,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "budgets": {

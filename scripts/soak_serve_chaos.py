@@ -275,9 +275,12 @@ def main(argv=None) -> int:
     from dotaclient_tpu.transport.base import RetryPolicy
     from dotaclient_tpu.transport.tcp import BrokerServer, TcpBroker
 
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+
     policy = _tiny_policy()
     artifact = {
         "host": "single host, in-process serve replicas, real tcp experience/weights broker, CPU learner (tiny policy)",
+        "host_preflight": preflight_check("soak_serve_chaos"),
         "envs": args.envs,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }

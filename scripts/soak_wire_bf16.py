@@ -130,10 +130,14 @@ def main(argv=None) -> int:
         ppo=PPOConfig(max_staleness=256),
         obs=ObsConfig(enabled=True, install_handlers=False, step_phases=False),
     )
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+
+    host_preflight = preflight_check("soak_wire_bf16")
     srv = BrokerServer(port=0).start()
     port = srv.port
     artifact = {
         "generated_by": "scripts/soak_wire_bf16.py",
+        "host_preflight": host_preflight,
         "topology": "real tcp broker, CPU learner (tiny policy), genuine actors (fake env)",
         "batch": f"{lcfg.batch_size}x{lcfg.seq_len}",
         "phase_s": args.phase_s,

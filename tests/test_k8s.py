@@ -390,3 +390,28 @@ def test_kubectl_dry_run_if_available():
             text=True,
         )
         assert proc.returncode == 0, f"{path.name}: {proc.stderr}"
+
+
+def test_learner_pack_workers_sized_to_cpu_request():
+    """Parallel host feed (PR 11): every learner manifest ships
+    --staging.pack_workers sized by the README rule — one packer worker
+    per 4 cpu-request cores, capped at 4 (pack is copy-bound; workers
+    past the memory-bandwidth knee only add contention). A manifest that
+    raises the cpu request without re-deriving the worker count, or
+    ships workers with no cpu basis, fails here."""
+    for name in ("learner", "learner-multihost"):
+        (_, doc), = [
+            (f, d) for f, d in DOCS
+            if d["metadata"]["name"] == name and d["kind"] != "Service"
+        ]
+        c = doc["spec"]["template"]["spec"]["containers"][0]
+        args = c["args"]
+        assert "--staging.pack_workers" in args, f"{name}: parallel feed not sized"
+        workers = int(args[args.index("--staging.pack_workers") + 1])
+        cpu_req = c["resources"]["requests"]["cpu"]
+        cores = float(cpu_req.rstrip("m")) / (1000.0 if cpu_req.endswith("m") else 1.0)
+        expect = max(1, min(4, int(cores // 4)))
+        assert workers == expect, (
+            f"{name}: pack_workers {workers} != sizing rule min(4, cpu_request//4) "
+            f"= {expect} for cpu request {cpu_req}"
+        )

@@ -440,3 +440,95 @@ def test_single_buffer_pack_bitwise_matches_dense(obs_bf16):
             np.ascontiguousarray(a).view(np.uint8), np.ascontiguousarray(b).view(np.uint8)
         )
     np.testing.assert_array_equal(buf, io.pack_transfer(dense))
+
+
+# --- sharded pack (ISSUE 11): row_offset C path + PackPlan -------------
+
+
+def test_row_offset_sharded_pack_bitwise_matches_dense():
+    """N dt_pack_batch calls over disjoint row ranges of ONE out batch
+    (incl. an uneven split) must equal the one-call pack bitwise — the
+    C half of the --staging.pack_workers contract, through the fused
+    strided views (the production target)."""
+    from dotaclient_tpu.runtime.staging import shard_rows
+
+    rollouts = [make_rollout(L=3 + (i % 4), H=8, seed=i, actor_id=i) for i in range(7)]
+    for r in rollouts:
+        r.obs.global_feats[0, :3] = [np.nan, 1.00390625, -1.00390625]
+    frames = [serialize_rollout(r) for r in rollouts]
+    dense = native.pack_frames(lib, frames, 8, 8, False, obs_bf16=True)
+    io = _template_from(dense)
+    for workers in (2, 3):  # 3 over 7 rows = uneven (3/2/2)
+        groups, out = io.alloc_views()
+        for off, cnt in shard_rows(len(frames), workers):
+            native.pack_frames(
+                lib, frames[off : off + cnt], 8, 8, False, obs_bf16=True,
+                out=out, row_offset=off, total_rows=len(frames),
+            )
+        import jax
+
+        for a, b in zip(jax.tree.leaves(dense), jax.tree.leaves(out)):
+            np.testing.assert_array_equal(
+                np.ascontiguousarray(a).view(np.uint8),
+                np.ascontiguousarray(b).view(np.uint8),
+            )
+
+
+def test_row_offset_validation():
+    """row_offset/total_rows misuse fails loudly at the pack boundary:
+    shards outside the out batch and row_offset without an out are
+    config errors, never silent memory stomps."""
+    from dotaclient_tpu.ops.batch import BatchLayoutError
+
+    frames = [serialize_rollout(make_rollout(L=3, H=8, seed=i)) for i in range(4)]
+    dense = native.pack_frames(lib, frames, 8, 8, False)
+    io = _template_from(dense)
+    _, out = io.alloc_views()
+    with pytest.raises(BatchLayoutError):
+        native.pack_frames(lib, frames, 8, 8, False, out=out, row_offset=2, total_rows=4)
+    with pytest.raises(BatchLayoutError):
+        native.pack_frames(lib, frames[:2], 8, 8, False, out=out, row_offset=0, total_rows=8)
+    with pytest.raises(ValueError, match="out"):
+        native.pack_frames(lib, frames, 8, 8, False, row_offset=1)
+
+
+def test_pack_plan_matches_pack_frames_and_reports_absolute_row():
+    """PackPlan (the prebuilt per-shard call template the ring path
+    reuses every batch) must byte-match pack_frames across REPEATED
+    packs of different frames into the same buffer, and name the
+    ABSOLUTE batch row when a shard frame is malformed."""
+    from dotaclient_tpu.ops.batch import BatchLayoutError
+    from dotaclient_tpu.runtime.staging import shard_rows
+
+    B = 6
+    frame_sets = []
+    for s in range(2):
+        rollouts = [
+            make_rollout(L=2 + ((i + s) % 5), H=8, seed=100 * s + i, actor_id=i)
+            for i in range(B)
+        ]
+        frame_sets.append([serialize_rollout(r) for r in rollouts])
+    io = _template_from(native.pack_frames(lib, frame_sets[0], 8, 8, False, obs_bf16=True))
+    groups_ref, out_ref = io.alloc_views()
+    groups_plan, out_plan = io.alloc_views()
+    plans = [
+        native.PackPlan(lib, out_plan, cnt, 8, 8, False, True, off, B)
+        for off, cnt in shard_rows(B, 2)
+    ]
+    for frames in frame_sets:  # reuse: same plans, new frames
+        native.pack_frames(lib, frames, 8, 8, False, obs_bf16=True, out=out_ref)
+        for p in plans:
+            p.pack(frames[p.row_offset : p.row_offset + p.n])
+        for k in groups_ref:
+            np.testing.assert_array_equal(
+                groups_ref[k].view(np.uint8), groups_plan[k].view(np.uint8)
+            )
+    # malformed frame in the SECOND shard: error names the absolute row
+    bad = list(frame_sets[0])
+    bad_row = plans[1].row_offset
+    bad[bad_row] = bad[bad_row][:-3]
+    with pytest.raises(ValueError, match=f"frame {bad_row}"):
+        plans[1].pack(bad[plans[1].row_offset : plans[1].row_offset + plans[1].n])
+    # wrong shard size is a layout error, not a silent partial pack
+    with pytest.raises(BatchLayoutError):
+        plans[0].pack(frame_sets[0][: plans[0].n - 1])

@@ -319,6 +319,15 @@ def test_registry_unregistered_filter():
     assert registry.is_registered("replay_age_le_4")
     assert registry.is_registered("trace_pack_ms_le_10")
     assert registry.is_registered("ckpt_mirror_lag_steps")
+    # parallel host feed scoreboard (ISSUE 11): the learner re-emits
+    # staging stats' pack_* keys as the staging_pack_ family — pin the
+    # per-worker tails and the ring meters against the prefix.
+    assert registry.is_registered("staging_pack_workers")
+    assert registry.is_registered("staging_pack_worker_busy_s_3")
+    assert registry.is_registered("staging_pack_worker_stall_s_0")
+    assert registry.is_registered("staging_pack_ring_occupancy")
+    assert registry.is_registered("staging_pack_ring_wait_s")
+    assert registry.is_registered("staging_pack_rows_per_s")
     assert not registry.is_registered("bogus_scalar")
     assert registry.unregistered(["step", "time", "loss", "bogus_scalar"]) == ["bogus_scalar"]
 

@@ -513,3 +513,347 @@ def test_staging_fused_single_buffer_matches_dense():
         np.testing.assert_array_equal(buf, io.pack_transfer(batch_d))
     finally:
         fused.stop(), dense.stop()
+
+
+# --- parallel host feed (ISSUE 11): sharded pack + transfer ring -------
+
+
+def _pooled_cfg(workers, native_on=True, dtype="bfloat16"):
+    cfg = LearnerConfig(
+        batch_size=4,
+        seq_len=8,
+        native_packer=native_on,
+        policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16, dtype=dtype),
+    )
+    cfg.staging.pack_workers = workers
+    return cfg
+
+
+def _mixed_wire_frames(n, with_traced=True):
+    """Mixed-wire frame list: DTR1 (f32), DTR3 (bf16 wire), and — when
+    tracing-era frames are wanted — DTR2 (traced f32, normalized to
+    DTR1 at the intake). Partial batches: varying L < seq_len."""
+    from dotaclient_tpu.transport.serialize import (
+        cast_rollout_obs_bf16,
+        serialize_rollout,
+        stamp_rollout_trace,
+    )
+
+    frames = []
+    for i in range(n):
+        r = make_rollout(L=3 + (i % 5), H=8, version=0, seed=i, actor_id=i)
+        if i % 3 == 0:
+            frames.append(serialize_rollout(cast_rollout_obs_bf16(r)))  # DTR3
+        elif i % 3 == 1 and with_traced:
+            frames.append(stamp_rollout_trace(serialize_rollout(r), i + 1, 123.0))  # DTR2
+        else:
+            frames.append(serialize_rollout(r))  # DTR1
+    return frames
+
+
+def _drain_batches(cfg, frames, fused, n_batches):
+    """Run one staging buffer to completion; returns materialized batch
+    copies (+ the groups payload bytes per batch when fused)."""
+    import copy as _copy
+
+    import jax
+
+    tag = f"pf_{cfg.staging.pack_workers}_{cfg.native_packer}_{fused}_{len(frames)}"
+    mem.reset(tag)
+    pub = connect(f"mem://{tag}")
+    for f in frames:
+        pub.publish_experience(f)
+    io = _fused_io_for(cfg) if fused else None
+    sb = StagingBuffer(cfg, connect(f"mem://{tag}"), version_fn=lambda: 0, fused_io=io)
+    if not cfg.native_packer:
+        sb._lib = None
+    sb.start()
+    batches, payloads = [], []
+    try:
+        for _ in range(n_batches):
+            b, groups = sb.get_batch_groups(timeout=30)
+            assert b is not None
+            batches.append(jax.tree.map(lambda a: np.array(a), b))
+            if groups is not None:
+                payloads.append(
+                    {k: np.array(v) for k, v in groups.items()}
+                    if isinstance(groups, dict)
+                    else np.array(groups)
+                )
+            lease = sb.last_batch_lease
+            if lease is not None:
+                lease.release()
+        stats = sb.stats()
+    finally:
+        sb.stop()
+    return batches, payloads, stats
+
+
+@pytest.mark.parametrize("native_on", [True, False])
+@pytest.mark.parametrize("workers", [2, 3, 4])
+def test_pack_workers_sharded_fused_bitwise_parity(native_on, workers):
+    """THE tentpole proof at staging level: N-worker sharded pack into
+    ring slots emits transfer buffers BITWISE identical to the
+    single-thread pack — native C packer AND python fallback, mixed
+    DTR1/DTR2/DTR3 frames, partial (L < T) rows, across several batches
+    (so reused, re-zeroed slots are covered), including workers=3 (an
+    uneven row split over B=4)."""
+    frames = _mixed_wire_frames(12)
+    base_b, base_p, _ = _drain_batches(_pooled_cfg(1, native_on), list(frames), True, 3)
+    got_b, got_p, stats = _drain_batches(
+        _pooled_cfg(workers, native_on), list(frames), True, 3
+    )
+    for a, b in zip(base_b, got_b):
+        _bitwise_equal(a, b)
+    for pa, pb in zip(base_p, got_p):
+        assert set(pa) == set(pb)
+        for k in pa:
+            np.testing.assert_array_equal(pa[k].view(np.uint8), pb[k].view(np.uint8))
+    # scoreboard meters exist only in pool mode
+    assert stats["pack_workers"] == workers
+    assert stats["pack_ring_depth"] == 2.0
+    assert stats["pack_rows_per_s"] > 0
+    assert f"pack_worker_busy_s_{workers - 1}" in stats
+
+
+@pytest.mark.parametrize("native_on", [True, False])
+def test_pack_workers_sharded_dense_bitwise_parity(native_on):
+    """Dense (non-fused) pooled pack — fresh per-batch allocation, same
+    classic cast semantics — matches the single-thread batch bitwise."""
+    frames = _mixed_wire_frames(8)
+    base_b, _, _ = _drain_batches(_pooled_cfg(1, native_on), list(frames), False, 2)
+    got_b, _, stats = _drain_batches(_pooled_cfg(3, native_on), list(frames), False, 2)
+    for a, b in zip(base_b, got_b):
+        _bitwise_equal(a, b)
+    assert "pack_ring_depth" not in stats  # no ring without fused buffers
+
+
+def test_transfer_ring_lease_backpressure_and_reuse():
+    """Ring ownership handoff: with transfer_depth=2 and no lease
+    releases, the feed stalls after 2 batches (the ring IS the
+    backpressure); releasing a lease hands its buffers back to the
+    packers, and the reused slot serves a later batch (same backing
+    payload object, re-zeroed)."""
+    cfg = _pooled_cfg(2)
+    frames = _mixed_wire_frames(20, with_traced=False)
+    tag = "ring_lease"
+    mem.reset(tag)
+    pub = connect(f"mem://{tag}")
+    for f in frames:
+        pub.publish_experience(f)
+    io = _fused_io_for(cfg)
+    sb = StagingBuffer(cfg, connect(f"mem://{tag}"), version_fn=lambda: 0, fused_io=io).start()
+    try:
+        held = []
+        ids = []
+        for _ in range(2):
+            b, groups = sb.get_batch_groups(timeout=30)
+            assert b is not None
+            ids.append(id(next(iter(groups.values()))))
+            held.append(sb.last_batch_lease)
+            assert held[-1] is not None
+        # both slots leased: no third batch can form
+        b3, _ = sb.get_batch_groups(timeout=1.0)
+        assert b3 is None
+        held[0].release()
+        held[0].release()  # idempotent: a double release must not fork the slot
+        b3, groups3 = sb.get_batch_groups(timeout=30)
+        assert b3 is not None
+        # the freed slot's buffers are REUSED, not reallocated
+        assert id(next(iter(groups3.values()))) == ids[0]
+        lease3 = sb.last_batch_lease
+        assert lease3 is not None
+        lease3.release()
+        held[1].release()
+    finally:
+        sb.stop()
+
+
+def test_pack_workers_default_inert_subprocess():
+    """Inertness proof (the PR-8 pattern): at the default
+    --staging.pack_workers=1 a StagingBuffer builds NONE of the parallel
+    feed — no pool threads, no assembler, no intake queue, no ring —
+    and the only thread is the classic staging-consumer. Subprocess so
+    the thread enumeration sees a clean interpreter."""
+    import subprocess
+    import sys
+
+    from tests.conftest import clean_subprocess_env
+
+    code = """
+import threading
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dotaclient_tpu.config import LearnerConfig, PolicyConfig
+from dotaclient_tpu.runtime.staging import StagingBuffer
+from dotaclient_tpu.transport.base import connect
+
+cfg = LearnerConfig(batch_size=4, seq_len=8,
+    policy=PolicyConfig(unit_embed_dim=16, lstm_hidden=8, mlp_hidden=16))
+assert cfg.staging.pack_workers == 1 and cfg.staging.transfer_depth == 2
+sb = StagingBuffer(cfg, connect("mem://inert"), version_fn=lambda: 0).start()
+try:
+    assert sb._pool is None and sb._ring is None
+    assert sb._intake is None and sb._assembler is None
+    names = sorted(t.name for t in threading.enumerate() if t.name.startswith("staging"))
+    assert names == ["staging-consumer"], names
+    assert not any(k.startswith("pack_") for k in sb.stats()), sb.stats()
+finally:
+    sb.stop()
+print("INERT_OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "INERT_OK" in proc.stdout
+
+
+def test_pack_workers_quiesce_drains_every_station():
+    """SIGTERM-drain visibility in pool mode: frames mid-pipeline (pop
+    locals, intake queue, pending) must all be trained out before
+    drained() turns true, and sub-batch leftovers stay snapshottable —
+    the PR-7 zero-loss drain contract extended to the parallel feed."""
+    cfg = _pooled_cfg(2)
+    tag = "pool_drain"
+    mem.reset(tag)
+    pub = connect(f"mem://{tag}")
+    io = _fused_io_for(cfg)
+    sb = StagingBuffer(cfg, connect(f"mem://{tag}"), version_fn=lambda: 0, fused_io=io).start()
+    try:
+        # one full batch + 3 leftovers
+        for f in _mixed_wire_frames(7, with_traced=False):
+            pub.publish_experience(f)
+        b, _ = sb.get_batch_groups(timeout=30)
+        assert b is not None
+        lease = sb.last_batch_lease
+        if lease is not None:
+            lease.release()
+        sb.quiesce()
+        deadline = time.monotonic() + 10
+        while not sb.drained() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sb.drained()
+        snap = sb.snapshot_state()
+        assert snap is not None and len(snap["pending"]) == 3
+    finally:
+        sb.stop()
+
+
+def test_pack_workers_lockcheck_zero_inversions(lockcheck):
+    """Concurrency soak under the instrumented-lock harness: producers
+    hammer a pooled fused staging while the consumer loop pops and
+    stats() scrapes — the pool/ring/assembler lock graph must show zero
+    acquisition-order inversions."""
+    import threading
+
+    cfg = _pooled_cfg(3)
+    tag = "pool_lock"
+    mem.reset(tag)
+    io = _fused_io_for(cfg)
+    sb = StagingBuffer(cfg, connect(f"mem://{tag}"), version_fn=lambda: 0, fused_io=io).start()
+    stop = threading.Event()
+    frames = _mixed_wire_frames(16, with_traced=False)
+
+    def produce():
+        conn = connect(f"mem://{tag}")
+        i = 0
+        while not stop.is_set():
+            if conn.experience_depth() > 32:
+                time.sleep(0.001)
+                continue
+            conn.publish_experience(frames[i % len(frames)])
+            i += 1
+
+    threads = [threading.Thread(target=produce, daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        got = 0
+        deadline = time.monotonic() + 15
+        while got < 6 and time.monotonic() < deadline:
+            b, _ = sb.get_batch_groups(timeout=5)
+            if b is None:
+                continue
+            sb.stats()
+            lease = sb.last_batch_lease
+            if lease is not None:
+                lease.release()
+            got += 1
+        assert got >= 6
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        sb.stop()
+    assert not lockcheck.inversions, lockcheck.report()
+    assert sb.stats()["consumer_errors"] == 0
+
+
+def test_pack_scale_ab_artifact_verdict():
+    """Guard the COMMITTED PACK_SCALE_AB.json: bitwise-identical
+    transfer buffers, ring overlap observed, pack_workers=1 inert, and
+    the scaling verdict — ≥ 2× at 4 workers wherever the independent
+    host memcpy probe shows the host can express parallel copy at all;
+    on hosts where it cannot (the 2-core bench box: one core saturates
+    the memory controller), the raw ratio is committed and excused BY
+    THE PROBE, in-artifact (the SERVE_BENCH disclosure pattern)."""
+    import json
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "PACK_SCALE_AB.json"
+    data = json.loads(path.read_text())
+    v = data["verdict"]
+    assert v["all_green"], v
+    assert v["transfer_buffers_bitwise_identical"]
+    assert v["ring_overlap_observed"]
+    assert v["pack_workers_1_inert"]
+    assert data["parity"]["native"]["bitwise_identical"]
+    assert data["parity"]["python"]["bitwise_identical"]
+    # the probe-keyed scaling judgment, exactly as the script computes it
+    if v["host_can_express_parallel_copy"]:
+        assert v["scaling_1_to_4_x"] >= 2.0
+    else:
+        assert data["host_copy_scaling"]["copy_scaling_4t"] < 1.5
+        assert v["scaling_caveat"]
+
+
+@pytest.mark.nightly
+@pytest.mark.slow  # nightly AND slow: the tier-1 -m 'not slow' override
+def test_ab_pack_scale_quick_nightly(tmp_path):
+    """Re-run the pack-scale A/B (--quick) in a clean subprocess and
+    assert the committed-artifact schema + verdict invariants live. On a
+    capable host (memcpy probe ≥ 1.5× at 4 threads) this REQUIRES the
+    full ≥ 2× scaling bar — the bar arms itself on real learner-class
+    hardware."""
+    import json
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    from tests.conftest import clean_subprocess_env
+
+    script = pathlib.Path(__file__).resolve().parent.parent / "scripts" / "ab_pack_scale.py"
+    out = tmp_path / "pack_ab.json"
+    proc = subprocess.run(
+        [sys.executable, str(script), "--quick", "--out", str(out)],
+        capture_output=True,
+        text=True,
+        timeout=570,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    data = json.loads(out.read_text())
+    for key in ("host_copy_scaling", "packer_scale", "parity", "e2e", "verdict"):
+        assert key in data, key
+    v = data["verdict"]
+    assert v["all_green"], v
+    assert v["transfer_buffers_bitwise_identical"] and v["pack_workers_1_inert"]
+    if v["host_can_express_parallel_copy"]:
+        assert v["scaling_1_to_4_x"] >= 2.0
