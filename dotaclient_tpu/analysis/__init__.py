@@ -1,9 +1,11 @@
-"""Graftlint: repo-native static analysis for the hazards this codebase
-actually ships — thread-safety discipline around the seven daemon
-threads, JAX hot-path recompile/host-sync hazards, and observability
-contract drift.
+"""Graftcheck: repo-native static analysis + dynamic sanitizers for the
+hazards this codebase actually ships — thread-safety discipline around
+the daemon threads, JAX hot-path recompile/host-sync hazards,
+observability contract drift, and (since the graftcheck PR) the
+concurrency protocols the parallel host feed and the serve tier live
+by.
 
-Three rule families (see the sibling modules for the full rule docs):
+Static rule families (see the sibling modules for the full rule docs):
 
 - THR (thr_rules.py)  — classes that spawn a ``threading.Thread`` must
   guard worker-written attributes read from public methods with the
@@ -16,16 +18,31 @@ Three rule families (see the sibling modules for the full rule docs):
   the static complement to the RecompileSentinel's
   ``compute_recompiles_total == 0`` runtime invariant.
 - OBS (obs_rules.py)  — scalar names logged to MetricsLogger must exist
-  in ``obs/registry.py``; ``--flags`` in ``k8s/*.yaml`` must exist in
-  ``config.py`` (or the broker argparse); defined flags must be consumed
+  in ``obs/registry.py``; ``--flags`` in ``k8s/*.yaml`` AND in the
+  ``scripts/`` bench/soak drivers' subprocess argv lists must exist in
+  the spawned binary's namespace; defined flags must be consumed
   somewhere in the package.
+- LIF/WIRE (lif_rules.py) — TransferRing lease lifecycle (released or
+  returned on every path, never before the H2D retire fence),
+  drained()-station reachability (the PR-7 zero-loss drain contract),
+  and WIRE001: the DTR wire layout extracted from BOTH
+  transport/serialize.py (ast) and native/packer.cc (structured regex)
+  into one spec table, failing on any drift.
 
-Runtime counterpart: ``lockcheck.py`` — an instrumented
-``threading.Lock`` that records per-thread acquisition order and
-detects lock-order inversions and over-held locks. Enabled by the
-``lockcheck`` fixture in tests; nothing imports it in production.
+Runtime counterparts (test-fixture-enabled only, production-inert):
 
-Everything here is pure stdlib + ``ast`` — linting the package never
+- ``lockcheck.py``  — instrumented ``threading.Lock`` recording
+  per-thread acquisition order: lock-order inversions + over-held locks.
+- ``racecheck.py``  — vector-clock happens-before race sanitizer:
+  repo-created locks/conditions/events/queues/threads convey HB edges,
+  opted-in instances get attribute-write tracing, write-write pairs
+  with no HB ordering are race reports (reasoned suppressions only).
+- ``schedcheck.py`` — deterministic schedule exploration: the ring-slot
+  lifecycle, drained()-station, checkpoint-coalescing, and serve
+  hot-swap protocols as explicit models, every bounded interleaving
+  exhausted, with mutants re-introducing the shipped bug classes.
+
+The lint path is pure stdlib + ``ast`` — linting the package never
 imports the package (and never imports JAX), so the tier-1 lint test
 costs ~a second of wall clock. Entry point: ``scripts/lint_graft.py``.
 """

@@ -214,6 +214,9 @@ class RepoContext:
     config_path: Optional[str] = None
     registry_path: Optional[str] = None
     k8s_dir: Optional[str] = None
+    scripts_dir: Optional[str] = None
+    serialize_path: Optional[str] = None
+    packer_cc_path: Optional[str] = None
 
 
 class Rule:
@@ -256,7 +259,12 @@ def register(rule_cls):
 def _ensure_rules_loaded() -> None:
     # Import for the registration side effect; deferred so `import
     # dotaclient_tpu.analysis.core` alone stays cheap and cycle-free.
-    from dotaclient_tpu.analysis import jax_rules, obs_rules, thr_rules  # noqa: F401
+    from dotaclient_tpu.analysis import (  # noqa: F401
+        jax_rules,
+        lif_rules,
+        obs_rules,
+        thr_rules,
+    )
 
 
 # ------------------------------------------------------------------ baseline
@@ -413,6 +421,15 @@ def lint_repo(
         (os.path.join("dotaclient_tpu", "config.py"), "config_path"),
         (os.path.join("dotaclient_tpu", "obs", "registry.py"), "registry_path"),
         ("k8s", "k8s_dir"),
+        ("scripts", "scripts_dir"),
+        (
+            os.path.join("dotaclient_tpu", "transport", "serialize.py"),
+            "serialize_path",
+        ),
+        (
+            os.path.join("dotaclient_tpu", "native", "packer.cc"),
+            "packer_cc_path",
+        ),
     ):
         cand = os.path.join(root, default_rel)
         if getattr(ctx, attr) is None and os.path.exists(cand):

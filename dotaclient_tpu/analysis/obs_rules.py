@@ -23,6 +23,18 @@ block) — a sidecar container's own ``--config``-style flags in the
 same manifest are some other program's namespace, not drift. Comment
 lines are ignored.
 
+The same rule also covers the ``scripts/`` bench/soak drivers
+(graftcheck PR): any LIST LITERAL in a ``scripts/*.py`` file that
+names a known ``dotaclient_tpu.<x>`` binary (the subprocess-argv
+idiom, ``[sys.executable, "-m", "dotaclient_tpu.serve.server",
+"--serve.port", ...]``) has its ``"--flag"`` string elements checked
+against that binary's namespace. Scoping to the list literal keeps a
+script's OWN argparse flags (self-reinvocation argv with no module
+string) and prose mentions out of scope; flag lists composed in a
+helper function and concatenated in (``+ _policy_flags(...)``) are a
+known blind spot — the k8s manifests remain the deploy-surface source
+of truth.
+
 OBS003 (warning) — every leaf config field defined in ``config.py``
 must be READ somewhere in the package (an ``.name`` attribute load
 outside config.py). A defined-but-never-consumed flag is a lie in the
@@ -301,15 +313,12 @@ class ManifestFlagDrift(Rule):
     doc = "--flag in a k8s manifest that no binary defines"
 
     def run_repo(self, ctx: RepoContext) -> List[Finding]:
-        if not (
-            ctx.k8s_dir
-            and os.path.isdir(ctx.k8s_dir)
-            and ctx.config_path
-            and os.path.exists(ctx.config_path)
-        ):
+        if not (ctx.config_path and os.path.exists(ctx.config_path)):
             return []
         classes = config_field_map(ctx.config_path)
-        findings: List[Finding] = []
+        findings: List[Finding] = self._scripts_pass(ctx, classes)
+        if not (ctx.k8s_dir and os.path.isdir(ctx.k8s_dir)):
+            return findings
         for name in sorted(os.listdir(ctx.k8s_dir)):
             if not (name.endswith(".yaml") or name.endswith(".yml")):
                 continue
@@ -360,6 +369,57 @@ class ManifestFlagDrift(Rule):
                                 f"defines no such field) — the binary will "
                                 f"refuse to start; fix the manifest or add "
                                 f"the field",
+                            )
+                        )
+        return findings
+
+    def _scripts_pass(self, ctx: RepoContext, classes) -> List[Finding]:
+        """The scripts/ half of OBS002: check subprocess-argv list
+        literals in bench/soak drivers against the spawned binary's flag
+        namespace. Only lists that NAME a known binary are judged — a
+        script's own argparse flags (self-reinvocation lists) never
+        mention a module and stay out of scope."""
+        if not (ctx.scripts_dir and os.path.isdir(ctx.scripts_dir)):
+            return []
+        findings: List[Finding] = []
+        for name in sorted(os.listdir(ctx.scripts_dir)):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(ctx.scripts_dir, name)
+            rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (SyntaxError, OSError):
+                continue
+            for lst in ast.walk(tree):
+                if not isinstance(lst, ast.List):
+                    continue
+                strs = [
+                    e
+                    for e in lst.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                mods: Set[str] = set()
+                for e in strs:
+                    mods.update(_MODULE_RE.findall(e.value))
+                namespaces, known = self._namespaces(ctx, classes, mods)
+                if not known:
+                    continue
+                for e in strs:
+                    if not e.value.startswith("--"):
+                        continue
+                    flag = e.value[2:].split("=", 1)[0]
+                    if flag and flag not in namespaces:
+                        findings.append(
+                            self.make(
+                                rel,
+                                e.lineno,
+                                f"--{flag} is not a flag of "
+                                f"{'/'.join(sorted(known))} (config.py "
+                                f"defines no such field) — the spawned "
+                                f"binary will refuse to start; fix the "
+                                f"driver or add the field",
                             )
                         )
         return findings

@@ -1,0 +1,770 @@
+"""Schedcheck: deterministic schedule exploration over explicit protocol
+models — the model-checking half of graftcheck.
+
+The last three PRs each shipped (and then hand-fixed) a concurrency bug
+that no single test schedule would ever hit deterministically: the ring
+lease released at put-dispatch (PR 11 — an in-flight H2D observing the
+NEXT batch's bytes), ``drained()`` declaring victory while a popped
+batch lived only in a consumer thread's locals (PR 7 — a SIGTERM drain
+silently losing frames), and checkpoint-teardown coalescing races. Those
+protocols are tiny state machines; this module model-checks them as
+EXPLICIT models, exhaustively, over every interleaving up to a bound —
+so the bug class is excluded by search, not by luck.
+
+Design:
+
+- A model is a plain-Python object over an immutable-ish ``dict`` state:
+  ``init()``, ``threads`` (ids), ``enabled(st, tid)``, ``step(st, tid)``
+  (mutates a copy the explorer hands it), ``invariant(st)`` (violation
+  strings, checked after every step), ``done(st)`` and
+  ``final_check(st)``. Every transition is one atomic region of the real
+  code — what happens under one lock hold, or between two preemption
+  points.
+- ``explore()`` runs a DFS over thread choices with two sound
+  reductions: a visited-state set (two schedules reaching the same
+  (shared state, pcs) need exploring once — the stateful-search
+  reduction DPOR approximates), and local-step commutation (a
+  transition marked ``local`` touches only its own thread's pc/locals,
+  so it commutes with everything and is taken immediately without
+  branching). The result says whether the bounded set was EXHAUSTED —
+  "zero violations" only counts when it was.
+- ``random_walks()`` is the seeded soak mode: long schedules through the
+  same models, replayable from the seed.
+- Mutants: each model takes a ``mutant=`` knob that re-introduces a
+  shipped bug class (``early_release``, ``no_packing_check``,
+  ``downstream_first``, ``clear_flag_before_put``, ``no_resubmit``,
+  ``per_row_read``). Tests pin that exploration FINDS each mutant's
+  violation and that the HEAD protocol explores clean — the
+  failing-then-fixed schedule, as a regression.
+
+The models are cross-validated against the real code by tests
+(tests/test_schedcheck.py): the lifecycle semantics the ring model
+assumes (acquire-from-free only, idempotent release, re-zero on
+acquire) are asserted against the real ``TransferRing``/``RingSlot``,
+and the drained() station order mirrors ``StagingBuffer.drained()``
+check-for-check. Pure stdlib — importing this module never imports
+JAX/numpy, so schedule exploration runs before (and independent of) any
+accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "ExploreResult",
+    "explore",
+    "random_walks",
+    "RingLeaseModel",
+    "DrainedModel",
+    "CoalesceModel",
+    "HotSwapModel",
+]
+
+
+def _freeze(x):
+    """Recursively hashable snapshot of a state value (dicts sorted)."""
+    if isinstance(x, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in x.items()))
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze(v) for v in x)
+    if isinstance(x, set):
+        return tuple(sorted(_freeze(v) for v in x))
+    return x
+
+
+@dataclass
+class ExploreResult:
+    """Outcome of one exploration. ``exhausted`` is the honesty bit:
+    zero violations from a truncated search proves nothing, and the
+    acceptance tests assert on BOTH fields."""
+
+    violations: List[str] = field(default_factory=list)
+    states: int = 0
+    schedules: int = 0  # maximal schedules reaching a terminal state
+    exhausted: bool = True
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def require_exhausted_clean(self) -> "ExploreResult":
+        if not self.exhausted:
+            raise AssertionError(
+                f"exploration truncated at {self.states} states — raise the bound"
+            )
+        if self.violations:
+            raise AssertionError("; ".join(self.violations[:5]))
+        return self
+
+
+def explore(model, max_states: int = 400_000) -> ExploreResult:
+    """Exhaustive bounded DFS over every interleaving of `model`.
+
+    Visited-state dedup makes this a stateful search: each reachable
+    (shared state, pcs) configuration is expanded once no matter how
+    many schedules reach it. Transitions the model marks local (pure
+    pc/thread-local moves) are taken immediately without branching —
+    they commute with every other transition, the classic
+    partial-order-reduction argument. Deadlock (no enabled thread, not
+    done) is itself a violation: the cancel-swallow teardown class."""
+    res = ExploreResult()
+    init = model.init()
+    seen = {_freeze(init)}
+    stack = [init]
+    res.states = 1
+    vset = set()
+
+    def report(v: str) -> None:
+        if v not in vset:
+            vset.add(v)
+            res.violations.append(v)
+
+    while stack:
+        st = stack.pop()
+        enabled = [t for t in model.threads if model.enabled(st, t)]
+        if not enabled:
+            res.schedules += 1
+            if model.done(st):
+                for v in model.final_check(st):
+                    report(v)
+            else:
+                report(f"deadlock: no enabled thread in state {model.describe(st)}")
+            continue
+        local = [t for t in enabled if model.is_local(st, t)]
+        choices = local[:1] if local else enabled
+        for tid in choices:
+            nxt = copy.deepcopy(st)
+            model.step(nxt, tid)
+            for v in model.invariant(nxt):
+                report(v)
+            key = _freeze(nxt)
+            if key in seen:
+                continue
+            if res.states >= max_states:
+                res.exhausted = False
+                continue
+            seen.add(key)
+            res.states += 1
+            stack.append(nxt)
+    return res
+
+
+def random_walks(
+    model, runs: int = 200, seed: int = 0, max_steps: int = 10_000
+) -> ExploreResult:
+    """Seeded random schedules through `model` — the soak mode. Never
+    claims exhaustion; replayable from (runs, seed)."""
+    res = ExploreResult(exhausted=False)
+    rng = random.Random(seed)
+    vset = set()
+    for _ in range(runs):
+        st = model.init()
+        for _ in range(max_steps):
+            enabled = [t for t in model.threads if model.enabled(st, t)]
+            if not enabled:
+                break
+            tid = rng.choice(enabled)
+            model.step(st, tid)
+            res.states += 1
+            for v in model.invariant(st):
+                if v not in vset:
+                    vset.add(v)
+                    res.violations.append(v)
+        res.schedules += 1
+        enabled = [t for t in model.threads if model.enabled(st, t)]
+        if not enabled:
+            if model.done(st):
+                for v in model.final_check(st):
+                    if v not in vset:
+                        vset.add(v)
+                        res.violations.append(v)
+            else:
+                v = f"deadlock: no enabled thread in state {model.describe(st)}"
+                if v not in vset:
+                    vset.add(v)
+                    res.violations.append(v)
+    return res
+
+
+class _Model:
+    """Shared trivia: default local/done/describe hooks."""
+
+    threads: Tuple[str, ...] = ()
+
+    def is_local(self, st: dict, tid: str) -> bool:
+        return False
+
+    def invariant(self, st: dict) -> List[str]:
+        return st.get("violations", [])
+
+    def final_check(self, st: dict) -> List[str]:
+        return []
+
+    def describe(self, st: dict) -> str:
+        return str({k: v for k, v in sorted(st.items()) if k != "violations"})
+
+
+# ---------------------------------------------------------------- ring lease
+
+
+class RingLeaseModel(_Model):
+    """The TransferRing slot lifecycle (parallel/fused_io.py):
+
+        free --acquire(packer)--> packing --ready-put--> ready
+             --learner-get--> in_transfer --release-after-retire--> free
+
+    One packer (the staging assembler) and one learner share `depth`
+    slots; the learner's device_put reads the slot buffer ASYNCHRONOUSLY
+    (jax defers the host read of a put numpy buffer), modeled as a
+    dispatch step and a separate retire step that observes which batch
+    generation the buffer holds at retire time. The protocol invariant:
+    the retire must observe the generation the get dispatched — anything
+    else is the PR-11 H2D corruption (the next batch's bytes shipped).
+
+    ``mutant="early_release"`` re-introduces the shipped bug: the lease
+    returns to the free queue at put-DISPATCH, before the transfer
+    retires — exploration finds the packer re-acquiring and repacking
+    the slot under the in-flight read. ``mutant="double_release"`` makes
+    release non-idempotent twice (models losing ``RingSlot._held``): the
+    free queue grows a duplicate and a later acquire hands out a slot
+    that is not free."""
+
+    threads = ("packer", "learner")
+
+    def __init__(self, depth: int = 2, batches: int = 3, mutant: Optional[str] = None):
+        assert mutant in (None, "early_release", "double_release")
+        self.depth = depth
+        self.batches = batches
+        self.mutant = mutant
+
+    def init(self) -> dict:
+        return {
+            "free": tuple(range(self.depth)),
+            "slot_state": {i: "free" for i in range(self.depth)},
+            "slot_gen": {i: 0 for i in range(self.depth)},
+            "ready": (),  # (slot, generation at put)
+            "in_flight": {},  # slot -> generation the dispatch read
+            "p_pc": "acquire",
+            "p_slot": None,
+            "packed": 0,
+            "gen": 0,
+            "l_pc": "get",
+            "l_slot": None,
+            "l_gen": None,
+            "consumed": 0,
+            "violations": [],
+        }
+
+    def enabled(self, st: dict, tid: str) -> bool:
+        if tid == "packer":
+            if st["p_pc"] == "acquire":
+                return st["packed"] < self.batches and bool(st["free"])
+            if st["p_pc"] == "put":
+                return len(st["ready"]) < 2  # the ready queue's maxsize
+            return st["p_pc"] != "done"
+        if st["l_pc"] == "get":
+            return st["consumed"] < self.batches and bool(st["ready"])
+        return st["l_pc"] != "done"
+
+    def step(self, st: dict, tid: str) -> None:
+        if tid == "packer":
+            pc = st["p_pc"]
+            if pc == "acquire":
+                sid, st["free"] = st["free"][0], st["free"][1:]
+                if st["slot_state"][sid] != "free":
+                    st["violations"].append(
+                        f"acquire handed out slot {sid} in state "
+                        f"{st['slot_state'][sid]} — the free queue holds a "
+                        f"duplicate (double release)"
+                    )
+                st["slot_state"][sid] = "packing"
+                st["p_slot"] = sid
+                st["p_pc"] = "pack"
+            elif pc == "pack":
+                sid = st["p_slot"]
+                st["gen"] += 1
+                st["slot_gen"][sid] = st["gen"]
+                if sid in st["in_flight"]:
+                    st["violations"].append(
+                        f"packer wrote slot {sid} while its H2D transfer was "
+                        f"in flight — the device receives the next batch's "
+                        f"bytes (the PR-11 early-lease-release corruption)"
+                    )
+                st["p_pc"] = "put"
+            elif pc == "put":
+                sid = st["p_slot"]
+                st["slot_state"][sid] = "ready"
+                st["ready"] += ((sid, st["slot_gen"][sid]),)
+                st["p_slot"] = None
+                st["packed"] += 1
+                st["p_pc"] = "acquire" if st["packed"] < self.batches else "done"
+            return
+        pc = st["l_pc"]
+        if pc == "get":
+            (sid, gen), st["ready"] = st["ready"][0], st["ready"][1:]
+            st["slot_state"][sid] = "in_transfer"
+            st["l_slot"], st["l_gen"] = sid, gen
+            st["l_pc"] = "dispatch"
+        elif pc == "dispatch":
+            sid = st["l_slot"]
+            st["in_flight"][sid] = st["l_gen"]
+            if self.mutant == "early_release":
+                # the shipped bug: lease back to the packers at dispatch
+                st["slot_state"][sid] = "free"
+                st["free"] += (sid,)
+            st["l_pc"] = "retire"
+        elif pc == "retire":
+            sid = st["l_slot"]
+            observed = st["slot_gen"][sid]
+            if observed != st["l_gen"]:
+                st["violations"].append(
+                    f"transfer of slot {sid} retired holding generation "
+                    f"{observed}, dispatched with {st['l_gen']} — H2D read "
+                    f"tore across a repack"
+                )
+            st["in_flight"].pop(sid, None)
+            st["consumed"] += 1
+            st["l_pc"] = "release"
+        elif pc == "release":
+            sid = st["l_slot"]
+            if self.mutant != "early_release":
+                st["slot_state"][sid] = "free"
+                st["free"] += (sid,)
+                if self.mutant == "double_release":
+                    st["free"] += (sid,)  # _held lost: second put
+            st["l_slot"] = st["l_gen"] = None
+            st["l_pc"] = "get" if st["consumed"] < self.batches else "done"
+
+    def is_local(self, st: dict, tid: str) -> bool:
+        # retire/release touch shared slot state; only the terminal pc
+        # moves are local — keep the reduction conservative.
+        return False
+
+    def done(self, st: dict) -> bool:
+        return st["p_pc"] == "done" and st["l_pc"] == "done"
+
+    def final_check(self, st: dict) -> List[str]:
+        out = []
+        if st["consumed"] != self.batches:
+            out.append(
+                f"learner consumed {st['consumed']} of {self.batches} batches"
+            )
+        if self.mutant is None and sorted(st["free"]) != list(range(self.depth)):
+            out.append(f"slots lost: free queue ended as {st['free']}")
+        return out
+
+
+# ------------------------------------------------------------------ drained
+
+
+class DrainedModel(_Model):
+    """The SIGTERM-drain zero-loss protocol (runtime/staging.py pool
+    mode): frames move pop-locals → intake → pending → pack-locals →
+    ready, and ``drained()`` checks the stations UPSTREAM-first —
+    ``_popping`` (under the mutate lock), ``intake.unfinished_tasks``,
+    ``(_packing, pending)`` (one lock hold), then ready LAST. The
+    controller thread quiesces, trains out ready batches, and polls
+    drained(); the invariant is conservation: when drained() returns
+    True, every popped frame is either consumed or sitting in _pending
+    (the checkpointable leftover) — NEVER in a thread's locals or a
+    queue.
+
+    Mutants (each a real bug class):
+    - ``no_packing_check``: drained() skips the in-flight pack flag —
+      the PR-7 shipped bug (batch in assembler locals declared drained).
+    - ``downstream_first``: drained() reads the ready queue FIRST; a
+      batch crossing pack-locals→ready between the checks is lost.
+    - ``clear_flag_before_put``: the assembler clears ``_packing``
+      before the ready-queue put lands (the flag pattern's ordering
+      contract, inverted)."""
+
+    threads = ("pop", "assembler", "controller")
+
+    def __init__(
+        self,
+        frames: int = 2,
+        batch: int = 1,
+        intake_cap: int = 1,
+        ready_cap: int = 1,
+        mutant: Optional[str] = None,
+    ):
+        assert mutant in (
+            None,
+            "no_packing_check",
+            "downstream_first",
+            "clear_flag_before_put",
+        )
+        self.frames = frames
+        self.batch = batch
+        self.intake_cap = intake_cap
+        self.ready_cap = ready_cap
+        self.mutant = mutant
+
+    def init(self) -> dict:
+        return {
+            "broker": self.frames,
+            "popping": False,
+            "pop_local": 0,
+            "intake_items": 0,
+            "intake_unfinished": 0,
+            "asm_local": 0,
+            "pending": 0,
+            "packing": False,
+            "pack_local": 0,
+            "ready": 0,
+            "consumed": 0,
+            "quiesce": False,
+            "pop_pc": "idle",
+            "asm_pc": "get",
+            "ctl_pc": "quiesce",
+            "obs": 0,  # drained() read cursor (0 = not mid-check)
+            "drained_true": False,
+            "violations": [],
+        }
+
+    # -- enabledness ---------------------------------------------------
+
+    def enabled(self, st: dict, tid: str) -> bool:
+        if tid == "pop":
+            if st["pop_pc"] == "idle":
+                # loop top: the quiesce check happens BEFORE _popping is
+                # set (the real code's loop order)
+                return not st["quiesce"] and st["broker"] > 0
+            if st["pop_pc"] == "put":
+                return st["intake_items"] < self.intake_cap
+            return True
+        if tid == "assembler":
+            if st["asm_pc"] == "get":
+                return st["intake_items"] > 0 or st["pending"] >= self.batch
+            if st["asm_pc"] == "put_ready":
+                return st["ready"] < self.ready_cap
+            return True
+        # controller: quiesce, then poll drained()/train-out until True
+        return not st["drained_true"]
+
+    # -- transitions ---------------------------------------------------
+
+    def step(self, st: dict, tid: str) -> None:
+        if tid == "pop":
+            pc = st["pop_pc"]
+            if pc == "idle":
+                st["popping"] = True  # set under the mutate lock
+                st["pop_pc"] = "pop"
+            elif pc == "pop":
+                st["broker"] -= 1
+                st["pop_local"] = 1
+                st["pop_pc"] = "put"
+            elif pc == "put":
+                st["intake_items"] += 1
+                st["intake_unfinished"] += 1
+                st["pop_local"] = 0
+                st["pop_pc"] = "clear"
+            elif pc == "clear":
+                st["popping"] = False  # cleared under the mutate lock
+                st["pop_pc"] = "idle"
+            return
+        if tid == "assembler":
+            pc = st["asm_pc"]
+            if pc == "get":
+                if st["intake_items"] > 0:
+                    st["intake_items"] -= 1
+                    st["asm_local"] = 1
+                    st["asm_pc"] = "ingest"
+                else:
+                    # nothing in the intake but a batch is pending
+                    st["asm_pc"] = "take"
+            elif pc == "ingest":
+                # one mutate-lock hold: frames land in _pending
+                st["pending"] += st["asm_local"]
+                st["asm_local"] = 0
+                st["asm_pc"] = "task_done"
+            elif pc == "task_done":
+                st["intake_unfinished"] -= 1
+                st["asm_pc"] = "take" if st["pending"] >= self.batch else "get"
+            elif pc == "take":
+                # ONE lock hold: pop the batch AND set the in-flight flag
+                # (the drained() visibility contract)
+                st["pending"] -= self.batch
+                st["packing"] = True
+                st["pack_local"] = self.batch
+                st["asm_pc"] = "put_ready"
+            elif pc == "put_ready":
+                if self.mutant == "clear_flag_before_put":
+                    st["packing"] = False
+                    st["asm_pc"] = "put_ready2"
+                else:
+                    st["ready"] += 1
+                    st["pack_local"] = 0
+                    st["asm_pc"] = "clear_flag"
+            elif pc == "put_ready2":
+                st["ready"] += 1
+                st["pack_local"] = 0
+                st["asm_pc"] = "get"
+            elif pc == "clear_flag":
+                st["packing"] = False
+                st["asm_pc"] = "get"
+            return
+        # controller
+        pc = st["ctl_pc"]
+        if pc == "quiesce":
+            st["quiesce"] = True
+            st["ctl_pc"] = "loop"
+        elif pc == "loop":
+            if st["ready"] > 0:
+                # train a ready batch out before re-polling
+                st["ready"] -= 1
+                st["consumed"] += self.batch
+            else:
+                st["obs"] = 0
+                st["ctl_pc"] = "check"
+        elif pc == "check":
+            self._drained_read(st)
+
+    def _stations(self) -> List[str]:
+        order = ["popping", "unfinished", "packing_pending", "ready"]
+        if self.mutant == "no_packing_check":
+            order.remove("packing_pending")
+            order.append("pending_only")
+            order.remove("ready")
+            order.append("ready")
+        if self.mutant == "downstream_first":
+            order = list(reversed(order))
+        return order
+
+    def _drained_read(self, st: dict) -> None:
+        """One read of the drained() sequence — each check is its own
+        interleaving point, exactly like the real method's lock holds."""
+        stations = self._stations()
+        name = stations[st["obs"]]
+        clear = {
+            "popping": lambda: not st["popping"],
+            "unfinished": lambda: st["intake_unfinished"] == 0,
+            "packing_pending": lambda: not st["packing"]
+            and st["pending"] < self.batch,
+            "pending_only": lambda: st["pending"] < self.batch,
+            "ready": lambda: st["ready"] == 0,
+        }[name]()
+        if not clear:
+            st["ctl_pc"] = "loop"  # station busy: retry from the top
+            st["obs"] = 0
+            return
+        st["obs"] += 1
+        if st["obs"] < len(stations):
+            return
+        # every station read clear → drained() returns True
+        st["drained_true"] = True
+        in_flight = (
+            st["pop_local"]
+            + st["asm_local"]
+            + st["pack_local"]
+            + st["intake_items"]
+            + st["ready"] * self.batch
+        )
+        if in_flight:
+            st["violations"].append(
+                f"drained() returned True with {in_flight} frame(s) still in "
+                f"flight (pop_local={st['pop_local']} asm_local={st['asm_local']} "
+                f"pack_local={st['pack_local']} intake={st['intake_items']} "
+                f"ready={st['ready']}) — a SIGTERM drain would lose them "
+                f"(the PR-7 bug class)"
+            )
+
+    def done(self, st: dict) -> bool:
+        return st["drained_true"]
+
+    def final_check(self, st: dict) -> List[str]:
+        popped = self.frames - st["broker"]
+        accounted = st["consumed"] + st["pending"]
+        if popped != accounted:
+            return [
+                f"conservation: {popped} frames popped but only {accounted} "
+                f"accounted (consumed {st['consumed']} + pending {st['pending']})"
+            ]
+        return []
+
+
+# ------------------------------------------------------------- coalescing
+
+
+class CoalesceModel(_Model):
+    """The latest-wins single-slot worker (CheckpointWorker /
+    WeightPublisher / the checkpoint aux+mirror queues): submitters
+    overwrite one pending slot under the condition lock and start the
+    worker iff it is not in flight; the worker drains until the slot is
+    empty, then parks (clearing in-flight under the same lock hold as
+    the exit decision). Invariants: the NEWEST submission is always the
+    last one written (coalescing may skip, never reorder or lose the
+    newest), and the system quiesces with the slot empty and the worker
+    parked — a worker exiting while the slot is full is the
+    cancel-swallow teardown class.
+
+    ``mutant="no_resubmit"`` drops the submit-side wakeup (submit fills
+    the slot but never starts a parked worker): exploration finds the
+    newest version stranded."""
+
+    threads = ("submitter", "worker")
+
+    def __init__(self, versions: int = 3, mutant: Optional[str] = None):
+        assert mutant in (None, "no_resubmit")
+        self.versions = versions
+        self.mutant = mutant
+
+    def init(self) -> dict:
+        return {
+            "pending": None,
+            "inflight": False,
+            "written": 0,
+            "superseded": 0,
+            "next_v": 1,
+            "w_pc": "parked",
+            "w_item": None,
+            "violations": [],
+        }
+
+    def enabled(self, st: dict, tid: str) -> bool:
+        if tid == "submitter":
+            return st["next_v"] <= self.versions
+        if st["w_pc"] == "parked":
+            return st["inflight"]
+        return True
+
+    def step(self, st: dict, tid: str) -> None:
+        if tid == "submitter":
+            # one condition-lock hold: supersede + fill + maybe start
+            if st["pending"] is not None:
+                st["superseded"] += 1
+            st["pending"] = st["next_v"]
+            st["next_v"] += 1
+            if not st["inflight"] and self.mutant != "no_resubmit":
+                st["inflight"] = True
+            return
+        pc = st["w_pc"]
+        if pc == "parked":
+            st["w_pc"] = "take"
+        elif pc == "take":
+            # one lock hold: take-or-park (exit decision under the lock)
+            if st["pending"] is None:
+                st["inflight"] = False
+                st["w_pc"] = "parked"
+            else:
+                st["w_item"], st["pending"] = st["pending"], None
+                st["w_pc"] = "write"
+        elif pc == "write":
+            if st["w_item"] < st["written"]:
+                st["violations"].append(
+                    f"worker wrote version {st['w_item']} after {st['written']} "
+                    f"— coalescing reordered"
+                )
+            st["written"] = st["w_item"]
+            st["w_item"] = None
+            st["w_pc"] = "take"
+
+    def done(self, st: dict) -> bool:
+        return (
+            st["next_v"] > self.versions
+            and st["w_pc"] == "parked"
+            and not st["inflight"]
+        )
+
+    def final_check(self, st: dict) -> List[str]:
+        out = []
+        if st["written"] != self.versions:
+            out.append(
+                f"newest version {self.versions} lost: worker parked with "
+                f"written={st['written']} pending={st['pending']} — the "
+                f"latest-wins contract broke"
+            )
+        return out
+
+
+# --------------------------------------------------------------- hot swap
+
+
+class HotSwapModel(_Model):
+    """The serve hot-swap no-mixed-tick protocol (serve/server.py
+    ``_ServeBatcher``): a swapper thread publishes (params, version)
+    bundles by single reference assignment; the batcher reads the bundle
+    ONCE per tick and serves every row of that tick from it. Invariant:
+    all rows of one tick carry one version.
+
+    ``mutant="per_row_read"`` re-reads the bundle per row (the code
+    shape the ONE-read contract exists to forbid): a swap landing
+    mid-tick produces a mixed tick."""
+
+    threads = ("swapper", "batcher")
+
+    def __init__(
+        self,
+        swaps: int = 2,
+        ticks: int = 2,
+        rows: int = 2,
+        mutant: Optional[str] = None,
+    ):
+        assert mutant in (None, "per_row_read")
+        self.swaps = swaps
+        self.ticks = ticks
+        self.rows = rows
+        self.mutant = mutant
+
+    def init(self) -> dict:
+        return {
+            "bundle": 0,  # published version
+            "swapped": 0,
+            "tick": 0,
+            "row": 0,
+            "tick_v": None,  # version read at tick start
+            "tick_rows": (),
+            "b_pc": "tick_start",
+            "violations": [],
+        }
+
+    def enabled(self, st: dict, tid: str) -> bool:
+        if tid == "swapper":
+            return st["swapped"] < self.swaps
+        return st["tick"] < self.ticks
+
+    def step(self, st: dict, tid: str) -> None:
+        if tid == "swapper":
+            st["swapped"] += 1
+            st["bundle"] = st["swapped"]  # one atomic rebind
+            return
+        pc = st["b_pc"]
+        if pc == "tick_start":
+            st["tick_v"] = st["bundle"]  # the ONE bundle read
+            st["tick_rows"] = ()
+            st["row"] = 0
+            st["b_pc"] = "row"
+        elif pc == "row":
+            v = st["bundle"] if self.mutant == "per_row_read" else st["tick_v"]
+            st["tick_rows"] += (v,)
+            st["row"] += 1
+            if st["row"] >= self.rows:
+                if len(set(st["tick_rows"])) > 1:
+                    st["violations"].append(
+                        f"tick {st['tick']} served rows from versions "
+                        f"{sorted(set(st['tick_rows']))} — a client observed a "
+                        f"mixed tick"
+                    )
+                st["tick"] += 1
+                st["b_pc"] = "tick_start"
+
+    def done(self, st: dict) -> bool:
+        return st["swapped"] >= self.swaps and st["tick"] >= self.ticks
+
+    def final_check(self, st: dict) -> List[str]:
+        return []
+
+
+def head_models() -> Dict[str, _Model]:
+    """The HEAD-protocol model set the nightly soak and the acceptance
+    tests exhaust — one entry per protocol, no mutants."""
+    return {
+        "ring_lease": RingLeaseModel(depth=2, batches=3),
+        "drained": DrainedModel(frames=2),
+        "coalesce": CoalesceModel(versions=3),
+        "hot_swap": HotSwapModel(swaps=2, ticks=2, rows=2),
+    }

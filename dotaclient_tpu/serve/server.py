@@ -126,7 +126,15 @@ class InferenceServer:
         self.last_weight_time = time.monotonic()
         # THE hot-swap cell: (params, version) swapped by one reference
         # assignment (poller thread writes, batcher tick reads once) —
-        # the atomically-rebound-and-read-once pattern.
+        # the atomically-rebound-and-read-once pattern. The tick READ
+        # needs no lock; the WRITERS do: a co-located learner chains
+        # swap_params off its WeightPublisher on_published hook while
+        # the broker poll thread applies fanout frames, and two
+        # unordered writers tear the (params, version) pair that
+        # apply_weight_frame's staleness rules read-modify-write
+        # (racecheck surfaced the write-write race on params/version/
+        # _bundle/weight_swaps_total; graftcheck PR).
+        self._swap_lock = threading.Lock()
         self._bundle: Tuple[object, int] = (self.params, self.version)
         # Batcher cfg: the serve knobs mapped onto the ActorConfig shape
         # InferenceBatcher speaks (gather window + policy).
@@ -171,10 +179,11 @@ class InferenceServer:
             params = unflatten_params(named_or_params, self.params)
         else:
             params = named_or_params
-        self.params = params
-        self.version = int(version)
-        self.weight_swaps_total += 1
-        self._bundle = (params, int(version))
+        with self._swap_lock:
+            self.params = params
+            self.version = int(version)
+            self.weight_swaps_total += 1
+            self._bundle = (params, int(version))
 
     def poke(self) -> None:
         """Wake the weight-poll thread now (WeightPublisher on_published
@@ -195,11 +204,17 @@ class InferenceServer:
                 continue
             if frame is None:
                 continue
-            if apply_weight_frame(self, frame, "serve"):
-                # apply_weight_frame mutated params/version; publish them
-                # as one tuple for the tick reader.
-                self.weight_swaps_total += 1
-                self._bundle = (self.params, self.version)
+            # Under the swap lock: apply_weight_frame reads self.version
+            # for its staleness rules and mutates params/version — a
+            # concurrent swap_params (the on_published hook) interleaving
+            # with that read-modify-write could re-publish an older tree
+            # over a newer one.
+            with self._swap_lock:
+                if apply_weight_frame(self, frame, "serve"):
+                    # apply_weight_frame mutated params/version; publish
+                    # them as one tuple for the tick reader.
+                    self.weight_swaps_total += 1
+                    self._bundle = (self.params, self.version)
 
     # ------------------------------------------------------------- serving
 

@@ -111,3 +111,24 @@ def lockcheck():
         yield monitor
     finally:
         monitor.uninstall()
+
+
+@pytest.fixture
+def racecheck():
+    """Vector-clock happens-before race sanitizer (dotaclient_tpu/
+    analysis/racecheck): patches threading.Lock/RLock/Condition/Event/
+    Thread and queue.Queue (repo-created objects only) for the duration
+    of the test; opt instances into attribute-write tracing with
+    monitor.watch(obj). Assert on monitor.races / monitor.report().
+    Mutually exclusive with the lockcheck fixture within one test (one
+    substrate may own threading at a time — install refuses otherwise).
+    Production never imports the module; this fixture is the only
+    enablement path."""
+    from dotaclient_tpu.analysis.racecheck import RaceMonitor
+
+    monitor = RaceMonitor()
+    monitor.install()
+    try:
+        yield monitor
+    finally:
+        monitor.uninstall()

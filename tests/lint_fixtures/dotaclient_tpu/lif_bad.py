@@ -1,0 +1,84 @@
+"""LIF001/LIF002 bad corpus: lease leaks, double release, release before
+the transfer retires, and drain-invisible stations. Never imported."""
+
+import queue
+import threading
+
+
+class LeakyPacker:
+    def __init__(self, ring):
+        self._ring = ring
+
+    def pack_leak(self, items):
+        # LIF001: the slot is never released nor returned
+        slot = self._ring.acquire(timeout=0.2)
+        return len(items)
+
+    def pack_raise_leak(self, items):
+        slot = self._ring.acquire(timeout=0.2)
+        if not items:
+            # LIF001: raise on the exception edge with no release before it
+            raise ValueError("empty batch")
+        slot.release()
+        return len(items)
+
+    def pack_double_release(self, items):
+        slot = self._ring.acquire(timeout=0.2)
+        slot.release()
+        # LIF001: straight-line double release — free-queue duplicate
+        slot.release()
+        return len(items)
+
+
+class DoubleBufferPacker:
+    """Two acquires in one function: the SECOND lease's leak must fire
+    even though the first checks out clean."""
+
+    def __init__(self, ring):
+        self._ring = ring
+
+    def pack_pair(self, items):
+        a = self._ring.acquire(timeout=0.2)
+        # LIF001: b is never released nor returned
+        b = self._ring.acquire(timeout=0.2)
+        a.release()
+        return len(items)
+
+
+class EarlyReleaseFetcher:
+    """The PR-11 bug shape: lease released at put-dispatch."""
+
+    def __init__(self, staging):
+        self.staging = staging
+
+    def fetch(self, batch_dev):
+        lease = self.staging.last_batch_lease
+        if lease is not None:
+            # LIF001: no block_until_ready precedes this release
+            lease.release()
+        return batch_dev
+
+
+class LossyDrainBuffer:
+    """The PR-7 bug shape: a station drained() cannot see, and a popper
+    holding frames in locals with no in-flight flag."""
+
+    def __init__(self, broker):
+        self.broker = broker
+        self._ready = queue.Queue(maxsize=2)
+        # LIF002: a queue frames can occupy that drained() never checks
+        self._side = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        # LIF002: pops frames, sets no flag drained() reads — frames in
+        # this thread's locals are invisible to the drain
+        while not self._stop.is_set():
+            frames = self.broker.consume_experience(max_items=4, timeout=0.2)
+            if frames:
+                self._side.put(frames)
+
+    def drained(self):
+        return self._ready.empty()

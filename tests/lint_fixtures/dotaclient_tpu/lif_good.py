@@ -1,0 +1,101 @@
+"""LIF001/LIF002 good corpus: the sanctioned lease and drain shapes —
+must lint clean under every rule. Never imported."""
+
+import queue
+import threading
+
+import jax
+
+
+class CleanPacker:
+    """Release on the error edge, ownership transfer on success."""
+
+    def __init__(self, ring):
+        self._ring = ring
+
+    def _fill(self, slot, items):
+        return None
+
+    def pack(self, items):
+        slot = self._ring.acquire(timeout=0.2)
+        err = self._fill(slot, items)
+        if err is not None:
+            slot.release()
+            raise err
+        # returning the slot transfers ownership to the fetcher
+        return slot
+
+
+class RetiringFetcher:
+    """The learner shape: release only after the put retires."""
+
+    def __init__(self, staging):
+        self.staging = staging
+
+    def fetch(self, put_result):
+        lease = self.staging.last_batch_lease
+        if lease is not None:
+            jax.block_until_ready(put_result)
+            lease.release()
+        return put_result
+
+
+class FinallyPacker:
+    """The idiomatic cleanup shape: a finally-block release covers every
+    raise inside the try by construction — must lint clean."""
+
+    def __init__(self, ring):
+        self._ring = ring
+
+    def pack(self, items):
+        slot = self._ring.acquire(timeout=0.2)
+        try:
+            if not items:
+                raise ValueError("empty batch")
+            return list(items)
+        finally:
+            slot.release()
+
+
+class NotARingAcquire:
+    """A 'ring'-substring lock name is NOT a transfer-ring lease: the
+    LIF001 receiver match is anchored to a terminal ring component, so
+    this ordinary acquire/release pair must lint clean."""
+
+    def __init__(self):
+        self._wiring_lock = threading.Lock()
+
+    def poll(self):
+        ok = self._wiring_lock.acquire(timeout=1.0)
+        if ok:
+            self._wiring_lock.release()
+        return ok
+
+
+class CleanDrainBuffer:
+    """Every station visible to drained(): the queue is checked, the
+    popper publishes its in-flight locals via a flag under the lock."""
+
+    def __init__(self, broker):
+        self.broker = broker
+        self._ready = queue.Queue(maxsize=2)
+        self._popping = False
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._popping = True
+            frames = self.broker.consume_experience(max_items=4, timeout=0.2)
+            if frames:
+                self._ready.put(frames)
+            with self._lock:
+                self._popping = False
+
+    def drained(self):
+        with self._lock:
+            if self._popping:
+                return False
+        return self._ready.empty()

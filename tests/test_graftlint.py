@@ -73,7 +73,19 @@ def _fixture_report():
 def test_every_rule_fires_on_the_bad_corpus():
     report = _fixture_report()
     fired = {f.rule for f in report.findings}
-    expected = {"THR001", "THR002", "JAX001", "JAX002", "JAX003", "OBS001", "OBS002", "OBS003"}
+    expected = {
+        "THR001",
+        "THR002",
+        "JAX001",
+        "JAX002",
+        "JAX003",
+        "OBS001",
+        "OBS002",
+        "OBS003",
+        "LIF001",
+        "LIF002",
+        "WIRE001",
+    }
     assert expected <= fired, f"rules that never fired: {expected - fired}"
     # every registered code rule is exercised by the corpus
     assert expected == set(RULES), "corpus out of sync with the rule registry"
@@ -145,6 +157,30 @@ def test_specific_known_bad_lines():
     # and --config are another binary's namespace and must NOT
     flagged = {f.message.split(" ", 1)[0] for f in obs002}
     assert flagged == {"--no_such_flag", "--bogus_env_flag"}, obs002
+    # the scripts/ half of OBS002: the argv list naming a known binary
+    # fires on its unknown flag; the self-reinvocation list (no module
+    # string) stays out of scope
+    obs002_s = by_rule[("OBS002", "spawn_fixture.py")]
+    flagged_s = {f.message.split(" ", 1)[0] for f in obs002_s}
+    assert flagged_s == {"--not_a_learner_flag"}, obs002_s
+    # LIF001: all five shapes — leak, raise-edge leak, double release,
+    # second-acquire leak, release-before-retire — each on its labeled
+    # method
+    lif001 = {f.context for f in by_rule[("LIF001", "lif_bad.py")]}
+    assert lif001 == {
+        "LeakyPacker.pack_leak",
+        "LeakyPacker.pack_raise_leak",
+        "LeakyPacker.pack_double_release",
+        "DoubleBufferPacker.pack_pair",
+        "EarlyReleaseFetcher.fetch",
+    }, lif001
+    # LIF002: the drain-invisible queue AND the flag-less popper
+    lif002 = by_rule[("LIF002", "lif_bad.py")]
+    assert any("self._side" in f.message for f in lif002)
+    assert any("in-flight flag" in f.message for f in lif002)
+    # WIRE001: the fixture packer.cc deliberately drifts kWireBf16
+    wire = by_rule[("WIRE001", "packer.cc")]
+    assert any("wire code bf16: 3 (py) vs 4 (cc)" in f.message for f in wire)
 
 
 def test_bad_snippet_introduced_into_package_fails(tmp_path):
@@ -540,6 +576,146 @@ def test_inline_suppression_with_reason_suppresses(tmp_path):
     )
     assert report.findings == []
     assert len(report.suppressed) == 1
+
+
+# ------------------------------------------------- graftcheck lifecycle/wire
+
+
+def _package_copy(tmp_path):
+    """A linted-shape copy of the real tree (package + k8s; no scripts —
+    the mutant tests target package files)."""
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "dotaclient_tpu"), tmp_path / "dotaclient_tpu"
+    )
+    shutil.copytree(os.path.join(REPO_ROOT, "k8s"), tmp_path / "k8s")
+    return tmp_path
+
+
+def test_wire001_head_parity():
+    """Acceptance bar: WIRE001 derives the SAME DTR layout from
+    serialize.py (ast) and packer.cc (regex) on HEAD — header/trace
+    sizes, wire codes, and all four canonical dtype-maps."""
+    from dotaclient_tpu.analysis.lif_rules import (
+        parse_packer_spec,
+        parse_serialize_spec,
+    )
+
+    py, py_errs = parse_serialize_spec(
+        os.path.join(REPO_ROOT, "dotaclient_tpu", "transport", "serialize.py")
+    )
+    cc, cc_errs = parse_packer_spec(
+        os.path.join(REPO_ROOT, "dotaclient_tpu", "native", "packer.cc")
+    )
+    assert py_errs == [] and cc_errs == []
+    assert py.diffs(cc) == []
+    # the spec is substantive, not vacuously equal
+    assert py.header_bytes == 21 and py.trace_ext_bytes == 16
+    assert py.codes == {"f32": 0, "i32": 1, "u8": 2, "bf16": 3}
+    assert len(py.maps[(False, False)]) == 16
+    assert len(py.maps[(True, True)]) == 19
+
+
+def test_early_lease_release_mutant_fails_lint(tmp_path):
+    """Acceptance bar (the PR-11 regression, static half): re-introduce
+    the early-lease-release bug into the REAL learner — release before
+    the block_until_ready fence — and LIF001 catches it. (The dynamic
+    half is schedcheck's ring model, tests/test_schedcheck.py.)"""
+    root = _package_copy(tmp_path)
+    lp = root / "dotaclient_tpu" / "runtime" / "learner.py"
+    src = lp.read_text()
+    mutant = src.replace(
+        "                jax.block_until_ready(batch_dev)\n"
+        "                lease.release()",
+        "                lease.release()",
+    )
+    assert mutant != src, "learner release site moved — update this pin"
+    lp.write_text(mutant)
+    report = lint_repo(str(root))
+    lif = [f for f in report.findings if f.rule == "LIF001"]
+    assert lif, "early-lease-release mutant not caught by LIF001"
+    assert any("Learner._fetch_next" in f.context for f in lif)
+
+
+def test_packer_layout_drift_mutant_fails_lint(tmp_path):
+    """A dtype-map loop-boundary edit in the REAL packer.cc that
+    serialize.py does not mirror fails WIRE001."""
+    root = _package_copy(tmp_path)
+    pp = root / "dotaclient_tpu" / "native" / "packer.cc"
+    src = pp.read_text()
+    mutant = src.replace(
+        "for (int64_t i = 6; i < 10; ++i)", "for (int64_t i = 6; i < 9; ++i)"
+    ).replace(
+        "for (int64_t i = 10; i < n_map; ++i)",
+        "for (int64_t i = 9; i < n_map; ++i)",
+    )
+    assert mutant != src, "packer.cc validation loops moved — update this pin"
+    pp.write_text(mutant)
+    report = lint_repo(str(root))
+    wire = [f for f in report.findings if f.rule == "WIRE001"]
+    assert wire and all("dtype-map" in f.message for f in wire)
+
+
+def test_packer_unparseable_layout_is_itself_a_finding(tmp_path):
+    """WIRE001 extraction failing (a layout edit that breaks the
+    structured regexes) is a loud finding, never a silent skip — the
+    MIGRATION contract that packer.cc edits keep the spec extractable."""
+    root = _package_copy(tmp_path)
+    pp = root / "dotaclient_tpu" / "native" / "packer.cc"
+    pp.write_text(pp.read_text().replace("constexpr int64_t kHeaderBytes", "static int64_t header_bytes"))
+    report = lint_repo(str(root))
+    wire = [f for f in report.findings if f.rule == "WIRE001"]
+    assert wire and any("extraction failed" in f.message for f in wire)
+
+
+def test_wire_pair_half_missing_is_loud(tmp_path):
+    """Renaming/moving ONE side of the serialize.py↔packer.cc pair must
+    not make WIRE001 vanish silently — half a pair is a finding; only a
+    corpus with NEITHER file (no wire layer at all) skips."""
+    root = _package_copy(tmp_path)
+    os.remove(root / "dotaclient_tpu" / "native" / "packer.cc")
+    report = lint_repo(str(root))
+    wire = [f for f in report.findings if f.rule == "WIRE001"]
+    assert wire and any("lost half its pair" in f.message for f in wire)
+
+
+def test_serialize_alias_refactor_is_loud_not_dead(tmp_path):
+    """A _canonical_codes refactor through a local alias defeats the
+    list-algebra extraction — that must surface as an extraction-failed
+    FINDING, never an exception that kills the lint run and loses every
+    other rule's findings."""
+    root = _package_copy(tmp_path)
+    sp = root / "dotaclient_tpu" / "transport" / "serialize.py"
+    src = sp.read_text()
+    mutant = src.replace(
+        "codes = [obs_code] * 3 + [_WIRE_U8] * 3 + [_WIRE_I32] * 4 "
+        "+ [_WIRE_F32] * 6",
+        "f = _WIRE_F32\n    codes = [obs_code] * 3 + [_WIRE_U8] * 3 "
+        "+ [_WIRE_I32] * 4 + [f] * 6",
+    )
+    assert mutant != src, "_canonical_codes body moved — update this pin"
+    sp.write_text(mutant)
+    report = lint_repo(str(root))  # must not raise
+    wire = [f for f in report.findings if f.rule == "WIRE001"]
+    assert wire and any("extraction failed" in f.message for f in wire)
+
+
+def test_scripts_flag_drift_mutant_fails_lint(tmp_path):
+    """The OBS002 scripts pass on a REAL-shaped tree: a driver spawning
+    a known binary with an unknown flag fails the lint."""
+    root = _package_copy(tmp_path)
+    scripts = root / "scripts"
+    scripts.mkdir()
+    (scripts / "bad_driver.py").write_text(
+        "import subprocess, sys\n"
+        "def spawn():\n"
+        "    subprocess.Popen([sys.executable, '-m',\n"
+        "                      'dotaclient_tpu.serve.server',\n"
+        "                      '--serve.port', '0',\n"
+        "                      '--serve.bogus_knob', '1'])\n"
+    )
+    report = lint_repo(str(root))
+    obs = [f for f in report.findings if f.rule == "OBS002"]
+    assert any("--serve.bogus_knob" in f.message for f in obs)
 
 
 # ------------------------------------------------------------- nightly lane

@@ -1,0 +1,290 @@
+"""Schedcheck tests (dotaclient_tpu/analysis/schedcheck.py): bounded
+exhaustive exploration of the protocol models, the failing-then-fixed
+regression schedules for the two shipped bug classes (PR-11
+early-lease-release H2D corruption, PR-7 drained()-while-in-locals
+loss), and cross-validation of the ring model against the real
+TransferRing/RingSlot. Pure stdlib except the cross-validation — the
+no-JAX subprocess proof pins that."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from types import SimpleNamespace
+
+import pytest
+
+from dotaclient_tpu.analysis.schedcheck import (
+    CoalesceModel,
+    DrainedModel,
+    HotSwapModel,
+    RingLeaseModel,
+    explore,
+    head_models,
+    random_walks,
+)
+from tests.conftest import clean_subprocess_env
+
+REPO_ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+# ----------------------------------------------------- HEAD protocols
+
+
+def test_head_protocols_exhaust_clean():
+    """Acceptance bar: every HEAD protocol model explores its ENTIRE
+    bounded interleaving set with zero violations — ring-lease and
+    drained() included. `exhausted` is asserted explicitly: a clean but
+    truncated search proves nothing."""
+    for name, model in head_models().items():
+        result = explore(model)
+        assert result.exhausted, f"{name}: truncated at {result.states} states"
+        assert result.violations == [], f"{name}: {result.violations}"
+        assert result.states > 10, f"{name}: vacuous model ({result.states} states)"
+
+
+def test_require_exhausted_clean_raises_on_truncation():
+    result = explore(RingLeaseModel(depth=2, batches=3), max_states=5)
+    assert not result.exhausted
+    with pytest.raises(AssertionError, match="truncated"):
+        result.require_exhausted_clean()
+
+
+# -------------------------------------- shipped bug class 1: ring lease
+
+
+def test_early_lease_release_schedule_found_then_fixed():
+    """The PR-11 regression as a failing-then-fixed schedule pair: with
+    the lease released at put-dispatch, exploration FINDS the schedule
+    where the packer repacks the slot under the in-flight H2D read; with
+    the HEAD protocol (release after retire) the same bounded set is
+    exhausted clean. (The static half of this pin is LIF001,
+    tests/test_graftlint.py::test_early_lease_release_mutant_fails_lint.)"""
+    broken = explore(RingLeaseModel(depth=2, batches=3, mutant="early_release"))
+    assert any("early-lease-release corruption" in v for v in broken.violations)
+    fixed = explore(RingLeaseModel(depth=2, batches=3))
+    assert fixed.exhausted and fixed.violations == []
+
+
+def test_double_release_schedule_found():
+    """Losing RingSlot._held (non-idempotent release) duplicates the
+    slot in the free queue; exploration finds the acquire that hands out
+    a non-free slot."""
+    broken = explore(RingLeaseModel(depth=2, batches=4, mutant="double_release"))
+    assert any("double release" in v for v in broken.violations)
+
+
+# ---------------------------------------- shipped bug class 2: drained()
+
+
+@pytest.mark.parametrize(
+    "mutant",
+    ["no_packing_check", "downstream_first", "clear_flag_before_put"],
+)
+def test_drained_loss_schedules_found_then_fixed(mutant):
+    """The PR-7 regression: each mutant re-introduces a way for
+    drained() to declare victory over in-flight frames — the missing
+    _packing check (the shipped bug), downstream-first station reads,
+    and clearing the flag before the ready-queue put. Exploration finds
+    the losing schedule for each; the HEAD protocol (upstream-first,
+    flag-set-under-the-pop-lock) is exhausted clean."""
+    broken = explore(DrainedModel(frames=2, mutant=mutant))
+    assert any("PR-7 bug class" in v for v in broken.violations), (
+        mutant,
+        broken.violations,
+    )
+    fixed = explore(DrainedModel(frames=2))
+    assert fixed.exhausted and fixed.violations == []
+
+
+# --------------------------------------------- the other two protocols
+
+
+def test_coalesce_lost_newest_schedule_found():
+    broken = explore(CoalesceModel(versions=3, mutant="no_resubmit"))
+    assert any("latest-wins contract broke" in v for v in broken.violations)
+
+
+def test_hot_swap_mixed_tick_schedule_found():
+    broken = explore(HotSwapModel(swaps=2, ticks=2, rows=2, mutant="per_row_read"))
+    assert any("mixed tick" in v for v in broken.violations)
+
+
+def test_deadlock_is_a_violation():
+    """No enabled thread + not done = deadlock, reported — the
+    cancel-swallow teardown class is a search outcome, not a hang."""
+
+    class Stuck:
+        threads = ("a",)
+
+        def init(self):
+            return {"pc": 0, "violations": []}
+
+        def enabled(self, st, tid):
+            return st["pc"] == 0
+
+        def step(self, st, tid):
+            st["pc"] = 1  # now waits forever on a condition never set
+
+        def is_local(self, st, tid):
+            return False
+
+        def invariant(self, st):
+            return st["violations"]
+
+        def done(self, st):
+            return False
+
+        def final_check(self, st):
+            return []
+
+        def describe(self, st):
+            return str(st)
+
+    result = explore(Stuck())
+    assert any("deadlock" in v for v in result.violations)
+
+
+def test_random_walks_are_seed_deterministic():
+    a = random_walks(DrainedModel(frames=2), runs=30, seed=7)
+    b = random_walks(DrainedModel(frames=2), runs=30, seed=7)
+    assert a.states == b.states and a.violations == b.violations
+    assert not a.exhausted  # walks never claim exhaustion
+    # walks through a mutant find the bug too (the soak's teeth)
+    c = random_walks(
+        DrainedModel(frames=2, mutant="no_packing_check"), runs=300, seed=7
+    )
+    assert c.violations
+
+
+# ------------------------------------------ cross-validation vs real code
+
+
+def _stub_ring(depth=2):
+    """A real TransferRing over a stub io — the lifecycle semantics the
+    model assumes, exercised on the shipped class."""
+    import numpy as np
+
+    from dotaclient_tpu.env import featurizer as F
+    from dotaclient_tpu.parallel.fused_io import TransferRing
+
+    def alloc_transfer():
+        payload = {"f32": np.ones((2, 8), np.float32)}
+        batch = SimpleNamespace(
+            obs=SimpleNamespace(
+                action_mask=np.zeros((2, 3, F.N_ACTION_TYPES), bool)
+            )
+        )
+        return payload, batch
+
+    io = SimpleNamespace(alloc_transfer=alloc_transfer)
+    return TransferRing(io, depth)
+
+
+def test_ring_model_matches_real_transfer_ring():
+    """The three semantics the ring model encodes, asserted against the
+    REAL TransferRing/RingSlot: acquire hands out only free slots (and
+    re-zeros them), release is idempotent (no free-queue duplicate — the
+    model's double_release mutant is UNREACHABLE through the real API),
+    and a released slot round-trips back through acquire."""
+    ring = _stub_ring(depth=2)
+    a = ring.acquire(timeout=1)
+    b = ring.acquire(timeout=1)
+    assert a is not None and b is not None and a is not b
+    assert ring.acquire(timeout=0.05) is None  # backpressure: all leased
+    assert (a.payload["f32"] == 0).all()  # acquire re-zeroed the buffer
+    a.payload["f32"][:] = 7.0
+    a.release()
+    a.release()  # idempotent: must NOT duplicate the slot
+    assert ring.occupancy == 1
+    c = ring.acquire(timeout=1)
+    assert c is a and (c.payload["f32"] == 0).all()
+    assert ring.acquire(timeout=0.05) is None  # no phantom second copy
+    b.release()
+    c.release()
+    assert ring.occupancy == 0
+
+
+def test_drained_model_station_order_matches_staging_source():
+    """The model's station list IS StagingBuffer.drained()'s check
+    order — pin the real method's upstream-first reads so a reorder
+    there invalidates the model loudly instead of silently."""
+    import ast
+    import os
+
+    path = os.path.join(REPO_ROOT, "dotaclient_tpu", "runtime", "staging.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    drained = next(
+        n
+        for cls in ast.walk(tree)
+        if isinstance(cls, ast.ClassDef) and cls.name == "StagingBuffer"
+        for n in cls.body
+        if isinstance(n, ast.FunctionDef) and n.name == "drained"
+    )
+    tagged = []
+    for node in ast.walk(drained):
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("_popping", "unfinished_tasks", "_packing"):
+                tagged.append((node.lineno, node.col_offset, node.attr))
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "empty":
+                tagged.append((node.lineno, node.col_offset, "ready"))
+    src_order = []
+    for _, _, label in sorted(tagged):  # ast.walk is BFS; sort by position
+        if label not in src_order:
+            src_order.append(label)
+    assert src_order == ["_popping", "unfinished_tasks", "_packing", "ready"], (
+        "StagingBuffer.drained() station order changed — update "
+        "DrainedModel._stations to match, or the model checks a protocol "
+        "the code no longer runs"
+    )
+
+
+def test_schedcheck_runs_without_jax_in_subprocess():
+    """Schedule exploration is pure stdlib: a subprocess (env stripped
+    of the pytest XLA cache + 8-device flag per the known wedge) runs
+    the full HEAD model set and never imports jax or numpy."""
+    code = textwrap.dedent(
+        f"""
+        import sys
+        sys.path.insert(0, {REPO_ROOT!r})
+        from dotaclient_tpu.analysis.schedcheck import head_models, explore
+        for name, m in head_models().items():
+            r = explore(m)
+            assert r.exhausted and not r.violations, (name, r.violations)
+        assert "jax" not in sys.modules, "schedcheck imported jax"
+        assert "numpy" not in sys.modules, "schedcheck imported numpy"
+        """
+    )
+    subprocess.run(
+        [sys.executable, "-c", code],
+        check=True,
+        timeout=120,
+        env=clean_subprocess_env(),
+    )
+
+
+# ------------------------------------------------------------- nightly lane
+
+
+@pytest.mark.nightly
+@pytest.mark.slow
+def test_schedule_soak_deeper_bounds():
+    """The nightly schedule soak: wider bounds on every protocol
+    (deeper rings, more frames/versions/ticks) explored exhaustively,
+    plus long seeded random walks — still zero violations."""
+    deep = {
+        "ring_lease": RingLeaseModel(depth=3, batches=5),
+        "drained": DrainedModel(frames=3, intake_cap=2, ready_cap=2),
+        "coalesce": CoalesceModel(versions=5),
+        "hot_swap": HotSwapModel(swaps=3, ticks=3, rows=3),
+    }
+    for name, model in deep.items():
+        result = explore(model, max_states=2_000_000)
+        assert result.exhausted, f"{name}: truncated at {result.states}"
+        assert result.violations == [], f"{name}: {result.violations}"
+    for name, model in deep.items():
+        walks = random_walks(model, runs=500, seed=11, max_steps=20_000)
+        assert walks.violations == [], f"{name}: {walks.violations}"
