@@ -95,6 +95,7 @@ _BINARY_CONFIGS = {
     "dotaclient_tpu.runtime.selfplay": "ActorConfig",
     "dotaclient_tpu.eval.evaluator": "EvalConfig",
     "dotaclient_tpu.serve.server": "InferenceConfig",
+    "dotaclient_tpu.serve.handoff": "HandoffConfig",
     "dotaclient_tpu.transport.tcp_server": "argparse:transport/tcp_server.py",
 }
 

@@ -62,6 +62,7 @@ __all__ = [
     "DrainedModel",
     "CoalesceModel",
     "HotSwapModel",
+    "HandoffModel",
 ]
 
 
@@ -759,6 +760,223 @@ class HotSwapModel(_Model):
         return []
 
 
+# ---------------------------------------------------------- carry handoff
+
+
+class HandoffModel(_Model):
+    """The session-continuity carry-handoff lifecycle (serve/handoff.py
+    + serve/server.py + serve/client.py): stream → durable → failover-
+    read → resume.
+
+    One client steps an episode through a serving tier that can be
+    killed (kill = resident carry lost, unacked in-flight reply lost,
+    un-landed store writes lost; restart is immediate — the in-process
+    ServeIncarnations shape). At every chunk boundary the server
+    WRITE-AHEAD streams the boundary carry to a keep-two store, THEN
+    acks the chunk-fill step. On a failure the client resumes: restore
+    the store entry matching its last OBSERVED boundary exactly (or the
+    episode-start zeros when no boundary passed), replay its buffered
+    partial chunk, re-issue the failed step.
+
+    The carry is modeled as its episode POSITION: a serve of step k from
+    carry position != k is the bitwise-divergence violation (the replay
+    count is the client's steps-since-boundary, so a wrong restore point
+    shifts every subsequent row); an abandon is itself a violation —
+    this protocol exists to make replica death an episode non-event.
+
+    Mutants (each a shipped-bug class the fixed protocol excludes):
+    - ``handoff_after_ack``: the server acks the chunk-fill step BEFORE
+      the store write lands. A kill in the ack→write window leaves the
+      client vouched-for boundary missing from the store — the next
+      failover's resume finds nothing matching and the episode abandons.
+    - ``resume_from_stale``: the server returns the NEWEST store entry
+      regardless of the client's boundary. When they differ (e.g. the
+      write landed but the kill ate the ack), the restored carry is at
+      the wrong position and every replayed/subsequent row diverges.
+    - ``single_entry``: the store keeps only the newest entry. The
+      previous boundary is load-bearing — write landed + ack lost means
+      the store is one boundary AHEAD of the client, and without the
+      previous entry the exact-match resume refuses (abandon).
+    - ``dup_shift``: a put whose boundary EQUALS the newest entry's
+      shifts instead of replacing. Exploration of THIS model found the
+      bug during development: a resumed client re-issues its chunk-fill
+      step, the server re-writes the same boundary, the duplicate shift
+      evicts the previous entry — and a second kill before the re-issued
+      ack lands abandons an episode keep-two was supposed to save.
+      CarryStore.put replaces on equal episode_step because of this."""
+
+    threads = ("client", "server", "chaos")
+
+    def __init__(
+        self,
+        steps: int = 5,
+        chunk: int = 2,
+        kills: int = 2,
+        mutant: Optional[str] = None,
+    ):
+        assert mutant in (
+            None,
+            "handoff_after_ack",
+            "resume_from_stale",
+            "single_entry",
+            "dup_shift",
+        )
+        self.steps = steps
+        self.chunk = chunk
+        self.kills = kills
+        self.mutant = mutant
+        self.keep = 1 if mutant == "single_entry" else 2
+
+    def init(self) -> dict:
+        return {
+            "c_steps": 0,  # completed steps (acks consumed)
+            "c_boundary": 0,  # last OBSERVED chunk boundary
+            "c_pc": "issue",
+            "issued": None,  # step index in flight
+            "ack": False,  # reply delivered, not yet consumed
+            "failed": False,  # connection failure / UNKNOWN_CLIENT pending
+            "carry": None,  # server-resident carry position
+            "s_pc": "idle",
+            "pending_write": None,  # mutant handoff_after_ack: write after ack
+            "store": (),  # retained entry positions, newest first
+            "kills": 0,
+            "violations": [],
+        }
+
+    # -- enabledness ---------------------------------------------------
+
+    def enabled(self, st: dict, tid: str) -> bool:
+        if tid == "client":
+            if st["c_pc"] == "issue":
+                return st["c_steps"] < self.steps and st["issued"] is None
+            if st["c_pc"] == "wait":
+                return st["ack"] or st["failed"]
+            return True  # resume
+        if tid == "server":
+            if st["s_pc"] == "idle":
+                return st["issued"] is not None and not st["ack"] and not st["failed"]
+            return True  # write / ack / late_write stages pending
+        # chaos: bounded kills while the episode is still running
+        return st["kills"] < self.kills and st["c_steps"] < self.steps
+
+    # -- transitions ---------------------------------------------------
+
+    def _store_push(self, st: dict, value: int) -> None:
+        # Same-boundary puts REPLACE the head entry (a resumed client
+        # re-issuing its chunk-fill step re-writes the same boundary;
+        # shifting would evict the previous entry keep-two exists for —
+        # the dup_shift mutant is that bug, found by exploring this
+        # model; CarryStore.put mirrors this rule).
+        if st["store"] and st["store"][0] == value and self.mutant != "dup_shift":
+            return
+        st["store"] = (value,) + st["store"][: self.keep - 1]
+
+    def step(self, st: dict, tid: str) -> None:
+        if tid == "client":
+            pc = st["c_pc"]
+            if pc == "issue":
+                st["issued"] = st["c_steps"]
+                st["c_pc"] = "wait"
+            elif pc == "wait":
+                if st["ack"]:
+                    st["ack"] = False
+                    st["issued"] = None
+                    st["c_steps"] += 1
+                    if st["c_steps"] % self.chunk == 0:
+                        # the reply just consumed vouches for this
+                        # boundary (write-ahead made it durable first)
+                        st["c_boundary"] = st["c_steps"]
+                    st["c_pc"] = "issue"
+                else:  # failed
+                    st["failed"] = False
+                    st["issued"] = None
+                    st["c_pc"] = "resume"
+            elif pc == "resume":
+                if st["c_boundary"] == 0:
+                    restored = 0  # episode-start zeros; no store needed
+                elif self.mutant == "resume_from_stale":
+                    if not st["store"]:
+                        st["violations"].append(
+                            "episode abandoned: resume found an empty store "
+                            "for an observed boundary"
+                        )
+                        restored = st["c_boundary"]
+                    else:
+                        restored = st["store"][0]  # newest, match ignored
+                else:
+                    matches = [e for e in st["store"] if e == st["c_boundary"]]
+                    if matches:
+                        restored = matches[0]
+                    else:
+                        st["violations"].append(
+                            f"episode abandoned: no store entry matches observed "
+                            f"boundary {st['c_boundary']} (store {st['store']}) — "
+                            f"a durable boundary went missing"
+                        )
+                        restored = st["c_boundary"]  # keep exploring past it
+                # replay the buffered partial chunk: steps_since_boundary
+                # advances, so a wrong restore point lands off-position
+                st["carry"] = restored + (st["c_steps"] - st["c_boundary"])
+                st["c_pc"] = "issue"
+            return
+        if tid == "server":
+            pc = st["s_pc"]
+            if pc == "idle":
+                k = st["issued"]
+                if k == 0:
+                    st["carry"] = 0  # EPISODE_START reset
+                if st["carry"] is None:
+                    st["failed"] = True  # UNKNOWN_CLIENT — no resident carry
+                    return
+                if st["carry"] != k:
+                    st["violations"].append(
+                        f"served step {k} from carry position {st['carry']} — "
+                        f"resumed rows diverge bitwise (stale-carry class)"
+                    )
+                st["carry"] += 1
+                if st["carry"] % self.chunk == 0:  # chunk-fill step
+                    if self.mutant == "handoff_after_ack":
+                        st["pending_write"] = st["carry"]
+                        st["s_pc"] = "ack"
+                    else:
+                        st["s_pc"] = "write"  # WRITE-AHEAD, then ack
+                else:
+                    st["s_pc"] = "ack"
+            elif pc == "write":
+                self._store_push(st, st["carry"])
+                st["s_pc"] = "ack"
+            elif pc == "ack":
+                st["ack"] = True
+                st["s_pc"] = "late_write" if st["pending_write"] is not None else "idle"
+            elif pc == "late_write":
+                self._store_push(st, st["pending_write"])
+                st["pending_write"] = None
+                st["s_pc"] = "idle"
+            return
+        # chaos: kill + immediate restart (the in-process controller
+        # shape): resident carry gone, un-landed pipeline work gone, an
+        # unacked in-flight step surfaces as a connection failure; a
+        # reply already delivered (ack=True) stays delivered.
+        st["kills"] += 1
+        st["carry"] = None
+        st["s_pc"] = "idle"
+        st["pending_write"] = None
+        if st["issued"] is not None and not st["ack"]:
+            st["failed"] = True
+
+    def done(self, st: dict) -> bool:
+        return st["c_steps"] >= self.steps
+
+    def final_check(self, st: dict) -> List[str]:
+        out = []
+        if st["c_steps"] != self.steps:
+            out.append(f"episode finished {st['c_steps']} of {self.steps} steps")
+        for e in st["store"]:
+            if e % self.chunk != 0:
+                out.append(f"store entry {e} is not a chunk boundary")
+        return out
+
+
 def head_models() -> Dict[str, _Model]:
     """The HEAD-protocol model set the nightly soak and the acceptance
     tests exhaust — one entry per protocol, no mutants."""
@@ -767,4 +985,5 @@ def head_models() -> Dict[str, _Model]:
         "drained": DrainedModel(frames=2),
         "coalesce": CoalesceModel(versions=3),
         "hot_swap": HotSwapModel(swaps=2, ticks=2, rows=2),
+        "carry_handoff": HandoffModel(steps=5, chunk=2, kills=2),
     }

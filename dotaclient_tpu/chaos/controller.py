@@ -173,6 +173,15 @@ class ServeIncarnations:
             "weight_swaps": int(server.weight_swaps_total),
             "version": int(server.version),
             "carries_resident_at_kill": int(resident),
+            # Session-continuity counters (serve/handoff.py; zero when
+            # the life ran without a carry store): the handoff soak
+            # reconciles resumes against kills the same way the abandon
+            # ledger reconciles them in the PR-10 soak.
+            "handoff_writes": int(getattr(server, "handoff_writes_total", 0)),
+            "handoff_write_errors": int(getattr(server, "handoff_write_errors_total", 0)),
+            "resumes": int(getattr(server, "resumes_total", 0)),
+            "resume_misses": int(getattr(server, "resume_misses_total", 0)),
+            "replayed_steps": int(getattr(server, "replayed_steps_total", 0)),
             "killed_at": time.monotonic() if chaos_kill else None,
         }
 
@@ -226,10 +235,19 @@ class ServeIncarnations:
             keys = (
                 "requests", "bad_requests", "episode_resets", "unknown_client",
                 "evictions", "weight_swaps", "carries_resident_at_kill",
+                "handoff_writes", "handoff_write_errors", "resumes",
+                "resume_misses", "replayed_steps",
             )
             total = {k: sum(l[k] for l in self.ledgers) for k in keys}
             total["incarnations"] = len(self.ledgers)
             return total
+
+    def replica_count(self) -> int:
+        """One controller = one replica; multi-replica topologies route
+        through a replica router (e.g. the soaks' round-robin router)
+        that fans kill()/restart() across N of these and reports N here
+        — the rolling@T:P@server execution contract."""
+        return 1
 
 
 class LearnerIncarnations:
@@ -448,11 +466,67 @@ class ScheduleRunner:
             self._stop.wait(min(remaining, 0.2))
         return False
 
+    def _sleep_wall(self, duration_s: float) -> bool:
+        """Sleep a wall-relative duration; False if stopped first. The
+        rolling executor paces on wall time, not schedule offsets —
+        restart/probe latencies vary and each replica's down window must
+        be the configured P regardless of how long the previous
+        replica's recovery took."""
+        deadline = time.monotonic() + duration_s
+        while not self._stop.is_set():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return True
+            self._stop.wait(min(remaining, 0.2))
+        return False
+
+    def _run_rolling(self, k: int, ev) -> bool:
+        """Execute one rolling@T:P@server event: kill replica i, hold it
+        down P seconds, restart it, wait for its recovery probe, then
+        move to replica i+1 — sequential, so at most ONE replica is ever
+        down (the property the zero-abandon handoff soak rides on). The
+        controller's kill()/restart() rotation supplies the fan-out; a
+        bare ServeIncarnations rolls its single replica."""
+        count_fn = getattr(self.server_inc, "replica_count", None)
+        n = int(count_fn()) if count_fn is not None else 1
+        probe = getattr(self.server_inc, "wait_first_request", None)
+        for r in range(n):
+            self.server_inc.kill()
+            if not self._sleep_wall(ev.duration_s):
+                return False
+            self.server_inc.restart()
+            restarted = time.monotonic()
+            # Bounded probe: with session continuity on, clients resume
+            # onto the SURVIVOR, so the reborn replica legitimately
+            # idles until the next roll forces them back — a short probe
+            # keeps the roll moving and None is not an error here.
+            first = None
+            if probe is not None:
+                first = probe(timeout=1.5, stop=self._stop)
+            self.recovery.append(
+                {
+                    "kill_index": k,
+                    "target": "server",
+                    "kind": "rolling",
+                    "replica": r,
+                    "at_s": ev.at_s,
+                    "down_s": round(ev.duration_s, 3),
+                    "recovery_s": None if first is None else round(first - restarted, 3),
+                }
+            )
+            if self._stop.is_set():
+                return False
+        return True
+
     def _run(self) -> None:
         kills = self.schedule.kills()
         for k, ev in enumerate(kills):
             if not self._sleep_until(ev.at_s):
                 return
+            if ev.kind == "rolling":
+                if not self._run_rolling(k, ev):
+                    return
+                continue
             if ev.target == "learner":
                 self.learner_inc.kill(sig=ev.signal)
                 if not self._sleep_until(ev.at_s + ev.duration_s):

@@ -18,6 +18,19 @@ instead of an anecdote):
 Timed one-shots (wall-clock offsets from the schedule epoch `t0`):
 
     stall@T:D       every broker op blocks for the window [T, T+D)
+    rolling@T:P@server
+                    staggered sequential restarts across the serve
+                    tier's replicas, starting at T: kill replica 0,
+                    keep it down P seconds, restart it, wait for its
+                    recovery probe, then replica 1, and so on — at most
+                    ONE replica is ever down, the rolling-deploy shape.
+                    Executed by a ScheduleRunner whose server
+                    controller fans kills across replicas (a
+                    replica_count()-bearing router, or a bare
+                    ServeIncarnations = 1 replica). The selector rides
+                    the ARG side like the kill targets, so existing
+                    specs parse byte-identically and no rate draw ever
+                    moves (the golden decision-sequence pin covers it).
     kill@T:D        kill the broker at T, restart it at T+D — executed
                     by a ScheduleRunner against a controller that owns
                     the broker process (chaos/controller.py), because a
@@ -59,10 +72,10 @@ _RATE_FAULTS = ("corrupt", "truncate", "dup", "reset", "shed")
 
 @dataclass
 class TimedEvent:
-    kind: str  # "stall" | "kill"
+    kind: str  # "stall" | "kill" | "rolling"
     at_s: float  # offset from the schedule epoch
-    duration_s: float
-    target: str = "broker"  # "broker" | "learner" (kill only)
+    duration_s: float  # down window (per replica, for rolling)
+    target: str = "broker"  # "broker" | "learner" | "server"
     signal: str = "kill"  # "kill" (SIGKILL) | "term" (SIGTERM drain); learner only
 
 
@@ -96,22 +109,31 @@ class FaultSchedule:
             name, _, arg = clause.partition(":")
             if "@" in name:
                 kind, _, at = name.partition("@")
-                if kind not in ("stall", "kill"):
+                if kind not in ("stall", "kill", "rolling"):
                     raise ValueError(f"unknown timed fault {kind!r} in {clause!r}")
-                # kill@T:D@TGT[:SIG] — the kill-target selector. The
+                # kill@T:D@TGT[:SIG] / rolling@T:P@server — the target
                 # selector rides the ARG side of the clause, so existing
                 # bare specs parse byte-identically (target defaults to
                 # broker) and the canonical rate-draw order never moves.
                 dur, _, tail = arg.partition("@")
-                target, sig = "broker", "kill"
+                target, sig = ("server" if kind == "rolling" else "broker"), "kill"
                 if tail:
-                    if kind != "kill":
+                    if kind == "stall":
                         raise ValueError(
-                            f"target selector only applies to kill, not {kind!r} "
-                            f"in {clause!r}"
+                            f"target selector only applies to kill/rolling, not "
+                            f"{kind!r} in {clause!r}"
                         )
                     target, _, sig_s = tail.partition(":")
-                    if target not in ("broker", "learner", "server"):
+                    if kind == "rolling":
+                        # rolling is a serve-tier shape: N replicas
+                        # behind one endpoint list; broker/learner are
+                        # singletons where rolling degenerates to kill.
+                        if target != "server" or sig_s:
+                            raise ValueError(
+                                f"rolling restarts target the serve tier only "
+                                f"(rolling@T:P@server) in {clause!r}"
+                            )
+                    elif target not in ("broker", "learner", "server"):
                         raise ValueError(f"unknown kill target {target!r} in {clause!r}")
                     if sig_s:
                         if target != "learner":
@@ -166,7 +188,10 @@ class FaultSchedule:
         return [e for e in self.events if e.kind == "stall"]
 
     def kills(self) -> List[TimedEvent]:
-        return [e for e in self.events if e.kind == "kill"]
+        """Kill-class timed events a ScheduleRunner executes — bare
+        kills AND rolling restarts (a rolling event is a kill sequence
+        fanned across replicas)."""
+        return [e for e in self.events if e.kind in ("kill", "rolling")]
 
     def stall_remaining(self, elapsed_s: float) -> float:
         """Seconds an op starting at `elapsed_s` (since epoch) must block

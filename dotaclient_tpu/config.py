@@ -219,6 +219,20 @@ class ServeConfig:
     # same broker weight fanout actors use; WeightPublisher's
     # on_published hook can poke the poll awake for same-tick swaps).
     weight_poll_s: float = 0.5
+    # Session continuity (serve/handoff.py): "host:port" of the shared
+    # carry store this replica streams (client_key, carry, version,
+    # episode_step) deltas to at every chunk-boundary step — the
+    # write-ahead happens BEFORE the chunk-fill reply, so a boundary a
+    # client observed is always durably restorable. "" (default) = off:
+    # no store connection, no extra bytes, replica death abandons
+    # in-flight episodes exactly like PR-10. Requires fleet-unique
+    # client keys (the actor_id scheme already guarantees this).
+    handoff_endpoint: str = ""
+    # Per-RPC budget against the carry store. A store outage never
+    # stops serving: the write is skipped (counted in
+    # serve_handoff_store_errors_total) and the affected sessions
+    # degrade to the PR-10 abandon semantics on the next failover.
+    handoff_timeout_s: float = 2.0
 
 
 @dataclass
@@ -262,6 +276,29 @@ class ServeClientConfig:
     # already covers those when a sibling replica is up): engaging is
     # cheap but flips the fleet off the accelerator tier.
     fallback_after_s: float = 10.0
+    # Session continuity (the server side is --serve.handoff_endpoint):
+    # with resume on, a remote-inference failure mid-episode no longer
+    # abandons the episode — the client reconnects (failing over if
+    # needed), presents its session (client_key + last chunk-boundary
+    # step), the new replica restores the boundary carry from the
+    # shared store, and the client REPLAYS its buffered partial-chunk
+    # observations to rebuild the mid-chunk carry bitwise (at most one
+    # chunk of recompute; replay outputs are discarded — the env
+    # already acted on the originals). Default off: failure semantics
+    # are byte-identical to PR-10 (abandon + ledger).
+    resume: bool = False
+    # Wall budget for one resume procedure (reconnect + restore +
+    # replay, retried across failovers). Past it the episode abandons —
+    # the PR-10 path. Keep it under fallback_after_s when both are
+    # armed, or the fallback decision starves behind resume retries.
+    resume_window_s: float = 20.0
+    # Endpoint placement at (re)connect time: "order" (PR-10 list-order
+    # rotation, the default) or "load" — probe every in-rotation
+    # endpoint's S_INFO load report (connected clients + tick occupancy
+    # from the actor_tick_rows_* histogram) and dial the least-loaded.
+    # Affinity is untouched: the pick happens only when a connection is
+    # (re)established, never mid-episode.
+    route: str = "order"
 
 
 @dataclass
@@ -625,6 +662,27 @@ class InferenceConfig:
     # "cpu" pins the service to host devices; "" = default backend
     # (a GPU/TPU inference pod serves large-batch forward passes).
     platform: str = "cpu"
+
+
+@dataclass
+class HandoffConfig:
+    """Carry-store binary (dotaclient_tpu/serve/handoff.py): the small
+    replicated session-continuity store the inference replicas stream
+    chunk-boundary carries to (--serve.handoff_endpoint) and read back
+    on failover. Pure stdlib + numpy — it never builds a policy or
+    touches jax, so it boots in milliseconds and can run as a tiny
+    sidecar-class pod (k8s/inference.yaml `carry-store`)."""
+
+    # TCP port the store listens on (0 = pick a free port, test use;
+    # the k8s Service pins 13390).
+    port: int = 13390
+    # Entries retained per session key. 2 is load-bearing, not a cache
+    # knob: the previous boundary must stay readable so a client whose
+    # chunk-fill ACK was lost in a kill (store written, reply dead) can
+    # still resume from the boundary it actually observed.
+    keep: int = 2
+    # /metrics + /healthz scrape surface (serve_handoff_store_* gauges).
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
 
 @dataclass

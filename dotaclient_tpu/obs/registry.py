@@ -128,6 +128,28 @@ SCALARS: Dict[str, str] = {
     "serve_version": "model version of the currently-serving param tree",
     "serve_clients_connected": "live client connections",
     "serve_carries_resident": "LSTM carries held server-side across all connections",
+    # --- session continuity, SERVER side (serve/server.py +
+    #     serve/handoff.py; zero with --serve.handoff_endpoint unset) --
+    "serve_handoff_store_writes_total": (
+        "chunk-boundary carries write-ahead-streamed to the shared "
+        "store BEFORE the chunk-fill reply (cumulative)"
+    ),
+    "serve_handoff_store_errors_total": (
+        "carry-store RPCs that failed (write or failover read); the "
+        "affected sessions degrade to PR-10 abandon-on-failover"
+    ),
+    "serve_handoff_resumes_total": (
+        "sessions restored from the store on failover (S_RESUME "
+        "answered OK; the client replays and the episode continues)"
+    ),
+    "serve_handoff_resume_misses_total": (
+        "resume handshakes refused (no store, store miss, or no entry "
+        "matching the client's boundary) — the client abandons"
+    ),
+    "serve_handoff_replayed_steps_total": (
+        "FLAG_REPLAY steps served — buffered partial-chunk observations "
+        "re-driven to rebuild a resumed session's mid-chunk carry"
+    ),
     # --- serve-tier resilience, CLIENT side (serve/client.py
     #     RemoteFleet.stats; scrape-only like actor_*) ------------------
     "serve_failover_endpoints": "configured inference endpoints in the failover list",
@@ -139,6 +161,22 @@ SCALARS: Dict[str, str] = {
         "loss, reply deadline, UNKNOWN_CLIENT (the serve chaos soak's "
         "explicit abandon ledger)"
     ),
+    # --- session continuity + routing tier, CLIENT side
+    #     (serve/client.py RemoteFleet.stats; scrape-only) -------------
+    "serve_handoff_client_resumes_total": (
+        "episodes RESUMED after a remote-inference failure instead of "
+        "abandoned (--serve.resume; the zero-abandon soak's ledger)"
+    ),
+    "serve_handoff_replay_steps_total": (
+        "replay steps sent while rebuilding resumed sessions (at most "
+        "one chunk per resume — the recompute bound)"
+    ),
+    "serve_route_load_mode": "1 when --serve.route load is active (0 = PR-10 list order)",
+    "serve_route_probes_total": (
+        "endpoint load probes issued at (re)connect time (S_INFO dials "
+        "across the in-rotation candidates)"
+    ),
+    "serve_route_picks_total": "connects whose endpoint order came from a load probe pass",
     "serve_fallback_engaged": "1 while the local-policy fallback is stepping episodes",
     "serve_fallback_engagements_total": (
         "distinct fallback engagements — counted per outage, not per "
@@ -217,6 +255,19 @@ PREFIXES: Dict[str, str] = {
     # broker_shed_throttle_s (runtime/actor.py ShedThrottle /
     # VectorActor.stats; transport/tcp.py watermarks are the source)
     "broker_shed_": "broker load-shed observability (admission refusals + actor throttle)",
+    # per-configured-endpoint health gauges (serve/client.py
+    # RemoteFleet.stats): serve_endpoint_up_<i> (1 = in rotation, 0 =
+    # sitting out a cooldown) and serve_endpoint_cooldown_s_<i>
+    # (remaining cooldown seconds), i = index into --serve.endpoint.
+    # PR 10 tracked health internally; these make WHICH replica a fleet
+    # marked down operator-visible. A family because the tail is the
+    # endpoint-list index.
+    "serve_endpoint_": "per-endpoint client-side health gauges (serve/client.py)",
+    # carry-store service gauges (serve/handoff.py CarryStoreServer
+    # /metrics): serve_handoff_store_sessions, _puts_total, _gets_total,
+    # _hits_total, _misses_total, _stale_total, _requests_total,
+    # _bad_requests_total — the store binary's own scrape surface.
+    "serve_handoff_store_": "carry-store service health (serve/handoff.py)",
     # seeded fault-injection meters (dotaclient_tpu/chaos/ ChaosBroker):
     # chaos_ops, chaos_corrupted, chaos_truncated, chaos_duplicated,
     # chaos_resets, chaos_sheds, chaos_stall_s, chaos_latency_s —

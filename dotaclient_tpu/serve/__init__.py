@@ -16,7 +16,13 @@ param residency moves to ONE process per fleet.
                    surface; `python -m dotaclient_tpu.serve.server`;
 - serve/client.py  RemotePolicyClient (multiplexing wire client),
                    RemoteActor / RemoteFleet (the actor loop with its
-                   `_policy_step` seam routed over the wire).
+                   `_policy_step` seam routed over the wire);
+- serve/handoff.py session-continuity carry store (CarryStore keep-two
+                   semantics, CarryStoreServer framed-TCP service,
+                   `python -m dotaclient_tpu.serve.handoff`): replicas
+                   write-ahead-stream chunk-boundary carries there so
+                   failover RESUMES episodes (--serve.resume) instead
+                   of abandoning them.
 
 Import contract (the chaos/ckpt precedent): actors with
 `--serve.endpoint` unset NEVER import this package — the local
@@ -26,7 +32,14 @@ inference hot path is byte-identical to the pre-serve build
 
 from __future__ import annotations
 
-__all__ = ["InferenceServer", "RemoteActor", "RemoteFleet", "RemotePolicyClient"]
+__all__ = [
+    "InferenceServer",
+    "RemoteActor",
+    "RemoteFleet",
+    "RemotePolicyClient",
+    "CarryStore",
+    "CarryStoreServer",
+]
 
 
 def __getattr__(name):
@@ -40,4 +53,8 @@ def __getattr__(name):
         from dotaclient_tpu.serve import client
 
         return getattr(client, name)
+    if name in ("CarryStore", "CarryStoreServer"):
+        from dotaclient_tpu.serve import handoff
+
+        return getattr(handoff, name)
     raise AttributeError(name)

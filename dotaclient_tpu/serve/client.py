@@ -70,7 +70,18 @@ class RemoteInferenceError(ConnectionError):
     """The inference service failed this step: transport failure,
     timeout, or a lost server-side carry (UNKNOWN_CLIENT). Retryable at
     episode granularity — the actor abandons the episode and starts a
-    fresh one, exactly the lost-env-session path."""
+    fresh one, exactly the lost-env-session path. With `--serve.resume`
+    armed, RemoteActor first tries to RESUME the episode on a healthy
+    replica (session-continuity handshake + partial-chunk replay,
+    serve/handoff.py); only a refused or budget-exhausted resume falls
+    back to this abandon semantics."""
+
+
+class SessionResumeRefused(RemoteInferenceError):
+    """The server answered a resume handshake with UNKNOWN_CLIENT: no
+    store, store miss, or no entry matching the client's boundary.
+    Authoritative — retrying cannot help (the entry will not appear), so
+    the episode abandons immediately, the PR-10 path."""
 
 
 def parse_endpoints(spec: str):
@@ -121,8 +132,19 @@ class RemotePolicyClient:
         connect_timeout_s: float = 5.0,
         cooldown_s: float = 5.0,
         retry: Optional[RetryPolicy] = None,
+        route: str = "order",
     ):
         self.endpoints = parse_endpoints(endpoint)
+        if route not in ("order", "load"):
+            raise ValueError(f"serve route must be order|load, got {route!r}")
+        # Endpoint placement at (re)connect: "order" = the PR-10 sticky
+        # list-order rotation; "load" = probe every in-rotation
+        # candidate's S_INFO load report and dial least-loaded first.
+        # Affinity is untouched either way — the pick happens only when
+        # a connection is being (re)established.
+        self._route = route
+        self.route_probes = 0
+        self.route_picks = 0
         self.lstm_hidden = int(policy_cfg.lstm_hidden)
         if wire_obs_dtype in ("f32", "float32"):
             self._obs_bf16 = False
@@ -243,6 +265,8 @@ class RemotePolicyClient:
                 raise RemoteInferenceError(
                     f"all {n} serve endpoints down (cooldown {self.cooldown_s}s)"
                 )
+            if self._route == "load" and len(candidates) > 1:
+                candidates = await self._probe_load_order(candidates)
             last_err: Optional[BaseException] = None
             for k, i in enumerate(candidates):
                 if k > 0:
@@ -312,6 +336,62 @@ class RemotePolicyClient:
                 f"connect failed on every healthy endpoint (last: {last_err})"
             )
 
+    async def _probe_load_order(self, candidates):
+        """Load-aware placement (--serve.route load): dial every
+        in-rotation candidate concurrently, read its S_INFO load report
+        (connected clients + tick occupancy from the actor_tick_rows_*
+        histogram + pending rows), close the probe sockets, and return
+        the candidates least-loaded-first (sticky-rotation position
+        tie-breaks, so equal-load behavior degrades to PR-10 order).
+        The winner pays one extra dial (probe + real connect) — a
+        (re)connect-time cost, never a per-step one. Probe failures
+        mark the endpoint down like any dial failure; if every probe
+        fails the original order is returned and the sequential dial
+        loop reports the outage through its usual path."""
+        import json
+
+        async def probe(i):
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(*self.endpoints[i]),
+                    self.connect_timeout_s,
+                )
+            except (OSError, asyncio.TimeoutError):
+                self._mark_down(i)
+                return None
+            try:
+                writer.write(W.frame(W.S_INFO, b""))
+                await writer.drain()
+                mtype, payload = await asyncio.wait_for(
+                    W.read_frame(reader), self.connect_timeout_s
+                )
+                info = json.loads(payload) if mtype == W.R_INFO else {}
+            except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError, ValueError):
+                self._mark_down(i)
+                return None
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            load = info.get("load") or {}
+            return (
+                float(load.get("clients", 0)),
+                float(load.get("occupancy", 0.0)),
+                float(load.get("pending", 0)),
+                i,
+            )
+
+        self.route_probes += len(candidates)
+        results = await asyncio.gather(*(probe(i) for i in candidates))
+        alive = [r for r in results if r is not None]
+        if not alive:
+            return candidates
+        pos = {i: k for k, i in enumerate(candidates)}
+        alive.sort(key=lambda r: (r[0], r[1], r[2], pos[r[3]]))
+        self.route_picks += 1
+        return [r[3] for r in alive]
+
     def _check_server_info(self, mtype: int, payload: bytes) -> None:
         import json
 
@@ -329,12 +409,16 @@ class RemotePolicyClient:
         try:
             while True:
                 mtype, payload = await W.read_frame(reader)
-                if mtype != W.R_STEP or len(payload) < 8:
+                # R_STEP and R_RESUME both lead with the u64 client_key
+                # demux key; at most one request per key is ever in
+                # flight (step OR resume), so one pending map serves
+                # both — the awaiting side checks the type it got.
+                if mtype not in (W.R_STEP, W.R_RESUME) or len(payload) < 8:
                     raise ValueError(f"unexpected server frame {mtype:#x}")
                 (key,) = struct.unpack_from("<Q", payload)
                 fut = self._pending.pop(key, None)
                 if fut is not None and not fut.done():
-                    fut.set_result(payload)
+                    fut.set_result((mtype, payload))
         except asyncio.CancelledError:
             pass
         except Exception as e:
@@ -400,6 +484,7 @@ class RemotePolicyClient:
         rng,
         episode_start: bool = False,
         want_carry: bool = False,
+        replay: bool = False,
     ) -> W.StepResponse:
         await self._ensure_connected()
         # Local snapshots: a SIBLING env's failure can run _teardown()
@@ -416,14 +501,14 @@ class RemotePolicyClient:
         fut = asyncio.get_running_loop().create_future()
         self._pending[client_key] = fut
         payload = W.encode_step_request(
-            client_key, obs, rng, episode_start, want_carry, self._obs_bf16
+            client_key, obs, rng, episode_start, want_carry, self._obs_bf16, replay
         )
         t0 = time.perf_counter()
         try:
             async with wlock:
                 writer.write(W.frame(W.S_STEP, payload))
                 await writer.drain()
-            resp_payload = await asyncio.wait_for(fut, self.timeout_s)
+            resp_mtype, resp_payload = await asyncio.wait_for(fut, self.timeout_s)
         except RemoteInferenceError:
             self.errors += 1
             raise
@@ -432,6 +517,12 @@ class RemotePolicyClient:
             self._pending.pop(client_key, None)
             await self._teardown()
             raise RemoteInferenceError(f"step failed: {e}") from e
+        if resp_mtype != W.R_STEP:
+            self.errors += 1
+            await self._teardown()
+            raise RemoteInferenceError(
+                f"server answered a step with frame {resp_mtype:#x}"
+            )
         self.latency_s.append(time.perf_counter() - t0)
         resp = W.decode_step_response(resp_payload, self.lstm_hidden)
         if resp.status == W.UNKNOWN_CLIENT:
@@ -446,6 +537,58 @@ class RemotePolicyClient:
             await self._teardown()
             raise RemoteInferenceError(f"server rejected step (status {resp.status})")
         self.steps += 1
+        return resp
+
+    async def resume(
+        self, client_key: int, boundary_step: int, carry_hash: int = 0
+    ) -> W.ResumeResponse:
+        """Session-continuity handshake (--serve.resume): ask the
+        currently-connected replica to restore this session's carry at
+        `boundary_step` from the shared store and make it resident.
+        `carry_hash` is serve/handoff.py carry_fingerprint of the
+        boundary carry the caller holds — the server refuses an entry
+        whose bytes differ (the cross-episode stale-entry guard).
+        Raises SessionResumeRefused when the server answers
+        UNKNOWN_CLIENT (authoritative — abandon), RemoteInferenceError
+        for transport failures (retryable: fail over and re-resume)."""
+        await self._ensure_connected()
+        wlock, writer = self._wlock, self._writer
+        if wlock is None or writer is None:
+            raise RemoteInferenceError("connection torn down")
+        if client_key in self._pending:
+            raise RuntimeError(f"concurrent requests for client_key {client_key}")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[client_key] = fut
+        try:
+            async with wlock:
+                writer.write(
+                    W.frame(
+                        W.S_RESUME,
+                        W.encode_resume_request(client_key, boundary_step, carry_hash),
+                    )
+                )
+                await writer.drain()
+            resp_mtype, resp_payload = await asyncio.wait_for(fut, self.timeout_s)
+        except RemoteInferenceError:
+            self.errors += 1
+            raise
+        except (OSError, asyncio.TimeoutError) as e:
+            self.errors += 1
+            self._pending.pop(client_key, None)
+            await self._teardown()
+            raise RemoteInferenceError(f"resume failed: {e}") from e
+        if resp_mtype != W.R_RESUME:
+            self.errors += 1
+            await self._teardown()
+            raise RemoteInferenceError(
+                f"server answered a resume with frame {resp_mtype:#x}"
+            )
+        resp = W.decode_resume_response(resp_payload)
+        if resp.status != W.OK:
+            raise SessionResumeRefused(
+                f"server cannot restore session {client_key} at boundary "
+                f"{boundary_step} (store miss/stale)"
+            )
         return resp
 
     async def close(self) -> None:
@@ -477,6 +620,7 @@ def _client_from_cfg(cfg: ActorConfig) -> RemotePolicyClient:
         connect_timeout_s=cfg.serve.connect_timeout_s,
         cooldown_s=cfg.serve.cooldown_s,
         retry=RetryPolicy.from_config(cfg.retry),
+        route=cfg.serve.route,
     )
 
 
@@ -576,6 +720,21 @@ class RemoteActor(Actor):
         # (zeros) and after every chunk-fill step (the server returns it
         # there); a stand-in mid-chunk, where nothing consumes it.
         self._episode_state = None
+        # Session continuity (--serve.resume, serve/handoff.py): the
+        # client-side half of the resume protocol. `_resume_boundary` =
+        # completed steps at the last OBSERVED chunk boundary (the
+        # write-ahead rule makes every observed boundary durably
+        # restorable); `_chunk_obs` buffers the completed steps' obs
+        # since that boundary — the replay set that rebuilds the
+        # mid-chunk carry bitwise on a fresh replica (carry updates are
+        # rng-independent, so replay outputs are discarded and the
+        # client's rng never double-advances). All inert when disarmed.
+        self._resume_armed = bool(getattr(cfg.serve, "resume", False))
+        self._resume_boundary = 0
+        self._resume_steps = 0
+        self._chunk_obs: list = []
+        self.episodes_resumed = 0
+        self.resume_replay_steps = 0
 
     def _decide_local_episode(self) -> bool:
         """Episode-start mode decision for --serve.fallback_local. Local
@@ -659,18 +818,33 @@ class RemoteActor(Actor):
             return await self._local_step(state, obs)
         if episode_start:
             self._episode_state = state  # the true zero carry, [1, H] pair
+            if self._resume_armed:
+                self._resume_boundary = 0
+                self._resume_steps = 0
+                self._chunk_obs = []
         want_carry = chunk_len + 1 >= self.cfg.rollout_len
         try:
             res = await self.remote_policy.step(
                 self.actor_id, obs, self.rng, episode_start=episode_start, want_carry=want_carry
             )
-        except RemoteInferenceError:
-            # This episode is now abandoned (the exception exits
-            # run_episode): ledger it explicitly — the serve chaos soak
-            # reconciles these against server lives, and silence here
-            # would make a kill's cost invisible.
-            self.episodes_abandoned += 1
-            raise
+        except RemoteInferenceError as e:
+            if not self._resume_armed:
+                # This episode is now abandoned (the exception exits
+                # run_episode): ledger it explicitly — the serve chaos
+                # soak reconciles these against server lives, and
+                # silence here would make a kill's cost invisible.
+                self.episodes_abandoned += 1
+                raise
+            res = await self._resume_and_retry(obs, episode_start, want_carry, e)
+        if self._resume_armed:
+            self._resume_steps += 1
+            if want_carry:
+                # The reply we just received vouches for this boundary
+                # — the server's write-ahead already made it durable.
+                self._resume_boundary = self._resume_steps
+                self._chunk_obs = []
+            else:
+                self._chunk_obs.append(obs)
         self.rng = res.rng
         if res.version != self._seen_version:
             # A version ADVANCE observed through serving is the weight
@@ -695,6 +869,97 @@ class RemoteActor(Actor):
         logp = np.asarray([res.logp], np.float32)
         value = np.asarray([res.value], np.float32)
         return self._episode_state, action, logp, value
+
+    async def _resume_and_retry(
+        self, obs, episode_start: bool, want_carry: bool, first_err: BaseException
+    ):
+        """The --serve.resume failure path: instead of abandoning the
+        episode, re-establish the session on a healthy replica and
+        retry the failed step, within `--serve.resume_window_s`.
+
+        One attempt = (1) reconnect — `step`/`resume` dial through
+        `_ensure_connected`, failing over under the routing policy; (2)
+        for a post-boundary episode, the S_RESUME handshake restores
+        the boundary carry from the shared store (exact-match only; a
+        refusal is authoritative → abandon); for a pre-first-boundary
+        episode the store is not needed — the boundary carry is the
+        EPISODE_START zeros, so the first replayed step carries that
+        flag; (3) replay the buffered partial-chunk obs (FLAG_REPLAY,
+        outputs discarded — the env already acted on the originals, and
+        the carry update is rng-independent, so the rebuilt mid-chunk
+        carry is bitwise the dead replica's); (4) re-issue the failed
+        step as a REAL step — its rng/carry/obs are exactly the
+        original attempt's, so the sampled action is bitwise what the
+        uninterrupted run would have produced. Transport failures
+        anywhere restart the attempt (another failover); the whole
+        procedure is idempotent — the store entry only moves at
+        boundaries the client has not observed yet."""
+        client = self.remote_policy
+        deadline = time.monotonic() + self.cfg.serve.resume_window_s
+        backoff = 0.05
+        err = first_err
+        while True:
+            if client._closed:
+                # Teardown, not an outage: the fleet is closing the
+                # client under us. Fail fast WITHOUT ledgering an
+                # abandon — the zero-abandon soak counts kill-caused
+                # abandons, and spinning the resume window here would
+                # also stall episode-stream teardown by up to the
+                # whole window.
+                raise err
+            try:
+                # Attempt FIRST: a healthy sibling endpoint is usually
+                # one dial away, and a pre-attempt sleep would tax every
+                # env of every failover (it shows up directly in the
+                # soak's restart-window p99). Backoff is paid only
+                # between FAILED attempts, below.
+                if self._resume_boundary > 0:
+                    # Lazy import: the handoff module stays un-imported
+                    # until a resume actually runs (the inertness rule).
+                    from dotaclient_tpu.serve.handoff import carry_fingerprint
+
+                    fp = carry_fingerprint(
+                        self._episode_state[0], self._episode_state[1]
+                    )
+                    await client.resume(self.actor_id, self._resume_boundary, fp)
+                for i, o in enumerate(self._chunk_obs):
+                    await client.step(
+                        self.actor_id,
+                        o,
+                        self.rng,
+                        episode_start=(self._resume_boundary == 0 and i == 0),
+                        replay=True,
+                    )
+                    self.resume_replay_steps += 1
+                res = await client.step(
+                    self.actor_id,
+                    obs,
+                    self.rng,
+                    episode_start=episode_start,
+                    want_carry=want_carry,
+                )
+            except SessionResumeRefused:
+                # Store miss/stale: the session is unrecoverable — the
+                # PR-10 abandon path still works underneath (tested).
+                self.episodes_abandoned += 1
+                raise
+            except RemoteInferenceError as e:
+                err = e
+                now = time.monotonic()
+                if now >= deadline:
+                    self.episodes_abandoned += 1
+                    raise err
+                await asyncio.sleep(min(backoff, max(0.0, deadline - now)))
+                backoff = min(backoff * 2.0, 1.0)
+                continue
+            self.episodes_resumed += 1
+            _log.info(
+                "actor %d: episode RESUMED at boundary %d (+%d replayed steps)",
+                self.actor_id,
+                self._resume_boundary,
+                len(self._chunk_obs),
+            )
+            return res
 
     def maybe_update_weights(self) -> bool:
         """No broker weight subscription for the SERVED tree — the
@@ -815,7 +1080,7 @@ class RemoteFleet:
         return sum(e.publish_throttle.failed for e in self.envs)
 
     def stats(self) -> dict:
-        shed = failed = abandoned = 0
+        shed = failed = abandoned = resumed = replayed = 0
         throttle_s = 0.0
         for e in self.envs:
             t = e.publish_throttle
@@ -823,9 +1088,11 @@ class RemoteFleet:
             failed += t.failed
             throttle_s += t.throttle_s
             abandoned += e.episodes_abandoned
+            resumed += e.episodes_resumed
+            replayed += e.resume_replay_steps
         c = self.client
         fb = self.fallback
-        return {
+        out = {
             "broker_shed_observed_total": float(shed),
             "broker_shed_publish_failed_total": float(failed),
             "broker_shed_throttle_s": throttle_s,
@@ -843,7 +1110,27 @@ class RemoteFleet:
             "serve_fallback_engagements_total": float(fb.engagements) if fb else 0.0,
             "serve_fallback_steps_total": float(fb.steps_total) if fb else 0.0,
             "serve_fallback_version": float(fb.version) if fb else 0.0,
+            # Session continuity, CLIENT side (serve_handoff_* family;
+            # zero with --serve.resume off): episodes resumed instead
+            # of abandoned, and the replay traffic that rebuilt them.
+            "serve_handoff_client_resumes_total": float(resumed),
+            "serve_handoff_replay_steps_total": float(replayed),
+            # Routing tier (serve_route_* family; probes/picks zero
+            # under the default list-order policy).
+            "serve_route_load_mode": 1.0 if c._route == "load" else 0.0,
+            "serve_route_probes_total": float(c.route_probes),
+            "serve_route_picks_total": float(c.route_picks),
         }
+        # Per-endpoint health gauges (serve_endpoint_* registry family):
+        # PR 10 tracked health internally but operators could not see
+        # WHICH replica a fleet has marked down — now /metrics shows,
+        # per configured endpoint index, whether it is in rotation and
+        # how long it still sits out.
+        now = time.monotonic()
+        for i, t in enumerate(c._down_until):
+            out[f"serve_endpoint_up_{i}"] = 0.0 if t > now else 1.0
+            out[f"serve_endpoint_cooldown_s_{i}"] = round(max(0.0, t - now), 3)
+        return out
 
     async def _env_loop(self, env: _RemoteEnvActor, results: "asyncio.Queue") -> None:
         backoff = 1.0

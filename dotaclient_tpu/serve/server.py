@@ -83,12 +83,16 @@ class _ServeBatcher(InferenceBatcher):
 
 class _ClientConn:
     """Per-connection server state: the resident carries this connection
-    owns and the write lock serializing interleaved responses."""
+    owns and the write lock serializing interleaved responses. `steps`
+    tracks each resident carry's episode position (completed steps;
+    reset by EPISODE_START, installed by a session resume) — the
+    episode_step the handoff store entries are stamped with."""
 
     def __init__(self, writer: asyncio.StreamWriter):
         self.writer = writer
         self.lock = asyncio.Lock()
         self.carries: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self.steps: Dict[int, int] = {}
 
     async def send(self, mtype: int, payload: bytes) -> None:
         try:
@@ -109,7 +113,7 @@ class InferenceServer:
     convention, so the service answers from step zero while the first
     weight broadcast is still compiling."""
 
-    def __init__(self, cfg: InferenceConfig, broker=None, obs_runtime=None):
+    def __init__(self, cfg: InferenceConfig, broker=None, obs_runtime=None, carry_store=None):
         if cfg.policy.arch != "lstm":
             raise ValueError(
                 f"inference service requires policy.arch='lstm' (server-side "
@@ -153,6 +157,31 @@ class InferenceServer:
         self.episode_resets_total = 0
         self.evictions_total = 0
         self.weight_swaps_total = 0
+        # Session continuity (serve/handoff.py): the shared carry store
+        # this replica write-ahead-streams chunk-boundary carries to.
+        # `carry_store` injects any object with the CarryStoreClient
+        # API (tests/soaks use LocalCarryStore); otherwise
+        # --serve.handoff_endpoint builds the TCP client — and when
+        # BOTH are unset the handoff module is never imported (the
+        # serve tier's own inertness rule).
+        if carry_store is None and cfg.serve.handoff_endpoint:
+            from dotaclient_tpu.serve.handoff import CarryStoreClient
+
+            host, sep, port = str(cfg.serve.handoff_endpoint).rpartition(":")
+            if not sep or not port.isdigit():
+                raise ValueError(
+                    f"--serve.handoff_endpoint must be host:port, got "
+                    f"{cfg.serve.handoff_endpoint!r}"
+                )
+            carry_store = CarryStoreClient(
+                host or "127.0.0.1", int(port), timeout_s=cfg.serve.handoff_timeout_s
+            )
+        self._store = carry_store
+        self.handoff_writes_total = 0
+        self.handoff_write_errors_total = 0
+        self.resumes_total = 0
+        self.resume_misses_total = 0
+        self.replayed_steps_total = 0
         self._conns: set = set()  # live _ClientConn, loop-thread mutated
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server = None
@@ -253,6 +282,8 @@ class InferenceServer:
             )
             return
         self.requests_total += 1
+        if req.replay:
+            self.replayed_steps_total += 1
         if req.episode_start:
             state = self._zero_state()
             self.episode_resets_total += 1
@@ -275,9 +306,31 @@ class InferenceServer:
         new_state, action, logp, value, rng2 = row
         new_state = jax.tree.map(np.asarray, new_state)
         conn.carries[req.client_key] = new_state
+        ep_step = 1 if req.episode_start else conn.steps.get(req.client_key, 0) + 1
+        conn.steps[req.client_key] = ep_step
         carry = None
         if req.want_carry:
             carry = (np.asarray(new_state[0][0]), np.asarray(new_state[1][0]))
+            if self._store is not None:
+                # WRITE-AHEAD: the store entry lands BEFORE the reply
+                # that vouches for this boundary — a kill can lose the
+                # ack, never the entry (schedcheck HandoffModel's
+                # handoff_after_ack mutant is this order inverted). A
+                # store failure degrades, it never stops serving: the
+                # session falls back to PR-10 abandon-on-failover.
+                try:
+                    await self._store.put(
+                        req.client_key, ep_step, version, carry[0], carry[1]
+                    )
+                    self.handoff_writes_total += 1
+                except Exception as e:
+                    self.handoff_write_errors_total += 1
+                    _log.warning(
+                        "serve: carry handoff write failed for client %d (%s); "
+                        "session degrades to abandon-on-failover",
+                        req.client_key,
+                        e,
+                    )
         await conn.send(
             W.R_STEP,
             W.encode_step_response(
@@ -298,6 +351,87 @@ class InferenceServer:
             ),
         )
 
+    async def _resume_request(self, conn: _ClientConn, payload: bytes) -> None:
+        """Session-continuity handshake: restore the client's boundary
+        carry from the shared store and make it resident, so the replay
+        steps that follow rebuild the mid-chunk carry bitwise. Spawned
+        as a task like S_STEP — a slow store read must not head-of-line
+        block the connection's OTHER envs' step frames (a fleet shares
+        one connection, and post-kill every env resumes at once);
+        per-key ordering is structural anyway: the client awaits the
+        resume reply before sending its replay steps. Any refusal (no
+        store, miss, stale, width or fingerprint mismatch) answers
+        UNKNOWN_CLIENT: the client abandons, exactly the PR-10 path."""
+        try:
+            req = W.decode_resume_request(payload)
+        except Exception as e:
+            self.bad_requests_total += 1
+            _log.warning("serve: bad resume request: %s", e)
+            import struct
+
+            key = struct.unpack_from("<Q", payload)[0] if len(payload) >= 8 else 0
+            await conn.send(
+                W.R_RESUME,
+                W.encode_resume_response(W.ResumeResponse(key, W.UNKNOWN_CLIENT)),
+            )
+            return
+        entry = None
+        if self._store is not None:
+            try:
+                _, entry = await self._store.get(req.client_key, req.boundary_step)
+            except Exception as e:
+                self.handoff_write_errors_total += 1
+                _log.warning("serve: carry handoff read failed: %s", e)
+        if entry is not None and entry.c.size != self.cfg.policy.lstm_hidden:
+            _log.warning(
+                "serve: store entry width %d != lstm_hidden %d — refusing resume "
+                "(mixed-policy store?)",
+                entry.c.size,
+                self.cfg.policy.lstm_hidden,
+            )
+            entry = None
+        if entry is not None:
+            from dotaclient_tpu.serve.handoff import carry_fingerprint
+
+            if carry_fingerprint(entry.c, entry.h) != req.carry_hash:
+                # Step-only matching is not enough: episode boundaries
+                # repeat the same step values across a client's
+                # episodes, so a FAILED boundary write (store outage)
+                # plus a previous episode's leftover entry could
+                # exact-match on step and silently serve a
+                # wrong-episode carry. The client holds the true
+                # boundary carry — refuse anything whose bytes differ.
+                _log.warning(
+                    "serve: store entry for client %d boundary %d fails the "
+                    "carry fingerprint — refusing resume (stale episode?)",
+                    req.client_key,
+                    req.boundary_step,
+                )
+                entry = None
+        if entry is None:
+            self.resume_misses_total += 1
+            await conn.send(
+                W.R_RESUME,
+                W.encode_resume_response(
+                    W.ResumeResponse(req.client_key, W.UNKNOWN_CLIENT)
+                ),
+            )
+            return
+        conn.carries[req.client_key] = (
+            np.ascontiguousarray(entry.c, np.float32)[None],
+            np.ascontiguousarray(entry.h, np.float32)[None],
+        )
+        conn.steps[req.client_key] = int(entry.episode_step)
+        self.resumes_total += 1
+        await conn.send(
+            W.R_RESUME,
+            W.encode_resume_response(
+                W.ResumeResponse(
+                    req.client_key, W.OK, int(entry.version), int(entry.episode_step)
+                )
+            ),
+        )
+
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn = _ClientConn(writer)
         self._conns.add(conn)
@@ -313,6 +447,10 @@ class InferenceServer:
                     t = asyncio.ensure_future(self._step_request(conn, payload))
                     tasks.add(t)
                     t.add_done_callback(tasks.discard)
+                elif mtype == W.S_RESUME:
+                    t = asyncio.ensure_future(self._resume_request(conn, payload))
+                    tasks.add(t)
+                    t.add_done_callback(tasks.discard)
                 elif mtype == W.S_STATS:
                     await conn.send(W.R_STATS, json.dumps(self.stats()).encode())
                 elif mtype == W.S_INFO:
@@ -326,6 +464,7 @@ class InferenceServer:
         finally:
             self.evictions_total += len(conn.carries)
             conn.carries.clear()
+            conn.steps.clear()
             self._conns.discard(conn)
             for t in tasks:
                 t.cancel()
@@ -357,6 +496,11 @@ class InferenceServer:
         await self._server.wait_closed()
         driver.cancel()
         await asyncio.gather(driver, return_exceptions=True)
+        if self._store is not None:
+            try:
+                await self._store.close()
+            except Exception:
+                pass
 
     def _warm(self) -> None:
         """Compile the tick signature before accepting traffic: a pad
@@ -445,9 +589,32 @@ class InferenceServer:
                 "serve_carries_resident": float(
                     sum(len(c.carries) for c in list(self._conns))
                 ),
+                # Session continuity (serve/handoff.py; all zero with
+                # --serve.handoff_endpoint unset).
+                "serve_handoff_store_writes_total": float(self.handoff_writes_total),
+                "serve_handoff_store_errors_total": float(self.handoff_write_errors_total),
+                "serve_handoff_resumes_total": float(self.resumes_total),
+                "serve_handoff_resume_misses_total": float(self.resume_misses_total),
+                "serve_handoff_replayed_steps_total": float(self.replayed_steps_total),
             }
         )
         return out
+
+    def load(self) -> dict:
+        """The routing tier's placement signal (S_INFO "load"): live
+        connection count plus mean tick occupancy derived from the
+        actor_tick_rows_<k> histogram. Read on the serve loop thread
+        (the info handler), same thread that writes the histogram."""
+        hist = list(self.batcher._tick_rows)
+        rows = sum(k * n for k, n in enumerate(hist))
+        ticks = sum(hist[1:])  # k=0 never fires — a tick starts from a request
+        occ = (rows / ticks / self.batcher.capacity) if ticks else 0.0
+        return {
+            "clients": len(list(self._conns)),
+            "occupancy": round(occ, 4),
+            "pending": self.batcher._queue.qsize(),
+            "capacity": self.batcher.capacity,
+        }
 
     def info(self) -> dict:
         """The S_INFO handshake body: what a client must agree with."""
@@ -458,6 +625,7 @@ class InferenceServer:
             "max_batch": self.cfg.serve.max_batch,
             "gather_window_s": self.cfg.serve.gather_window_s,
             "version": self._bundle[1],
+            "load": self.load(),
         }
 
     def _health(self) -> dict:

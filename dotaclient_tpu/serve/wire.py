@@ -6,6 +6,7 @@ Framing is the tcp broker's (transport/tcp.py): every message is
   0x01 S_STEP   one policy-step request            → 0x81 R_STEP
   0x02 S_STATS  no payload                         → 0x82 R_STATS (JSON)
   0x03 S_INFO   no payload                         → 0x83 R_INFO  (JSON)
+  0x04 S_RESUME session-continuity handshake       → 0x84 R_RESUME
 
 S_STEP payload:
   u64    client_key  — names this client's server-resident LSTM carry.
@@ -17,7 +18,19 @@ S_STEP payload:
                        reset the vector fleet does locally);
                        bit1 WANT_CARRY: return the post-step (c, h) —
                        clients set it on chunk-fill steps, where the
-                       carry becomes the next chunk's wire initial_state.
+                       carry becomes the next chunk's wire initial_state
+                       (and, with --serve.handoff_endpoint armed, where
+                       the server write-ahead-streams the carry to the
+                       shared store BEFORE this reply);
+                       bit2 REPLAY: this step re-drives a buffered
+                       observation after a resume, purely to advance the
+                       server-resident carry — the client discards the
+                       outputs (the env already acted on the original
+                       sample, and the carry update is rng-independent).
+                       Sent only by --serve.resume clients rebuilding a
+                       partial chunk; servers meter it
+                       (serve_handoff_replayed_steps_total) and
+                       otherwise step normally.
   u8     obs_code    — float-leaf wire dtype of the obs block: 0 = f32
                        (exact), 3 = bf16 (the PR-8 DTR3 code; halves
                        request bandwidth, server upcasts exactly).
@@ -51,6 +64,38 @@ R_STEP payload:
   u8     has_carry
   f32[H] c, f32[H] h — present iff has_carry (H = lstm_hidden).
 
+S_RESUME payload (session continuity, --serve.resume clients only):
+  u64    client_key     — the session token (fleet-unique by the
+                          actor_id scheme; the store is keyed by it).
+  u32    boundary_step  — completed steps at the client's last OBSERVED
+                          chunk boundary. The server restores the store
+                          entry whose episode_step matches EXACTLY
+                          (current or previous entry — the previous one
+                          covers a chunk-fill ACK lost in a kill after
+                          the write-ahead landed); anything else is
+                          refused, never silently served stale.
+  u64    carry_hash     — serve/handoff.py carry_fingerprint of the
+                          boundary carry the CLIENT holds (the
+                          chunk-fill reply delivered it). The server
+                          refuses an entry whose stored bytes do not
+                          fingerprint-match: episode boundaries repeat
+                          the same step values across episodes, so
+                          after a FAILED boundary write (store outage,
+                          the degrade path) a previous episode's
+                          leftover entry could step-match — the hash
+                          turns that silent divergence into the abandon
+                          refusal.
+
+R_RESUME payload:
+  u64    client_key  — echo (demultiplex key, like R_STEP).
+  u8     status      — 0 OK (carry restored and resident; replay away);
+                       1 UNKNOWN_CLIENT (no store, store miss, or no
+                       entry matching boundary_step — abandon the
+                       episode, the PR-10 semantics).
+  u32    version     — model version stamped into the restored entry
+                       (0 unless OK).
+  u32    episode_step — the restored boundary (0 unless OK).
+
 Compat note: this protocol is NEW in this build — there are no old
 peers to stay compatible with. The rolling-upgrade order is therefore
 purely operational (MIGRATION.md): deploy servers first, then actors
@@ -77,11 +122,12 @@ from dotaclient_tpu.transport.serialize import (
 _LEN = struct.Struct("<I")
 _TYPE = struct.Struct("<B")
 
-S_STEP, S_STATS, S_INFO = 0x01, 0x02, 0x03
-R_STEP, R_STATS, R_INFO = 0x81, 0x82, 0x83
+S_STEP, S_STATS, S_INFO, S_RESUME = 0x01, 0x02, 0x03, 0x04
+R_STEP, R_STATS, R_INFO, R_RESUME = 0x81, 0x82, 0x83, 0x84
 
 FLAG_EPISODE_START = 1
 FLAG_WANT_CARRY = 2
+FLAG_REPLAY = 4
 
 OK, UNKNOWN_CLIENT, BAD_REQUEST = 0, 1, 2
 
@@ -101,6 +147,20 @@ class StepRequest(NamedTuple):
     obs_bf16: bool
     rng: np.ndarray  # u32 [2]
     obs: F.Observation
+    replay: bool = False
+
+
+class ResumeRequest(NamedTuple):
+    client_key: int
+    boundary_step: int
+    carry_hash: int = 0
+
+
+class ResumeResponse(NamedTuple):
+    client_key: int
+    status: int
+    version: int = 0
+    episode_step: int = 0
 
 
 class StepResponse(NamedTuple):
@@ -126,9 +186,12 @@ def encode_step_request(
     episode_start: bool = False,
     want_carry: bool = False,
     obs_bf16: bool = False,
+    replay: bool = False,
 ) -> bytes:
-    flags = (FLAG_EPISODE_START if episode_start else 0) | (
-        FLAG_WANT_CARRY if want_carry else 0
+    flags = (
+        (FLAG_EPISODE_START if episode_start else 0)
+        | (FLAG_WANT_CARRY if want_carry else 0)
+        | (FLAG_REPLAY if replay else 0)
     )
     code = OBS_BF16 if obs_bf16 else OBS_F32
     rng_b = np.ascontiguousarray(np.asarray(rng), np.uint32).tobytes()
@@ -153,6 +216,35 @@ def decode_step_request(payload: bytes) -> StepRequest:
         obs_bf16=obs_bf16,
         rng=np.frombuffer(rng_b, np.uint32),
         obs=obs,
+        replay=bool(flags & FLAG_REPLAY),
+    )
+
+
+_RESUME_REQ = struct.Struct("<QIQ")
+_RESUME_RESP = struct.Struct("<QBII")
+
+
+def encode_resume_request(client_key: int, boundary_step: int, carry_hash: int = 0) -> bytes:
+    return _RESUME_REQ.pack(client_key, boundary_step, carry_hash)
+
+
+def decode_resume_request(payload: bytes) -> ResumeRequest:
+    if len(payload) != _RESUME_REQ.size:
+        raise ValueError(f"resume request size {len(payload)} != {_RESUME_REQ.size}")
+    key, boundary, carry_hash = _RESUME_REQ.unpack(payload)
+    return ResumeRequest(client_key=key, boundary_step=boundary, carry_hash=carry_hash)
+
+
+def encode_resume_response(r: ResumeResponse) -> bytes:
+    return _RESUME_RESP.pack(r.client_key, r.status, r.version, r.episode_step)
+
+
+def decode_resume_response(payload: bytes) -> ResumeResponse:
+    if len(payload) != _RESUME_RESP.size:
+        raise ValueError(f"resume response size {len(payload)} != {_RESUME_RESP.size}")
+    key, status, version, episode_step = _RESUME_RESP.unpack(payload)
+    return ResumeResponse(
+        client_key=key, status=status, version=version, episode_step=episode_step
     )
 
 

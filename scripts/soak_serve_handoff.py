@@ -1,0 +1,654 @@
+"""Serve session-continuity soak: the zero-abandon rolling-restart
+proof → SERVE_HANDOFF_SOAK.json.
+
+SERVE_CHAOS_SOAK.json phase 2 (PR 10) proved ms-scale failover — but
+100% of in-flight episodes were abandoned, because the true mid-episode
+LSTM carry lived only on the dead replica. This soak proves the PR-13
+session-continuity story: with the carry store armed
+(`--serve.handoff_endpoint` server-side, `--serve.resume` client-side),
+a rolling restart across TWO replicas (`rolling@T:P@server`,
+chaos/schedule.py) is an episode NON-EVENT. Two phases:
+
+1. PARITY + ZERO ABANDON — two arms of M RemoteActors sharing one
+   multiplexed client (deterministic local fake envs, version-0
+   serving): arm A runs one undisturbed replica; arm B runs TWO
+   replicas + a shared real-TCP CarryStoreServer while a ScheduleRunner
+   executes rolling restarts that kill EACH replica. The bar is strict
+   FULL-STREAM equality: every frame every env publishes in arm B is
+   bitwise identical to arm A's — not a prefix up to the first abandon
+   (the PR-10 bar), because there ARE no abandons: every interrupted
+   episode resumes from its last chunk boundary (store restore + replay
+   ≤ one chunk) and the re-issued step samples bitwise what the
+   uninterrupted arm sampled (same rng/carry/obs). p99 policy-step
+   latency inside the kill→restart(+1s) windows must stay under an
+   absolute budget, disclosed against the undisturbed arm's p99
+   (bench-host variance is real; the budget is deliberately coarse and
+   the raw numbers ride the artifact).
+
+2. CONSERVATION — a live tcp learner (experience in, weight fanout
+   out), two hot-swapping replicas + store, a RemoteFleet with resume
+   armed, and a rolling restart mid-stream: zero abandoned episodes,
+   client resumes >= kills that interrupted steps, and the exact
+   frame-conservation ledger of the PR-6/7 methodology — producer
+   attempted = acked + shed + failed, broker enqueued = popped +
+   dropped_oldest + resident, popped - reply_lost - consumed == 0
+   (ZERO unaccounted frames).
+
+Run: python scripts/soak_serve_handoff.py                        # committed artifact
+     python scripts/soak_serve_handoff.py --quick --out /tmp/x   # nightly wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import struct
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SENTINEL_WARM_ID = 999_999
+
+
+def _tiny_policy():
+    from dotaclient_tpu.config import PolicyConfig
+
+    return PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+def _make_serve_inc(policy, seed, max_batch, store_port, weights_port=None):
+    """ServeIncarnations whose lives stream carries to the shared store
+    (and poll the weight fanout when weights_port is given)."""
+    from dotaclient_tpu.chaos import ServeIncarnations
+    from dotaclient_tpu.config import InferenceConfig, ServeConfig
+    from dotaclient_tpu.serve.server import InferenceServer
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.tcp import TcpBroker
+
+    def make_server(port):
+        cfg = InferenceConfig(
+            serve=ServeConfig(
+                port=port,
+                max_batch=max_batch,
+                gather_window_s=0.002,
+                weight_poll_s=0.05,
+                handoff_endpoint=f"127.0.0.1:{store_port}",
+                handoff_timeout_s=2.0,
+            ),
+            policy=policy,
+            seed=seed,
+        )
+        broker = (
+            TcpBroker(port=weights_port, retry=RetryPolicy(window_s=5.0))
+            if weights_port
+            else None
+        )
+        return InferenceServer(cfg, broker=broker).start()
+
+    return ServeIncarnations(make_server, port=0)
+
+
+def _acfg(policy, endpoint, env_addr="local", seed=100):
+    from dotaclient_tpu.config import ActorConfig, RetryConfig, ServeClientConfig
+
+    return ActorConfig(
+        env_addr=env_addr,
+        rollout_len=4,  # short chunks: every episode crosses >= 2 boundaries
+        max_dota_time=12.0,
+        policy=policy,
+        seed=seed,
+        max_weight_age_s=0.0,  # kills legitimately pause version advance
+        serve=ServeClientConfig(
+            endpoint=endpoint,
+            timeout_s=6.0,
+            connect_timeout_s=1.5,
+            cooldown_s=0.3,
+            resume=True,
+            resume_window_s=15.0,
+            route="load",
+        ),
+        retry=RetryConfig(window_s=5.0, backoff_base_s=0.05, backoff_cap_s=0.5),
+    )
+
+
+class _PacedStub:
+    """Env stub wrapper adding a fixed wall delay per observe() — it
+    stretches episodes over wall time so the rolling restarts land
+    MID-EPISODE (the interesting case) on any host speed. Pure pacing:
+    the observation DATA is untouched and both arms pace identically,
+    so the bitwise comparison is unaffected."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self._delay = delay_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def observe(self, req):
+        await asyncio.sleep(self._delay)
+        return await self._inner.observe(req)
+
+
+class _ReplicaRouter:
+    """kill()/restart() router over N ServeIncarnations: the rolling
+    executor calls kill/restart replica_count() times and this fans the
+    sequential pairs across replicas round-robin — replica i down for
+    its window while every sibling serves."""
+
+    def __init__(self, incs):
+        self.incs = incs
+        self._next = 0
+        self._pending = []
+
+    def replica_count(self) -> int:
+        return len(self.incs)
+
+    def kill(self):
+        i = self._next % len(self.incs)
+        self._next += 1
+        self._pending.append(i)
+        return self.incs[i].kill()
+
+    def restart(self):
+        self.incs[self._pending[-1]].restart()
+
+    def wait_first_request(self, timeout=30.0, stop=None):
+        return self.incs[self._pending[-1]].wait_first_request(timeout, stop)
+
+    def kill_times(self):
+        return sorted(t for inc in self.incs for t in inc.kill_times)
+
+    def restart_times(self):
+        return sorted(t for inc in self.incs for t in inc.restart_times)
+
+
+# --------------------------------------------------------------- phase 1
+
+
+def _run_parity_arm(policy, envs, episodes_per_env, rolling_spec, seed, mem_name, deadline_s, replicas):
+    """One parity arm: M RemoteActors sharing one multiplexed client,
+    `replicas` serve incarnations sharing one fresh real-TCP carry
+    store; optional rolling-restart schedule. Returns frames, ledgers,
+    and the latency/kill timelines the p99-window verdict needs."""
+    from dotaclient_tpu.chaos import FaultSchedule, ScheduleRunner
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.env.service import LocalDotaServiceStub
+    from dotaclient_tpu.serve.client import RemoteActor, RemoteInferenceError, _client_from_cfg
+    from dotaclient_tpu.serve.handoff import CarryStoreServer
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+    from dotaclient_tpu.transport.serialize import deserialize_rollout
+
+    store_srv = CarryStoreServer(port=0).start()
+    incs = [_make_serve_inc(policy, 1, envs, store_srv.port) for _ in range(replicas)]
+    router = _ReplicaRouter(incs)
+    mem.reset(mem_name)
+    broker = connect(f"mem://{mem_name}")
+    endpoint = ",".join(f"127.0.0.1:{inc.port}" for inc in incs)
+    cfg = _acfg(policy, endpoint, seed=seed)
+    client = _client_from_cfg(cfg)
+    actors = [
+        RemoteActor(
+            cfg,
+            broker,
+            actor_id=j,
+            stub=_PacedStub(LocalDotaServiceStub(FakeDotaService()), 0.04),
+            client=client,
+        )
+        for j in range(envs)
+    ]
+    deadline = time.monotonic() + deadline_s
+    runner_box = {}
+
+    # Latency timeline sampler: (monotonic t, samples recorded so far) —
+    # sliced post-hoc into the kill→restart windows for the p99 gate.
+    lat_timeline = []
+    stop_sampler = threading.Event()
+
+    def sampler():
+        while not stop_sampler.is_set():
+            lat_timeline.append((time.monotonic(), len(client.latency_s)))
+            time.sleep(0.02)
+
+    st = threading.Thread(target=sampler, daemon=True)
+    st.start()
+
+    async def drive():
+        async def one(env):
+            while env.episodes_done < episodes_per_env and time.monotonic() < deadline:
+                try:
+                    await env.run_episode()
+                    # Small inter-episode gap; the real pacing is the
+                    # per-step _PacedStub delay, which keeps the fleet
+                    # IN-EPISODE almost all the time so kills interrupt
+                    # live sessions rather than idle gaps.
+                    await asyncio.sleep(0.05)
+                except RemoteInferenceError:
+                    # With resume armed this is the last-resort abandon
+                    # path (already ledgered by the actor) — it firing
+                    # at all flips the zero-abandon verdict red.
+                    await asyncio.sleep(0.05)
+
+        async def arm_runner():
+            # Progress-gated epoch: the schedule's t0 starts when ~10%
+            # of the expected steps have flowed, so the roll hits a
+            # mid-stream fleet on ANY host speed (a wall-clock t0 raced
+            # fast hosts to the finish line).
+            if not rolling_spec:
+                return
+            expected = envs * episodes_per_env * 12  # 12 steps/episode
+            while sum(a.steps_done for a in actors) < expected * 0.1:
+                if time.monotonic() > deadline:
+                    return
+                await asyncio.sleep(0.02)
+            schedule = FaultSchedule.parse(rolling_spec, seed=0)
+            runner_box["r"] = ScheduleRunner(
+                schedule, broker=None, t0=time.monotonic(), server=router
+            ).start()
+
+        try:
+            await asyncio.gather(*(one(a) for a in actors), arm_runner())
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(drive())
+    runner = runner_box.get("r")
+    if runner is not None:
+        runner.stop()
+    stop_sampler.set()
+    st.join(timeout=5)
+    ledgers = [inc.final_ledger() for inc in incs]
+    lives = [l for inc in incs for l in inc.ledgers]
+    frames = {}
+    for f in broker.consume_experience(1_000_000, timeout=0.2):
+        frames.setdefault(deserialize_rollout(f).actor_id, []).append(f)
+    store_stats = store_srv.stats()
+    store_srv.stop()
+    lat = list(client.latency_s)
+    return {
+        "frames": frames,
+        "episodes_done": {a.actor_id: a.episodes_done for a in actors},
+        "abandons": sum(a.episodes_abandoned for a in actors),
+        "resumed": sum(a.episodes_resumed for a in actors),
+        "replay_steps": sum(a.resume_replay_steps for a in actors),
+        "inflight_step_failures": client.errors,
+        "reconnects": client.reconnects,
+        "failovers": client.failovers,
+        "route_probes": client.route_probes,
+        "serve_lives": lives,
+        "serve_totals": {
+            k: sum(l[k] for l in ledgers)
+            for k in ("requests", "resumes", "resume_misses", "handoff_writes",
+                      "handoff_write_errors", "replayed_steps", "unknown_client")
+        },
+        "store": store_stats,
+        "recovery": None if runner is None else runner.recovery,
+        "kill_times": router.kill_times(),
+        "restart_times": router.restart_times(),
+        "latency_s": lat,
+        "lat_timeline": lat_timeline,
+        "finished_all": all(a.episodes_done >= episodes_per_env for a in actors),
+    }
+
+
+def _p99(samples):
+    if not samples:
+        return None
+    s = sorted(samples)
+    return round(s[min(len(s) - 1, int(0.99 * len(s)))] * 1e3, 3)
+
+
+def _window_latencies(arm):
+    """Latency samples recorded inside [kill, restart+1s] windows,
+    via the (t, n_samples) timeline."""
+    timeline = arm["lat_timeline"]
+    lat = arm["latency_s"]
+
+    def count_at(t):
+        n = 0
+        for ts, c in timeline:
+            if ts > t:
+                break
+            n = c
+        return n
+
+    out = []
+    for kt, rt in zip(arm["kill_times"], arm["restart_times"]):
+        a, b = count_at(kt), count_at(rt + 1.0)
+        out.extend(lat[a:b])
+    return out
+
+
+# ------------------------------------------------------------------ main
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="SERVE_HANDOFF_SOAK.json")
+    p.add_argument("--envs", type=int, default=4)
+    p.add_argument("--parity-episodes", type=int, default=20)
+    # Three rolling events at period-incommensurate offsets: episode
+    # wall period is ~1s, so sweeping the start phase makes kills land
+    # across chunk positions (first-chunk, mid-chunk-2, chunk-fill) —
+    # the store-backed and zeros-backed resume paths both get hit.
+    p.add_argument("--parity-rolling", default="rolling@0.1:0.6@server,rolling@4.3:0.6@server,rolling@8.77:0.6@server")
+    p.add_argument("--p99-budget-ms", type=float, default=2000.0)
+    p.add_argument("--conserve-s", type=float, default=22.0)
+    p.add_argument("--conserve-rolling", default="rolling@4:0.8@server")
+    p.add_argument("--quick", action="store_true",
+                   help="nightly-wrapper scale: fewer episodes, one rolling event, same invariants")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.parity_episodes = 8
+        args.parity_rolling = "rolling@0.1:0.6@server"
+        args.conserve_s = 16.0
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench as bench_mod
+    from dotaclient_tpu.chaos import FaultSchedule, ScheduleRunner
+    from dotaclient_tpu.config import LearnerConfig, ObsConfig, PPOConfig, WatchdogConfig
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.env.service import serve as env_serve
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+    from dotaclient_tpu.runtime.learner import Learner
+    from dotaclient_tpu.serve.client import RemoteFleet
+    from dotaclient_tpu.serve.handoff import CarryStoreServer
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.tcp import BrokerServer, TcpBroker
+
+    policy = _tiny_policy()
+    artifact = {
+        "host": (
+            "single host, in-process serve replicas, real-TCP carry store, "
+            "real tcp experience/weights broker, CPU learner (tiny policy)"
+        ),
+        "host_preflight": preflight_check("soak_serve_handoff"),
+        "envs": args.envs,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "baseline_comparison": (
+            "SERVE_CHAOS_SOAK.json phase 2 (PR 10) is the before: ms-scale "
+            "failover but 100% of in-flight episodes abandoned per kill; "
+            "this soak's bar is ZERO abandons and full-stream bitwise parity"
+        ),
+    }
+
+    # ------------- phase 1: parity + zero abandon under rolling restart
+    base = _run_parity_arm(
+        policy, args.envs, args.parity_episodes, None, 100, "svhand_base", 240.0, replicas=1
+    )
+    chaos = _run_parity_arm(
+        policy, args.envs, args.parity_episodes, args.parity_rolling, 100, "svhand_roll",
+        360.0, replicas=2,
+    )
+    per_env = []
+    parity_ok = True
+    matched = 0
+    for aid in range(args.envs):
+        a = base["frames"].get(aid, [])
+        b = chaos["frames"].get(aid, [])
+        env_ok = len(a) == len(b) and a == b
+        parity_ok = parity_ok and env_ok
+        matched += min(len(a), len(b)) if env_ok else 0
+        per_env.append(
+            {
+                "actor_id": aid,
+                "baseline_frames": len(a),
+                "rolling_frames": len(b),
+                "full_stream_bitwise": env_ok,
+            }
+        )
+    win_lat = _window_latencies(chaos)
+    p99_window = _p99(win_lat)
+    artifact["phase_1_parity"] = {
+        "episodes_per_env": args.parity_episodes,
+        "rolling_spec": args.parity_rolling,
+        "rolling_recovery": chaos["recovery"],
+        "kills_executed": len(chaos["kill_times"]),
+        "per_env": per_env,
+        "matched_frames_bitwise": matched,
+        "episodes_abandoned": chaos["abandons"],
+        "episodes_resumed": chaos["resumed"],
+        "replay_steps": chaos["replay_steps"],
+        "inflight_step_failures": chaos["inflight_step_failures"],
+        "failovers": chaos["failovers"],
+        "route_probes": chaos["route_probes"],
+        "serve_totals": chaos["serve_totals"],
+        "store": chaos["store"],
+        "baseline_abandons": base["abandons"],
+        "both_arms_finished": base["finished_all"] and chaos["finished_all"],
+        "latency": {
+            "budget_ms": args.p99_budget_ms,
+            "p99_ms_during_restart_windows": p99_window,
+            "window_samples": len(win_lat),
+            "p99_ms_rolling_arm_overall": _p99(chaos["latency_s"]),
+            "p99_ms_baseline_arm": _p99(base["latency_s"]),
+            "disclosure": (
+                "2-core bench host; absolute budget chosen coarse on purpose "
+                "(reply timeout is 6000 ms) and both arms' raw p99 disclosed — "
+                "the claim is 'bounded, no global stall', not a latency bench"
+            ),
+        },
+    }
+    print(json.dumps({k: v for k, v in artifact["phase_1_parity"].items() if k != "per_env"}), flush=True)
+
+    # ---------------- phase 2: conservation with a live learner ----------
+    exp_broker_server = BrokerServer(port=0, maxlen=8192).start()
+    bport = exp_broker_server.port
+    env_server, env_port = env_serve(FakeDotaService())
+    env_addr = f"127.0.0.1:{env_port}"
+    lcfg = LearnerConfig(
+        batch_size=8,
+        seq_len=4,
+        policy=policy,
+        publish_every=1,
+        metrics_every=5,
+        # Wide window: the tiny-policy learner advances versions far
+        # faster than any real cadence (the chaos_soak precedent).
+        ppo=PPOConfig(max_staleness=4096),
+        obs=ObsConfig(
+            enabled=True,
+            install_handlers=False,
+            step_phases=False,
+            watchdog=WatchdogConfig(enabled=True, interval_s=2.0, stall_s=30.0),
+        ),
+    )
+    producers = {}
+    learner_crashed = None
+    fleet_errors = []
+    try:
+        learner = Learner(lcfg, TcpBroker(port=bport, retry=RetryPolicy()))
+        frames = bench_mod._make_frames(lcfg, 32)
+        warm_pub = TcpBroker(port=bport)
+        n_warm = lcfg.batch_size + 4
+        for i in range(n_warm):
+            fr = bytearray(frames[i % len(frames)])
+            struct.pack_into("<I", fr, 13, SENTINEL_WARM_ID)
+            warm_pub.publish_experience(bytes(fr))
+        producers["warmup"] = {"attempted": n_warm, "acked": n_warm, "shed": 0, "failed": 0}
+        learner.run(num_steps=1, batch_timeout=120.0)
+        warm_pub.close()
+        print("learner warm", flush=True)
+
+        store_srv = CarryStoreServer(port=0).start()
+        inc_a = _make_serve_inc(policy, 0, args.envs, store_srv.port, weights_port=bport)
+        inc_b = _make_serve_inc(policy, 0, args.envs, store_srv.port, weights_port=bport)
+        router = _ReplicaRouter([inc_a, inc_b])
+        cfg2 = _acfg(
+            policy, f"127.0.0.1:{inc_a.port},127.0.0.1:{inc_b.port}",
+            env_addr=env_addr, seed=200,
+        )
+        fleet = RemoteFleet(
+            cfg2, TcpBroker(port=bport, retry=RetryPolicy(window_s=8.0)), actor_id=0, envs=args.envs
+        )
+        stop_ev = threading.Event()
+
+        def fleet_main():
+            async def go():
+                agen = fleet.episode_stream()
+                try:
+                    async for _ in agen:
+                        if stop_ev.is_set():
+                            return
+                except Exception as e:  # surfaced fleet death = red verdict
+                    fleet_errors.append(f"{type(e).__name__}: {e}")
+                finally:
+                    await agen.aclose()
+
+            asyncio.run(go())
+
+        ft = threading.Thread(target=fleet_main, daemon=True)
+        t0 = time.monotonic()
+        ft.start()
+        runner = ScheduleRunner(
+            FaultSchedule.parse(args.conserve_rolling, seed=0), broker=None, t0=t0, server=router
+        ).start()
+        learner.run(max_seconds=args.conserve_s, batch_timeout=2.0)
+        runner.stop()
+        stop_ev.set()
+        ft.join(timeout=60)
+        if ft.is_alive():
+            fleet_errors.append("fleet thread failed to join (teardown wedge)")
+        fleet.broker.close()
+        stats2 = fleet.stats()
+        ledger2 = {
+            "attempted": fleet.rollouts_published + fleet.rollouts_shed + fleet.rollouts_failed,
+            "acked": fleet.rollouts_published,
+            "shed": fleet.rollouts_shed,
+            "failed": fleet.rollouts_failed,
+        }
+        producers["handoff_fleet"] = ledger2
+        serve2 = {"a": inc_a.final_ledger(), "b": inc_b.final_ledger()}
+        store2 = store_srv.stats()
+        store_srv.stop()
+        artifact["phase_2_conservation"] = {
+            "duration_s": args.conserve_s,
+            "rolling_spec": args.conserve_rolling,
+            "rolling_recovery": runner.recovery,
+            "kills_executed": len(router.kill_times()),
+            "episodes_abandoned": stats2["serve_failover_episodes_abandoned_total"],
+            "episodes_resumed": stats2["serve_handoff_client_resumes_total"],
+            "replay_steps": stats2["serve_handoff_replay_steps_total"],
+            "failovers": stats2["serve_failover_total"],
+            "route_mode_load": stats2["serve_route_load_mode"],
+            "route_probes": stats2["serve_route_probes_total"],
+            "publish": ledger2,
+            "serve": serve2,
+            "store": store2,
+        }
+        print(json.dumps(artifact["phase_2_conservation"]), flush=True)
+
+        # final drain so late publishes get consumed before the ledger
+        learner.run(max_seconds=3.0, batch_timeout=0.5)
+        watchdog = learner.obs.watchdog.verdict() if learner.obs and learner.obs.watchdog else {}
+        learner.staging.stop()
+        staging_stats = learner.staging.stats()
+        learner.close()
+        learner_crashed = False
+    except Exception as e:
+        learner_crashed = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        exp_broker_server.stop()
+        env_server.stop(0)
+
+    # ---------------- conservation ledger --------------------------------
+    broker_led = exp_broker_server.ledger()
+    producer_totals = {
+        k: sum(int(pr.get(k, 0)) for pr in producers.values())
+        for k in ("attempted", "acked", "shed", "failed")
+    }
+    producer_ledgers_ok = all(
+        int(pr["attempted"]) == int(pr["acked"]) + int(pr["shed"]) + int(pr["failed"])
+        for pr in producers.values()
+    )
+    unaccounted = (
+        broker_led["popped"] - broker_led["reply_lost"] - staging_stats["consumed"]
+    )
+    conservation = {
+        "producers": producers,
+        "producer_totals": producer_totals,
+        "broker": broker_led,
+        "staging": {
+            k: int(staging_stats[k])
+            for k in ("consumed", "dropped_stale", "dropped_bad", "quarantined", "rows_packed")
+        },
+        "staging_pending_leftover": int(staging_stats["pending_rollouts"]),
+        "broker_identity_holds": broker_led["enqueued"]
+        == broker_led["popped"] + broker_led["dropped_oldest"] + broker_led["resident"],
+        "producer_ledgers_balance": producer_ledgers_ok,
+        "died_with_broker": broker_led["resident"] + broker_led["reply_lost"],
+        "unaccounted_frames": unaccounted,
+    }
+    artifact["conservation"] = conservation
+    artifact["learner"] = {
+        "versions_trained": int(staging_stats["batches"]),
+        "crashed": learner_crashed,
+        "fleet_errors": fleet_errors,
+        "watchdog": watchdog,
+    }
+
+    p1 = artifact["phase_1_parity"]
+    p2 = artifact["phase_2_conservation"]
+    total_kills = p1["kills_executed"] + p2["kills_executed"]
+    verdict = {
+        # the headline: rolling restarts are an episode non-event
+        "zero_abandoned_episodes": p1["episodes_abandoned"] == 0
+        and p2["episodes_abandoned"] == 0
+        and p1["baseline_abandons"] == 0,
+        "episodes_resumed_cover_interruptions": p1["episodes_resumed"] >= 1
+        and p2["episodes_resumed"] >= 1,
+        "kills_hit_inflight_steps": p1["inflight_step_failures"] >= 1,
+        "rolling_killed_every_replica": p1["kills_executed"] >= 2
+        and p2["kills_executed"] >= 2,
+        # parity: FULL streams, not prefixes — the resumed episodes' rows
+        # are bitwise the uninterrupted arm's from the last boundary on
+        "parity_full_stream_bitwise": parity_ok and matched > 0,
+        "parity_both_arms_finished": p1["both_arms_finished"],
+        # the store really carried sessions (phases combined: WHICH kill
+        # lands mid-chunk-2 vs mid-chunk-1 is wall-clock dependent, but
+        # across both phases' kills at least one resume must have gone
+        # through the store, and boundary writes must be flowing)
+        "store_backed_resumes": (
+            p1["serve_totals"]["resumes"]
+            + p2["serve"]["a"]["resumes"]
+            + p2["serve"]["b"]["resumes"]
+        )
+        >= 1
+        and p1["serve_totals"]["handoff_writes"] >= 1
+        and p2["serve"]["a"]["handoff_writes"] + p2["serve"]["b"]["handoff_writes"] >= 1,
+        "store_no_errors_or_misses": p1["serve_totals"]["handoff_write_errors"] == 0
+        and p1["serve_totals"]["resume_misses"] == 0
+        and p2["serve"]["a"]["resume_misses"] + p2["serve"]["b"]["resume_misses"] == 0,
+        "load_routing_probed": p1["route_probes"] >= 1,
+        # bounded p99 inside the restart windows (absolute budget;
+        # raw values + baseline arm disclosed in phase_1_parity.latency)
+        "p99_bounded_during_restart": p1["latency"]["p99_ms_during_restart_windows"]
+        is not None
+        and p1["latency"]["p99_ms_during_restart_windows"] <= args.p99_budget_ms,
+        # conservation: zero unaccounted frames end to end
+        "conservation_zero_unaccounted": unaccounted == 0,
+        "broker_identity_holds": conservation["broker_identity_holds"],
+        "producer_ledgers_balance": producer_ledgers_ok,
+        "learner_clean_finish": learner_crashed is False
+        and not fleet_errors
+        and not watchdog.get("tripped", False)
+        and int(watchdog.get("trips_total", 0) or 0) == 0,
+        "server_kills_executed": total_kills,
+    }
+    artifact["verdict"] = verdict
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(artifact, indent=2))
+    return 0 if all(v for v in verdict.values() if isinstance(v, bool)) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -127,10 +127,85 @@ def test_golden_decision_sequence_pinned():
     assert seq(_GOLDEN_SPEC + ",kill@5:1") == _GOLDEN_SEQ
     assert seq(_GOLDEN_SPEC + ",kill@7:2@server") == _GOLDEN_SEQ
     assert seq(_GOLDEN_SPEC + ",kill@3:1@server,kill@9:2@broker,kill@12:1@server") == _GOLDEN_SEQ
+    # the PR-13 rolling-restart grammar is ARG-side too: zero rate draws
+    assert seq(_GOLDEN_SPEC + ",rolling@6:1@server") == _GOLDEN_SEQ
+    assert seq(_GOLDEN_SPEC + ",rolling@2:0.5@server,kill@9:2@server,rolling@15:1@server") == _GOLDEN_SEQ
     # latency draw position pinned too (it follows the five rate draws)
     s = FaultSchedule.parse(_GOLDEN_SPEC + ",kill@9:1@learner", seed=3)
     assert round(s.decide(0).latency_s, 9) == 0.00253577
     assert round(s.decide(47).latency_s, 9) == 0.002151729
+
+
+def test_rolling_grammar_parses_and_rejects():
+    """rolling@T:P@server — staggered sequential serve-replica restarts.
+    The selector is server-only (broker/learner are singletons where
+    rolling degenerates to kill), bare form defaults to server, and
+    kills() returns rolling events (they are kill-class work for the
+    ScheduleRunner)."""
+    s = FaultSchedule.parse("rolling@5:1.5@server,kill@10:2", seed=0)
+    ev, kv = s.kills()
+    assert (ev.kind, ev.at_s, ev.duration_s, ev.target) == ("rolling", 5.0, 1.5, "server")
+    assert kv.kind == "kill" and kv.target == "broker"
+    assert FaultSchedule.parse("rolling@1:2", seed=0).kills()[0].target == "server"
+    for bad in (
+        "rolling@1:2@broker",
+        "rolling@1:2@learner",
+        "rolling@1:2@server:term",
+        "stall@1:2@server",
+    ):
+        with pytest.raises(ValueError):
+            FaultSchedule.parse(bad)
+
+
+def test_rolling_runner_fans_kills_across_replicas_sequentially():
+    """The rolling executor asks the controller for replica_count() and
+    runs kill→down-window→restart per replica SEQUENTIALLY — restart i
+    always precedes kill i+1, so at most one replica is ever down (the
+    property the zero-abandon handoff soak rides on)."""
+    import time as _time
+
+    from dotaclient_tpu.chaos.controller import ScheduleRunner
+
+    class Router:
+        def __init__(self, n):
+            self.n = n
+            self.kills = []
+            self.restarts = []
+
+        def replica_count(self):
+            return self.n
+
+        def kill(self):
+            self.kills.append(_time.monotonic())
+
+        def restart(self):
+            self.restarts.append(_time.monotonic())
+
+    router = Router(3)
+    runner = ScheduleRunner(
+        FaultSchedule.parse("rolling@0.02:0.03@server", seed=0),
+        broker=None,
+        t0=_time.monotonic(),
+        server=router,
+    ).start()
+    deadline = _time.monotonic() + 10
+    while len(router.restarts) < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    runner.stop()
+    assert len(router.kills) == 3 and len(router.restarts) == 3
+    assert [e["replica"] for e in runner.recovery] == [0, 1, 2]
+    assert all(e["kind"] == "rolling" and e["target"] == "server" for e in runner.recovery)
+    for i in range(2):
+        assert router.restarts[i] <= router.kills[i + 1], "two replicas down at once"
+    # down windows honored: each replica stayed down ~duration_s
+    for kt, rt in zip(router.kills, router.restarts):
+        assert rt - kt >= 0.028
+
+    # a rolling schedule against no server controller refuses loudly
+    with pytest.raises(ValueError, match="server"):
+        ScheduleRunner(
+            FaultSchedule.parse("rolling@1:1@server", seed=0), broker=None, t0=0.0
+        )
 
 
 def test_corrupt_hits_magic_truncate_shortens():

@@ -238,6 +238,7 @@ def test_chaos_pinned_off_in_all_prod_manifests():
         if cmd is None or cmd[2] in (
             "dotaclient_tpu.transport.tcp_server",  # broker: no chaos surface
             "dotaclient_tpu.env.fake_dotaservice",  # env stub: no flags at all
+            "dotaclient_tpu.serve.handoff",  # carry store: no chaos surface
         ):
             continue
         args = c.get("args", [])
@@ -368,6 +369,63 @@ def test_serve_endpoint_lists_match_replicas_and_league_stays_local():
         "the serve-tier fleet arms the local fallback (experience never stops)"
     )
     assert float(scripted[scripted.index("--serve.fallback_after_s") + 1]) > 0
+
+
+def test_session_continuity_manifests():
+    """Session continuity (PR 13), gated on a green SERVE_HANDOFF_SOAK
+    verdict (the WIRE_SOAK flip pattern): the carry-store Deployment +
+    Service exist, every inference replica streams to it
+    (--serve.handoff_endpoint naming the Service and its port), and the
+    scripted serve-tier fleet arms resume + load routing with a resume
+    window under the fallback budget (a starved fallback decision would
+    idle the fleet)."""
+    import json
+
+    verdict = json.loads((K8S.parent / "SERVE_HANDOFF_SOAK.json").read_text())["verdict"]
+    bad = [k for k, v in verdict.items() if isinstance(v, bool) and not v]
+    assert not bad, f"handoff opt-in requires a green SERVE_HANDOFF_SOAK verdict: {bad}"
+
+    (_, store), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "carry-store" and d["kind"] == "Deployment"
+    ]
+    sc = store["spec"]["template"]["spec"]["containers"][0]
+    assert sc["command"][2] == "dotaclient_tpu.serve.handoff"
+    sargs = sc["args"]
+    store_port = int(sargs[sargs.index("--port") + 1])
+    assert int(sargs[sargs.index("--keep") + 1]) >= 2, (
+        "keep>=2 is load-bearing: the previous boundary covers lost-ack resumes"
+    )
+    (_, ssvc), = [
+        (f, d) for f, d in DOCS
+        if d["kind"] == "Service" and d["metadata"]["name"] == "carry-store"
+    ]
+    assert store_port in {p["port"] for p in ssvc["spec"]["ports"]}
+
+    (_, sts), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "inference" and d["kind"] == "StatefulSet"
+    ]
+    sts_args = sts["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert sts_args[sts_args.index("--serve.handoff_endpoint") + 1] == (
+        f"carry-store:{store_port}"
+    ), "inference replicas must stream boundaries to the carry-store Service"
+
+    for fname, c in _our_containers():
+        if c.get("command") and c["command"][2] == "dotaclient_tpu.runtime.actor":
+            a = c.get("args", [])
+            if a[a.index("--opponent") + 1] != "scripted_hard":
+                continue
+            assert a[a.index("--serve.resume") + 1] == "true", (
+                f"{fname}: the serve-tier fleet rides session continuity"
+            )
+            assert a[a.index("--serve.route") + 1] == "load"
+            window = float(a[a.index("--serve.resume_window_s") + 1])
+            budget = float(a[a.index("--serve.fallback_after_s") + 1])
+            assert 0 < window < budget, (
+                "resume window must sit under the fallback budget, or the "
+                "fallback decision starves behind resume retries"
+            )
 
 
 def test_actor_fleet_scale_and_kill_switch():

@@ -508,9 +508,17 @@ def test_serve_scalars_are_registered():
         "serve_version",
         "serve_clients_connected",
         "serve_carries_resident",
+        # session continuity, server side (zero with handoff off)
+        "serve_handoff_store_writes_total",
+        "serve_handoff_store_errors_total",
+        "serve_handoff_resumes_total",
+        "serve_handoff_resume_misses_total",
+        "serve_handoff_replayed_steps_total",
         "actor_batch_occupancy",  # the shared batcher family rides along
         "actor_tick_rows_1",
     } <= set(stats)
+    # default-off surface: handoff meters read zero with no store
+    assert stats["serve_handoff_store_writes_total"] == 0.0
 
 
 def test_serve_failover_fallback_scalars_are_registered():
@@ -544,11 +552,26 @@ def test_serve_failover_fallback_scalars_are_registered():
         "serve_fallback_engagements_total",
         "serve_fallback_steps_total",
         "serve_fallback_version",
+        # session continuity + routing tier, client side
+        "serve_handoff_client_resumes_total",
+        "serve_handoff_replay_steps_total",
+        "serve_route_load_mode",
+        "serve_route_probes_total",
+        "serve_route_picks_total",
+        # per-endpoint health gauges (serve_endpoint_ registry family)
+        "serve_endpoint_up_0",
+        "serve_endpoint_cooldown_s_0",
         "broker_shed_observed_total",  # publish degradation rides along
     } <= set(stats)
     # default-off surface: fallback meters read zero with no fallback
     assert stats["serve_fallback_engaged"] == 0.0
     assert stats["serve_failover_endpoints"] == 1.0
+    # resume/routing defaults off: list-order mode, no probes, no resumes
+    assert stats["serve_route_load_mode"] == 0.0
+    assert stats["serve_handoff_client_resumes_total"] == 0.0
+    # a configured endpoint starts IN rotation
+    assert stats["serve_endpoint_up_0"] == 1.0
+    assert stats["serve_endpoint_cooldown_s_0"] == 0.0
 
 
 def test_wire_scalars_are_registered_and_emitted_names_pinned():

@@ -18,6 +18,7 @@ import pytest
 from dotaclient_tpu.analysis.schedcheck import (
     CoalesceModel,
     DrainedModel,
+    HandoffModel,
     HotSwapModel,
     RingLeaseModel,
     explore,
@@ -109,6 +110,70 @@ def test_coalesce_lost_newest_schedule_found():
 def test_hot_swap_mixed_tick_schedule_found():
     broken = explore(HotSwapModel(swaps=2, ticks=2, rows=2, mutant="per_row_read"))
     assert any("mixed tick" in v for v in broken.violations)
+
+
+# ------------------------------------------- carry-handoff lifecycle
+
+
+@pytest.mark.parametrize(
+    "mutant,needle",
+    [
+        ("handoff_after_ack", "abandoned"),
+        ("resume_from_stale", "diverge"),
+        ("single_entry", "abandoned"),
+        ("dup_shift", "abandoned"),
+    ],
+)
+def test_handoff_mutants_found_then_fixed(mutant, needle):
+    """The PR-13 session-continuity protocol, failing-then-fixed: each
+    mutant re-introduces a losing order — ack-before-durable-write, a
+    stale (non-exact-match) restore, a single-entry store, and the
+    duplicate-boundary shift that exploration of THIS model caught
+    during development (CarryStore.put replaces on equal episode_step
+    because of it). Exploration finds every one; the HEAD protocol
+    (write-ahead + keep-two + replace-on-dup + exact-match) exhausts
+    its entire bounded interleaving set clean."""
+    broken = explore(HandoffModel(steps=5, chunk=2, kills=2, mutant=mutant))
+    assert any(needle in v for v in broken.violations), (mutant, broken.violations)
+    fixed = explore(HandoffModel(steps=5, chunk=2, kills=2))
+    assert fixed.exhausted and fixed.violations == []
+
+
+def test_handoff_model_matches_real_carry_store():
+    """Cross-validation against the REAL CarryStore (serve/handoff.py):
+    the four semantics the model's store component encodes — exact-match
+    restore only, the previous boundary retained (the lost-ack resume),
+    same-boundary puts replacing instead of shifting (the dup_shift
+    catch), and stale/miss refusals — asserted on the shipped class."""
+    import numpy as np
+
+    from dotaclient_tpu.serve.handoff import ST_MISS, ST_OK, ST_STALE, CarryStore
+
+    store = CarryStore()
+    z = np.zeros(8, np.float32)
+    # exact-match only: an unknown key is MISS, a known key with no
+    # matching boundary is STALE — never a silently-served wrong entry
+    assert store.get(1, 2)[0] == ST_MISS
+    store.put(1, 2, 1, z, z)
+    assert store.get(1, 2)[0] == ST_OK
+    assert store.get(1, 4)[0] == ST_STALE
+    # keep-two: after the next boundary lands, the previous one still
+    # resumes (the model's write-landed-ack-lost schedule)
+    store.put(1, 4, 1, z, z)
+    assert store.get(1, 2)[0] == ST_OK and store.get(1, 4)[0] == ST_OK
+    # replace-on-duplicate: the re-issued chunk-fill re-write must NOT
+    # evict the previous entry (the dup_shift mutant's losing schedule)
+    store.put(1, 4, 2, z, z)
+    assert store.get(1, 2)[0] == ST_OK, (
+        "duplicate-boundary put evicted the previous entry — the "
+        "dup_shift bug the model exploration caught"
+    )
+    # and a third distinct boundary finally rotates the oldest out
+    store.put(1, 6, 2, z, z)
+    assert store.get(1, 2)[0] == ST_STALE
+    # the model refuses keep<2 for the same reason the class does
+    with pytest.raises(ValueError):
+        CarryStore(keep=1)
 
 
 def test_deadlock_is_a_violation():
@@ -280,6 +345,7 @@ def test_schedule_soak_deeper_bounds():
         "drained": DrainedModel(frames=3, intake_cap=2, ready_cap=2),
         "coalesce": CoalesceModel(versions=5),
         "hot_swap": HotSwapModel(swaps=3, ticks=3, rows=3),
+        "carry_handoff": HandoffModel(steps=9, chunk=3, kills=4),
     }
     for name, model in deep.items():
         result = explore(model, max_states=2_000_000)

@@ -1,0 +1,730 @@
+"""Serve session continuity (PR 13): carry store + resume-on-failover,
+load-aware routing, and the zero-abandon handoff-soak artifact guards.
+
+The load-bearing contracts: boundary writes are WRITE-AHEAD (durable
+before the chunk-fill reply that vouches for them); the store keeps the
+previous boundary too (lost-ack resume) and REPLACES on same-boundary
+puts (the schedcheck dup_shift catch); resume restores only an
+EXACT-match boundary, replay rebuilds the mid-chunk carry bitwise, and
+a refused resume falls back to the PR-10 abandon semantics; routing is
+load-aware only at (re)connect time — affinity is untouched; and with
+every flag unset the whole surface is inert."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.chaos import ServeIncarnations
+from dotaclient_tpu.config import (
+    ActorConfig,
+    HandoffConfig,
+    InferenceConfig,
+    PolicyConfig,
+    RetryConfig,
+    ServeClientConfig,
+    ServeConfig,
+    parse_config,
+)
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+from dotaclient_tpu.env.service import LocalDotaServiceStub
+from dotaclient_tpu.serve import wire as W
+from dotaclient_tpu.serve.client import (
+    RemoteActor,
+    RemoteFleet,
+    RemoteInferenceError,
+    RemotePolicyClient,
+    SessionResumeRefused,
+)
+from dotaclient_tpu.serve.handoff import (
+    ST_MISS,
+    ST_OK,
+    ST_STALE,
+    CarryStore,
+    CarryStoreClient,
+    CarryStoreServer,
+    LocalCarryStore,
+    carry_fingerprint,
+)
+from dotaclient_tpu.serve.server import InferenceServer
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import connect as broker_connect
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _server(port=0, store=None, handoff_endpoint="", max_batch=2, seed=1):
+    cfg = InferenceConfig(
+        serve=ServeConfig(
+            port=port,
+            max_batch=max_batch,
+            gather_window_s=0.002,
+            handoff_endpoint=handoff_endpoint,
+        ),
+        policy=SMALL,
+        seed=seed,
+    )
+    return InferenceServer(cfg, broker=None, carry_store=store).start()
+
+
+def _rand_obs(rs):
+    o = F.zeros_observation()
+    return o._replace(
+        unit_feats=np.asarray(rs.randn(*o.unit_feats.shape), np.float32),
+        hero_feats=np.asarray(rs.randn(*o.hero_feats.shape), np.float32),
+        global_feats=np.asarray(rs.randn(*o.global_feats.shape), np.float32),
+        unit_mask=np.asarray(rs.rand(*o.unit_mask.shape) > 0.3),
+        action_mask=np.ones_like(o.action_mask),
+        target_mask=np.asarray(rs.rand(*o.target_mask.shape) > 0.3),
+    )
+
+
+class _PacedStub:
+    """LocalDotaServiceStub wrapper adding a fixed wall delay per
+    observe(): it slows steps so a background kill lands within ~1 step
+    of its trigger threshold (kill() joins the server loop, which costs
+    wall time — unpaced, fast hosts overshoot into the NEXT episode's
+    first chunk and the store-backed path goes untested). Data is
+    untouched, so bitwise comparisons are unaffected."""
+
+    def __init__(self, inner, delay_s=0.05):
+        self._inner = inner
+        self._delay = delay_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def observe(self, req):
+        await asyncio.sleep(self._delay)
+        return await self._inner.observe(req)
+
+
+def _acfg(endpoint, seed=11, **serve_kw):
+    serve_kw.setdefault("timeout_s", 4.0)
+    serve_kw.setdefault("connect_timeout_s", 1.0)
+    serve_kw.setdefault("cooldown_s", 0.3)
+    return ActorConfig(
+        env_addr="local",
+        rollout_len=4,
+        max_dota_time=8.0,
+        policy=SMALL,
+        seed=seed,
+        max_weight_age_s=0.0,
+        serve=ServeClientConfig(endpoint=endpoint, **serve_kw),
+        retry=RetryConfig(window_s=3.0, backoff_base_s=0.02, backoff_cap_s=0.1),
+    )
+
+
+# ------------------------------------------------------------- config/wire
+
+
+def test_flag_surface_roundtrip_and_defaults_off():
+    d = ServeClientConfig()
+    assert d.resume is False and d.route == "order"
+    s = ServeConfig()
+    assert s.handoff_endpoint == ""
+    cfg = parse_config(
+        ActorConfig(),
+        [
+            "--serve.endpoint", "inf-0:13380,inf-1:13380",
+            "--serve.resume", "true",
+            "--serve.resume_window_s", "12.5",
+            "--serve.route", "load",
+        ],
+    )
+    assert cfg.serve.resume is True and cfg.serve.resume_window_s == 12.5
+    assert cfg.serve.route == "load"
+    icfg = parse_config(
+        InferenceConfig(),
+        ["--serve.handoff_endpoint", "carry-store:13390", "--serve.handoff_timeout_s", "1.5"],
+    )
+    assert icfg.serve.handoff_endpoint == "carry-store:13390"
+    assert icfg.serve.handoff_timeout_s == 1.5
+    hcfg = parse_config(HandoffConfig(), ["--port", "0", "--keep", "3"])
+    assert hcfg.port == 0 and hcfg.keep == 3
+    with pytest.raises(ValueError):
+        RemotePolicyClient("a:1", SMALL, route="banana")
+
+
+def test_resume_wire_roundtrip_and_replay_flag():
+    req = W.encode_resume_request(77, 24, 0xDEADBEEFCAFE)
+    back = W.decode_resume_request(req)
+    assert back.client_key == 77 and back.boundary_step == 24
+    assert back.carry_hash == 0xDEADBEEFCAFE
+    ok = W.decode_resume_response(
+        W.encode_resume_response(W.ResumeResponse(77, W.OK, version=9, episode_step=24))
+    )
+    assert (ok.client_key, ok.status, ok.version, ok.episode_step) == (77, W.OK, 9, 24)
+    refused = W.decode_resume_response(
+        W.encode_resume_response(W.ResumeResponse(77, W.UNKNOWN_CLIENT))
+    )
+    assert refused.status == W.UNKNOWN_CLIENT and refused.episode_step == 0
+    with pytest.raises(ValueError):
+        W.decode_resume_request(req[:-1])
+    # FLAG_REPLAY round-trips; its default leaves request bytes
+    # byte-identical to the PR-10 encoding (flags-byte inertness)
+    rs = np.random.RandomState(0)
+    obs = _rand_obs(rs)
+    rng = np.asarray(jax.random.PRNGKey(1))
+    plain = W.encode_step_request(5, obs, rng, episode_start=True)
+    with_default = W.encode_step_request(5, obs, rng, episode_start=True, replay=False)
+    assert plain == with_default
+    replayed = W.encode_step_request(5, obs, rng, replay=True)
+    dec = W.decode_step_request(replayed)
+    assert dec.replay is True and not dec.episode_start
+    assert W.decode_step_request(plain).replay is False
+
+
+# ------------------------------------------------------------- the store
+
+
+def test_carry_store_bitwise_roundtrip_keep_two_and_statuses():
+    store = CarryStore()
+    rs = np.random.RandomState(3)
+    c1, h1 = rs.randn(16).astype(np.float32), rs.randn(16).astype(np.float32)
+    c2, h2 = rs.randn(16).astype(np.float32), rs.randn(16).astype(np.float32)
+    assert store.get(5, 4) == (ST_MISS, None)
+    store.put(5, 4, 7, c1, h1)
+    st, e = store.get(5, 4)
+    assert st == ST_OK and e.version == 7 and e.episode_step == 4
+    assert e.c.tobytes() == c1.tobytes() and e.h.tobytes() == h1.tobytes()
+    # keep-two: the previous boundary stays readable (lost-ack resume)
+    store.put(5, 8, 9, c2, h2)
+    assert store.get(5, 8)[0] == ST_OK
+    st_prev, e_prev = store.get(5, 4)
+    assert st_prev == ST_OK and e_prev.c.tobytes() == c1.tobytes()
+    # anything else is STALE, never silently served
+    assert store.get(5, 12)[0] == ST_STALE
+    # third boundary evicts the first
+    store.put(5, 12, 9, c1, h1)
+    assert store.get(5, 4)[0] == ST_STALE
+    store.evict(5)
+    assert store.get(5, 12)[0] == ST_MISS
+    with pytest.raises(ValueError):
+        CarryStore(keep=1)  # the previous entry is load-bearing
+
+
+def test_carry_store_same_boundary_put_replaces_not_shifts():
+    """The schedcheck HandoffModel catch (dup_shift mutant): a resumed
+    client re-issuing its chunk-fill step re-writes the same boundary;
+    shifting would evict the previous entry a second kill still needs."""
+    store = CarryStore()
+    z16 = np.zeros(16, np.float32)
+    store.put(5, 4, 1, z16, z16)
+    store.put(5, 8, 1, z16, z16)
+    store.put(5, 8, 2, z16, z16)  # re-issued chunk-fill re-write
+    st, e = store.get(5, 4)
+    assert st == ST_OK, "same-boundary put must REPLACE, not evict the previous entry"
+    st8, e8 = store.get(5, 8)
+    assert st8 == ST_OK and e8.version == 2  # newest write won
+
+
+def test_carry_store_server_wire_roundtrip_and_degradation():
+    srv = CarryStoreServer(port=0).start()
+    client = CarryStoreClient("127.0.0.1", srv.port, timeout_s=2.0)
+    rs = np.random.RandomState(4)
+    c, h = rs.randn(16).astype(np.float32), rs.randn(16).astype(np.float32)
+
+    async def go():
+        await client.put(9, 4, 3, c, h)
+        st, e = await client.get(9, 4)
+        assert st == ST_OK and e.version == 3 and e.episode_step == 4
+        assert e.c.tobytes() == c.tobytes() and e.h.tobytes() == h.tobytes()
+        st2, e2 = await client.get(9, 8)
+        assert st2 == ST_STALE and e2 is None
+        st3, e3 = await client.get(1234, 4)
+        assert st3 == ST_MISS and e3 is None
+        await client.close()
+
+    run(go())
+    stats = srv.stats()
+    assert stats["serve_handoff_store_puts_total"] == 1.0
+    assert stats["serve_handoff_store_hits_total"] == 1.0
+    assert stats["serve_handoff_store_stale_total"] == 1.0
+    assert stats["serve_handoff_store_misses_total"] == 1.0
+    srv.stop()
+
+    # store down: ops raise StoreUnavailableError — and a serving server
+    # DEGRADES (write counted as error, reply still goes out) rather
+    # than failing the step (covered end-to-end below)
+    from dotaclient_tpu.serve.handoff import StoreUnavailableError
+
+    dead = CarryStoreClient("127.0.0.1", srv.port, timeout_s=0.5)
+
+    async def down():
+        with pytest.raises(StoreUnavailableError):
+            await dead.put(1, 4, 0, c, h)
+
+    run(down())
+
+
+# ------------------------------------------- resume-on-failover, wire level
+
+
+def _drive_steps(client, key, obs_seq, rng0, boundary_every, kill_after=None, on_fail=None):
+    """Step obs_seq through `client`; on RemoteInferenceError run
+    `on_fail(...)` then re-issue. Tracks the last boundary carry the
+    chunk-fill replies delivered (the resume fingerprint source).
+    Returns the per-step outputs."""
+    out = []
+
+    async def go():
+        rng = rng0
+        buffered = []
+        boundary = 0
+        boundary_carry = None
+        for i, o in enumerate(obs_seq):
+            want = (i + 1) % boundary_every == 0
+            try:
+                r = await client.step(key, o, rng, episode_start=(i == 0), want_carry=want)
+            except RemoteInferenceError:
+                assert on_fail is not None, "unexpected step failure"
+                r = await on_fail(i, o, rng, list(buffered), boundary, want, boundary_carry)
+            rng = r.rng
+            if want:
+                boundary = i + 1
+                boundary_carry = r.carry
+                buffered.clear()
+            else:
+                buffered.append(o)
+            out.append((r.action.tolist(), r.logp, r.value, bytes(np.asarray(r.rng))))
+            if kill_after is not None and i == kill_after[0]:
+                kill_after[1]()
+        await client.close()
+
+    run(go())
+    return out
+
+
+@pytest.mark.parametrize("obs_bf16", [False, True])
+def test_resume_failover_bitwise_mid_chunk(obs_bf16):
+    """The tentpole at wire level, deterministically: steps 0..k on
+    replica A (boundary written write-ahead), A dies mid-chunk, the
+    client resumes on B (exact-match store restore + FLAG_REPLAY
+    rebuild), and every output — action, logp, value, advanced rng — is
+    BITWISE the uninterrupted run's, for f32 and bf16 wire clients
+    alike (the carry is f32 on the store either way)."""
+    wire = "bf16" if obs_bf16 else "f32"
+    store = CarryStore()
+    s_base = _server(store=LocalCarryStore(store))
+    rs = np.random.RandomState(7)
+    obs_seq = [_rand_obs(rs) for _ in range(7)]
+    rng0 = np.asarray(jax.random.PRNGKey(21))
+
+    base_client = RemotePolicyClient(
+        f"127.0.0.1:{s_base.port}", SMALL, wire_obs_dtype=wire, cooldown_s=0.2
+    )
+    base = _drive_steps(base_client, 5, obs_seq, rng0, boundary_every=3)
+    s_base.stop()
+
+    store2 = CarryStore()
+    s_a = _server(store=LocalCarryStore(store2))
+    s_b = _server(store=LocalCarryStore(store2))
+    client = RemotePolicyClient(
+        f"127.0.0.1:{s_a.port},127.0.0.1:{s_b.port}",
+        SMALL,
+        wire_obs_dtype=wire,
+        cooldown_s=0.3,
+        connect_timeout_s=1.0,
+    )
+
+    async def on_fail(i, o, rng, buffered, boundary, want, boundary_carry):
+        while True:
+            await asyncio.sleep(0.05)
+            try:
+                if boundary > 0:
+                    fp = carry_fingerprint(boundary_carry[0], boundary_carry[1])
+                    rr = await client.resume(5, boundary, fp)
+                    assert rr.episode_step == boundary
+                for j, bo in enumerate(buffered):
+                    await client.step(5, bo, rng, episode_start=(boundary == 0 and j == 0), replay=True)
+                return await client.step(5, o, rng, episode_start=(i == 0), want_carry=want)
+            except SessionResumeRefused:
+                raise
+            except RemoteInferenceError:
+                continue
+
+    # kill A after step 4 (mid-chunk-2: boundary 3 durable, 1 buffered)
+    chaos = _drive_steps(
+        client, 5, obs_seq, rng0, boundary_every=3,
+        kill_after=(4, s_a.stop), on_fail=on_fail,
+    )
+    assert base == chaos, "resumed outputs diverged from the uninterrupted run"
+    assert s_b.resumes_total >= 1 and s_b.replayed_steps_total >= 1
+    assert store2.gets >= 1 and store2.hits >= 1 and store2.stale == 0
+    s_b.stop()
+
+
+def test_write_ahead_boundary_durable_before_reply():
+    """The write-ahead ordering contract: the instant the client holds a
+    chunk-fill reply, the boundary entry is already in the store (a kill
+    can eat the reply, never the entry the reply vouched for)."""
+    store = CarryStore()
+    s = _server(store=LocalCarryStore(store))
+    client = RemotePolicyClient(f"127.0.0.1:{s.port}", SMALL, cooldown_s=0.2)
+    rs = np.random.RandomState(9)
+    rng = np.asarray(jax.random.PRNGKey(3))
+
+    async def go():
+        nonlocal rng
+        for i in range(3):
+            r = await client.step(8, _rand_obs(rs), rng, episode_start=(i == 0), want_carry=(i == 2))
+            rng = r.rng
+            if i == 2:
+                assert r.carry is not None
+                st, e = store.get(8, 3)  # synchronous: reply in hand ⇒ durable
+                assert st == ST_OK and e.episode_step == 3
+                # and the stored carry IS the replied carry, bitwise
+                assert e.c.tobytes() == np.ascontiguousarray(r.carry[0], np.float32).tobytes()
+                assert e.h.tobytes() == np.ascontiguousarray(r.carry[1], np.float32).tobytes()
+        await client.close()
+
+    run(go())
+    assert s.handoff_writes_total == 1 and s.handoff_write_errors_total == 0
+    s.stop()
+
+
+def test_resume_refuses_cross_episode_stale_entry_by_fingerprint():
+    """Review-fix regression: episode boundaries repeat the same step
+    values across a client's episodes, so after a FAILED boundary write
+    a previous episode's leftover entry can exact-match on step. The
+    carry fingerprint turns that into a refusal (→ the abandon path)
+    instead of a silently-served wrong-episode carry; the true carry's
+    fingerprint still resumes."""
+    store = CarryStore()
+    s = _server(store=LocalCarryStore(store))
+    client = RemotePolicyClient(f"127.0.0.1:{s.port}", SMALL, cooldown_s=0.2)
+    rs = np.random.RandomState(13)
+    rng = np.asarray(jax.random.PRNGKey(5))
+
+    async def go():
+        nonlocal rng
+        carry = None
+        for i in range(3):  # boundary at step 3 → store entry written
+            r = await client.step(9, _rand_obs(rs), rng, episode_start=(i == 0), want_carry=(i == 2))
+            rng = r.rng
+            if r.carry is not None:
+                carry = r.carry
+        # the TRUE fingerprint resumes
+        fp = carry_fingerprint(carry[0], carry[1])
+        rr = await client.resume(9, 3, fp)
+        assert rr.status == W.OK and rr.episode_step == 3
+        # a different episode's carry (wrong bytes, same step) is refused
+        wrong = np.asarray(rs.randn(16), np.float32)
+        with pytest.raises(SessionResumeRefused):
+            await client.resume(9, 3, carry_fingerprint(wrong, wrong))
+        await client.close()
+
+    run(go())
+    assert s.resumes_total == 1 and s.resume_misses_total == 1
+    s.stop()
+
+
+def test_resume_refused_on_store_miss_falls_back_to_abandon():
+    """The PR-10 abandon path survives underneath: a server with NO
+    store (or no matching entry) answers S_RESUME with UNKNOWN_CLIENT,
+    the client raises SessionResumeRefused, and a resume-armed
+    RemoteActor ledgers the abandon exactly like PR 10."""
+    s = _server()  # no store at all
+    client = RemotePolicyClient(f"127.0.0.1:{s.port}", SMALL, cooldown_s=0.2)
+
+    async def go():
+        with pytest.raises(SessionResumeRefused):
+            await client.resume(5, 4)
+        await client.close()
+
+    run(go())
+    assert s.resume_misses_total == 1
+    s.stop()
+
+    # actor level: resume armed, NO store on the servers — a mid-episode
+    # kill abandons (the PR-10 semantics) and the next episode recovers
+    def make_server(port):
+        return _server(port=port)
+
+    inc = ServeIncarnations(make_server, port=0)
+    mem.reset("hoff_miss")
+    cfg = _acfg(f"127.0.0.1:{inc.port}", resume=True, resume_window_s=2.0)
+    actor = RemoteActor(
+        cfg, broker_connect("mem://hoff_miss"), actor_id=0,
+        stub=_PacedStub(LocalDotaServiceStub(FakeDotaService())),
+    )
+    stop = threading.Event()
+
+    def killer():
+        while not stop.is_set() and actor.steps_done < 5:  # mid-chunk-2
+            time.sleep(0.005)
+        if not stop.is_set():
+            inc.kill()
+            time.sleep(0.2)
+            inc.restart()
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+
+    async def drive():
+        while actor.episodes_done < 2:
+            try:
+                await actor.run_episode()
+            except RemoteInferenceError:
+                await asyncio.sleep(0.05)
+        await actor.remote_policy.close()
+
+    try:
+        run(drive())
+    finally:
+        stop.set()
+        kt.join(timeout=5)
+        total = inc.final_ledger()
+    assert actor.episodes_abandoned >= 1, "store miss must fall back to abandon"
+    assert actor.episodes_done >= 2  # fresh episodes still serve
+    assert total["resume_misses"] >= 1 and total["resumes"] == 0
+
+
+def test_actor_zero_abandon_resume_bitwise_vs_uninterrupted():
+    """Episode level, end to end: RemoteActor with resume armed against
+    TWO ServeIncarnations replicas sharing a real-TCP CarryStoreServer;
+    a kill mid-chunk-2 resumes through the store (S_RESUME + replay)
+    and the published frames are bitwise the uninterrupted arm's, with
+    ZERO abandons."""
+    store_srv = CarryStoreServer(port=0).start()
+
+    def make_server(port):
+        return _server(port=port, handoff_endpoint=f"127.0.0.1:{store_srv.port}")
+
+    def run_arm(endpoint, memname, incs=None, kill_step=None):
+        mem.reset(memname)
+        broker = broker_connect(f"mem://{memname}")
+        cfg = _acfg(endpoint, resume=True, resume_window_s=10.0, route="load")
+        actor = RemoteActor(
+            cfg, broker, actor_id=0,
+            stub=_PacedStub(LocalDotaServiceStub(FakeDotaService())),
+        )
+        stop = threading.Event()
+
+        def killer():
+            while not stop.is_set() and actor.steps_done < kill_step:
+                time.sleep(0.005)
+            if not stop.is_set():
+                incs[0].kill()
+                time.sleep(0.3)
+                incs[0].restart()
+
+        kt = None
+        if kill_step is not None:
+            kt = threading.Thread(target=killer, daemon=True)
+            kt.start()
+
+        async def drive():
+            while actor.episodes_done < 3:
+                try:
+                    await actor.run_episode()
+                except RemoteInferenceError:
+                    await asyncio.sleep(0.05)
+            await actor.remote_policy.close()
+
+        run(drive())
+        stop.set()
+        if kt:
+            kt.join(timeout=5)
+        return actor, broker.consume_experience(100000, timeout=0.2)
+
+    inc0 = ServeIncarnations(make_server, port=0)
+    a_base, f_base = run_arm(f"127.0.0.1:{inc0.port}", "hoff_b")
+    inc0.final_ledger()
+
+    inc_a = ServeIncarnations(make_server, port=0)
+    inc_b = ServeIncarnations(make_server, port=0)
+    a_chaos, f_chaos = run_arm(
+        f"127.0.0.1:{inc_a.port},127.0.0.1:{inc_b.port}", "hoff_c",
+        incs=[inc_a, inc_b], kill_step=5,
+    )
+    la, lb = inc_a.final_ledger(), inc_b.final_ledger()
+    store_stats = store_srv.stats()
+    store_srv.stop()
+
+    assert a_chaos.episodes_abandoned == 0, "resume must make the kill an episode non-event"
+    assert a_chaos.episodes_resumed >= 1
+    assert la["resumes"] + lb["resumes"] >= 1, "resume must go through the store"
+    assert la["resume_misses"] + lb["resume_misses"] == 0
+    assert store_stats["serve_handoff_store_misses_total"] == 0.0
+    assert len(f_base) == len(f_chaos) and f_base == f_chaos, (
+        "resumed episodes' frames must be bitwise the uninterrupted arm's"
+    )
+
+
+# ---------------------------------------------------------- load routing
+
+
+def test_route_load_picks_least_loaded_endpoint():
+    """--serve.route load: (re)connect probes every in-rotation
+    endpoint's S_INFO load report and dials the least-loaded — here the
+    SECOND endpoint, despite list order. Affinity after the pick is
+    unchanged (sticky)."""
+    s_a, s_b = _server(max_batch=4), _server(max_batch=4)
+    rs = np.random.RandomState(5)
+    obs = _rand_obs(rs)
+    rng = np.asarray(jax.random.PRNGKey(2))
+
+    # park two clients on A so its connection count is visibly higher
+    parked = [
+        RemotePolicyClient(f"127.0.0.1:{s_a.port}", SMALL, cooldown_s=0.2)
+        for _ in range(2)
+    ]
+
+    async def go():
+        for i, p in enumerate(parked):
+            await p.step(100 + i, obs, rng, episode_start=True)
+        c = RemotePolicyClient(
+            f"127.0.0.1:{s_a.port},127.0.0.1:{s_b.port}",
+            SMALL,
+            cooldown_s=0.2,
+            route="load",
+        )
+        r = await c.step(1, obs, rng, episode_start=True)
+        assert r.status == W.OK
+        assert c.addr == ("127.0.0.1", s_b.port), "load routing must pick the idle replica"
+        assert c.route_probes == 2 and c.route_picks == 1
+        # sticky thereafter: further steps probe nothing
+        await c.step(1, obs, r.rng)
+        assert c.route_probes == 2
+        await c.close()
+        for p in parked:
+            await p.close()
+
+    run(go())
+    s_a.stop()
+    s_b.stop()
+
+
+def test_server_info_reports_load():
+    s = _server(max_batch=2)
+    info = s.info()
+    load = info["load"]
+    assert set(load) >= {"clients", "occupancy", "pending", "capacity"}
+    assert load["capacity"] == 2 and load["clients"] == 0
+    s.stop()
+
+
+# ------------------------------------------------------------- inertness
+
+
+def test_default_off_inertness_subprocess():
+    """With handoff/resume/routing flags unset nothing changes: the
+    handoff module is never imported by a default server or client
+    process, the server builds no store, the client buffers nothing,
+    and step-request bytes are the PR-10 encoding (flags byte 0/1/2)."""
+    script = r"""
+import sys
+import numpy as np, jax
+from dotaclient_tpu.config import ActorConfig, InferenceConfig, PolicyConfig
+from dotaclient_tpu.serve.server import InferenceServer
+from dotaclient_tpu.serve.client import RemotePolicyClient, RemoteActor
+from dotaclient_tpu.serve import wire as W
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.transport.base import connect
+
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+icfg = InferenceConfig(policy=SMALL)
+assert icfg.serve.handoff_endpoint == ""
+server = InferenceServer(icfg)  # constructed, never started
+assert server._store is None
+acfg = ActorConfig(policy=SMALL)
+acfg.serve.endpoint = "127.0.0.1:9"
+actor = RemoteActor(acfg, connect("mem://inert_hoff"), actor_id=0, stub=object())
+assert actor._resume_armed is False and actor._chunk_obs == []
+assert actor.remote_policy._route == "order"
+obs = F.zeros_observation()
+rng = np.asarray(jax.random.PRNGKey(0))
+payload = W.encode_step_request(3, obs, rng, episode_start=True, want_carry=False)
+# flags byte (offset 8) carries only the PR-10 bits with defaults
+assert payload[8] == W.FLAG_EPISODE_START
+assert W.encode_step_request(3, obs, rng)[8] == 0
+offenders = [m for m in sys.modules if m == "dotaclient_tpu.serve.handoff"]
+assert not offenders, f"handoff imported with flags off: {offenders}"
+print("INERT_HOFF_OK")
+"""
+    from tests.conftest import clean_subprocess_env
+
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0 and "INERT_HOFF_OK" in proc.stdout, proc.stderr[-2000:]
+
+
+# --------------------------------------------------------- soak artifact
+
+
+def test_serve_handoff_soak_committed_artifact_verdict():
+    """Committed-artifact guard (the SERVE_CHAOS_SOAK pattern):
+    SERVE_HANDOFF_SOAK.json must exist with an all-green verdict — a
+    rolling restart across 2 replicas with ZERO abandoned episodes,
+    FULL-stream bitwise parity (vs the per-kill 100% abandons of
+    SERVE_CHAOS_SOAK.json phase 2), store-backed resumes, bounded p99
+    inside restart windows, and zero unaccounted frames."""
+    path = os.path.join(REPO_ROOT, "SERVE_HANDOFF_SOAK.json")
+    assert os.path.exists(path), "SERVE_HANDOFF_SOAK.json not committed"
+    artifact = json.load(open(path))
+    v = artifact["verdict"]
+    bad = [k for k, val in v.items() if isinstance(val, bool) and not val]
+    assert not bad, f"committed SERVE_HANDOFF_SOAK.json has red verdicts: {bad}"
+    assert v["server_kills_executed"] >= 4
+    p1 = artifact["phase_1_parity"]
+    assert p1["episodes_abandoned"] == 0
+    assert artifact["phase_2_conservation"]["episodes_abandoned"] == 0
+    assert p1["matched_frames_bitwise"] > 0
+    assert p1["episodes_resumed"] >= 1
+    lat = p1["latency"]
+    assert lat["p99_ms_during_restart_windows"] is not None
+    assert lat["p99_ms_during_restart_windows"] <= lat["budget_ms"]
+    assert artifact["conservation"]["unaccounted_frames"] == 0
+
+
+@pytest.mark.nightly
+@pytest.mark.slow  # tier-1 runs -m 'not slow', which would override the
+# nightly exclusion and pull this multi-minute closed loop into the gate
+def test_serve_handoff_soak_quick_rerun(tmp_path):
+    """Nightly: scripts/soak_serve_handoff.py --quick must reproduce the
+    committed artifact's invariants end-to-end on this host."""
+    from tests.conftest import clean_subprocess_env
+
+    out = tmp_path / "SERVE_HANDOFF_SOAK.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "soak_serve_handoff.py"),
+            "--quick",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=580,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    artifact = json.loads(out.read_text())
+    v = artifact["verdict"]
+    bad = [k for k, val in v.items() if isinstance(val, bool) and not val]
+    assert not bad, bad
+    assert artifact["conservation"]["unaccounted_frames"] == 0
+    assert artifact["phase_1_parity"]["episodes_abandoned"] == 0
