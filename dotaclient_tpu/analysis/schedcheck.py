@@ -63,6 +63,7 @@ __all__ = [
     "CoalesceModel",
     "HotSwapModel",
     "HandoffModel",
+    "ShardEpochModel",
 ]
 
 
@@ -977,6 +978,230 @@ class HandoffModel(_Model):
         return out
 
 
+# ------------------------------------------------------------ shard epoch
+
+
+class ShardEpochModel(_Model):
+    """The broker-fabric routing/failover lifecycle (transport/fabric.py
+    FabricBroker + ShardFence + the tcp priority admission):
+    route → publish → fence-check → apply.
+
+    One client publishes `chunks` trajectory chunks of one route key
+    (increasing seq; priority = seq+1 so later chunks rank higher —
+    enough to force priority-admission pressure). The key's rendezvous
+    primary is shard A; shard B is the failover successor, with a
+    bounded admission queue (cap_b). A chaos thread PARTITIONS A once
+    (publishes to it fail; frames it already holds are withheld — the
+    stale-shard limbo) and later RESURRECTS it (withheld frames start
+    delivering again — the late-delivery hazard the epoch fence exists
+    for). `land_on_partition` selects the partition's publish fate:
+    True = the frame lands but the ack is lost (the duplicate hazard),
+    False = the frame is lost with the ack (the liveness hazard) — HEAD
+    must explore clean under BOTH.
+
+    Protocol under test (the FabricBroker/ShardFence rules):
+    - a failed publish bumps the KEY's epoch BEFORE republishing the
+      same seq to the successor;
+    - the consumer fence drops epoch-stale arrivals (counted), dedupes
+      same-seq arrivals (counted), applies the rest;
+    - shard admission above capacity EVICTS the lowest-priority
+      resident (counted) rather than refusing the newcomer.
+
+    Invariants: no seq is ever applied twice (double-counted gradient
+    data); every attempted seq is accounted — applied, fence-dropped,
+    dup-dropped, priority-evicted, or shed with the client told
+    (refused) — never silently lost.
+
+    Mutants (each a real bug class the shipped protocol excludes):
+    - ``no_fence``: the consumer applies whatever arrives (no epoch
+      check, no seq dedup) — a resurrected A's late copy of a
+      republished chunk applies twice.
+    - ``reroute_before_drain``: the client re-routes the key to B
+      without first resolving (republishing) the nacked in-flight
+      chunk — that chunk vanishes with no ledger entry.
+    - ``shed_newest``: admission above capacity refuses the NEWCOMER
+      (the pre-fabric SHED) — a higher-priority chunk is shed while a
+      lower-priority resident survives, the inversion priority
+      admission exists to prevent.
+    """
+
+    threads = ("client", "net_a", "net_b", "chaos")
+
+    def __init__(
+        self,
+        chunks: int = 3,
+        cap_b: int = 1,
+        land_on_partition: bool = True,
+        mutant: Optional[str] = None,
+    ):
+        assert mutant in (None, "no_fence", "reroute_before_drain", "shed_newest")
+        self.chunks = chunks
+        self.cap_b = cap_b
+        self.land = land_on_partition
+        self.mutant = mutant
+
+    def init(self) -> dict:
+        return {
+            "a_q": (),  # (epoch, seq) frames resident in shard A
+            "b_q": (),  # (epoch, seq) frames resident in shard B
+            "a_part": False,  # A partitioned (publishes fail, delivery withheld)
+            "parts": 0,  # partitions executed (bounded to 1)
+            "c_seq": 0,  # next fresh chunk index
+            "c_epoch": 0,  # the key's publish epoch
+            "c_down_a": False,  # client-side failover belief
+            "pending": None,  # nacked seq awaiting republish
+            "acked": (),  # seqs the client got an ack for
+            "refused": (),  # seqs shed back to the client (it knows)
+            "evicted": (),  # seqs priority-evicted at admission
+            "f_epoch": 0,  # consumer fence: highest epoch seen
+            "applied": (),  # apply history (a seq twice = violation)
+            "fenced": (),  # epoch-stale drops
+            "dup": (),  # same-seq dedup drops
+            "violations": [],
+        }
+
+    # -- enabledness ---------------------------------------------------
+
+    def _client_done(self, st: dict) -> bool:
+        return st["c_seq"] >= self.chunks and st["pending"] is None
+
+    def enabled(self, st: dict, tid: str) -> bool:
+        if tid == "client":
+            return not self._client_done(st)
+        if tid == "net_a":
+            return bool(st["a_q"]) and not st["a_part"]
+        if tid == "net_b":
+            return bool(st["b_q"])
+        # chaos: one partition while the client still publishes, and the
+        # matching resurrection whenever A is partitioned
+        return (st["parts"] == 0 and not self._client_done(st)) or st["a_part"]
+
+    # -- transitions ---------------------------------------------------
+
+    def _apply(self, st: dict, epoch: int, seq: int) -> None:
+        """Consumer fence-check + apply for one delivered frame — the
+        ShardFence.admit rules (single producer boot)."""
+        if self.mutant != "no_fence":
+            if epoch < st["f_epoch"]:
+                st["fenced"] += (seq,)
+                return
+            st["f_epoch"] = max(st["f_epoch"], epoch)
+            if seq in st["applied"]:
+                st["dup"] += (seq,)
+                return
+        if seq in st["applied"]:
+            st["violations"].append(
+                f"chunk seq {seq} applied twice — a stale shard's late "
+                f"delivery was double-counted (the epoch-fence bug class)"
+            )
+        st["applied"] += (seq,)
+
+    def _publish_b(self, st: dict, seq: int) -> None:
+        """Publish (epoch, seq) to shard B with bounded priority
+        admission (priority = seq+1)."""
+        if len(st["b_q"]) >= self.cap_b:
+            if self.mutant == "shed_newest":
+                # the pre-fabric SHED: refuse the newcomer
+                resident_min = min(s for _, s in st["b_q"])
+                if seq > resident_min:
+                    st["violations"].append(
+                        f"admission shed chunk seq {seq} (priority {seq + 1}) "
+                        f"while lower-priority seq {resident_min} stayed "
+                        f"resident — the inversion priority-shed exists to "
+                        f"prevent"
+                    )
+                st["refused"] += (seq,)
+                st["pending"] = None
+                if seq == st["c_seq"]:
+                    st["c_seq"] += 1
+                return
+            # HEAD: evict the lowest-priority resident, admit the newcomer
+            evict_i = min(range(len(st["b_q"])), key=lambda i: st["b_q"][i][1])
+            evicted = st["b_q"][evict_i][1]
+            st["b_q"] = st["b_q"][:evict_i] + st["b_q"][evict_i + 1 :]
+            st["evicted"] += (evicted,)
+        st["b_q"] += ((st["c_epoch"], seq),)
+        st["acked"] += (seq,)
+        st["pending"] = None
+        if seq == st["c_seq"]:
+            st["c_seq"] += 1
+
+    def step(self, st: dict, tid: str) -> None:
+        if tid == "client":
+            seq = st["pending"] if st["pending"] is not None else st["c_seq"]
+            if not st["c_down_a"]:
+                if st["a_part"]:
+                    # publish into the partition: maybe lands, ack lost
+                    if self.land:
+                        st["a_q"] += ((st["c_epoch"], seq),)
+                    st["c_down_a"] = True
+                    if self.mutant == "reroute_before_drain":
+                        # the bug: move the key to B WITHOUT resolving
+                        # the nacked chunk — it simply vanishes
+                        st["pending"] = None
+                        if seq == st["c_seq"]:
+                            st["c_seq"] += 1
+                    else:
+                        # bump the epoch BEFORE the successor sees the
+                        # key, then republish the same seq
+                        st["c_epoch"] += 1
+                        st["pending"] = seq
+                else:
+                    st["a_q"] += ((st["c_epoch"], seq),)
+                    st["acked"] += (seq,)
+                    st["pending"] = None
+                    if seq == st["c_seq"]:
+                        st["c_seq"] += 1
+            else:
+                self._publish_b(st, seq)
+            return
+        if tid == "net_a":
+            (epoch, seq), st["a_q"] = st["a_q"][0], st["a_q"][1:]
+            self._apply(st, epoch, seq)
+            return
+        if tid == "net_b":
+            (epoch, seq), st["b_q"] = st["b_q"][0], st["b_q"][1:]
+            self._apply(st, epoch, seq)
+            return
+        # chaos
+        if st["a_part"]:
+            st["a_part"] = False  # resurrect: withheld frames deliver again
+        else:
+            st["a_part"] = True
+            st["parts"] += 1
+
+    def done(self, st: dict) -> bool:
+        return (
+            self._client_done(st)
+            and not st["a_q"]
+            and not st["b_q"]
+            and not st["a_part"]
+        )
+
+    def final_check(self, st: dict) -> List[str]:
+        out = []
+        for seq in range(self.chunks):
+            accounted = (
+                seq in st["applied"]
+                or seq in st["fenced"]
+                or seq in st["dup"]
+                or seq in st["evicted"]
+                or seq in st["refused"]
+            )
+            if not accounted:
+                out.append(
+                    f"chunk seq {seq} lost UNACCOUNTED — attempted but in no "
+                    f"ledger (applied/fenced/dup/evicted/refused): the "
+                    f"reroute-before-drain bug class"
+                )
+        for seq in set(st["applied"]):
+            # acked chunks the fence later dropped are counted losses;
+            # an applied chunk must still be unique (also inline-checked)
+            if st["applied"].count(seq) > 1:
+                out.append(f"chunk seq {seq} applied {st['applied'].count(seq)}x")
+        return out
+
+
 def head_models() -> Dict[str, _Model]:
     """The HEAD-protocol model set the nightly soak and the acceptance
     tests exhaust — one entry per protocol, no mutants."""
@@ -986,4 +1211,8 @@ def head_models() -> Dict[str, _Model]:
         "coalesce": CoalesceModel(versions=3),
         "hot_swap": HotSwapModel(swaps=2, ticks=2, rows=2),
         "carry_handoff": HandoffModel(steps=5, chunk=2, kills=2),
+        # both partition-publish fates: the frame lands with the ack
+        # lost (duplicate hazard) and the frame lost with it (liveness)
+        "shard_epoch": ShardEpochModel(chunks=3, land_on_partition=True),
+        "shard_epoch_lost": ShardEpochModel(chunks=3, land_on_partition=False),
     }

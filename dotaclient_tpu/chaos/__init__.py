@@ -61,6 +61,22 @@ def wrap_broker(broker, chaos_cfg, t0=None):
     """Broker decorator factory for the binaries: parse the spec once,
     wrap. Callers gate on cfg.chaos.enabled BEFORE importing this
     package (the inertness contract)."""
+    if hasattr(broker, "fanin_residual"):
+        # A broker FABRIC (transport/fabric.py) cannot be chaos-wrapped:
+        # ChaosBroker forwards only the base Broker surface, so the
+        # wrapper would silently strip quiesce/consume_residual/
+        # fanin_residual (the SIGTERM drain would declare victory over
+        # frames stranded in the fan-in queue — a zero-loss-contract
+        # violation, not a fault injection), fabric_stats, and the
+        # per-endpoint routing the actor throttle keys on. Inject
+        # faults into individual SHARDS instead (chaos-wrapped shard
+        # clients, or the fabric soak's BrokerIncarnations kills).
+        raise ValueError(
+            "chaos cannot wrap a broker fabric (comma --broker_url): the "
+            "wrapper would strip the fabric's drain/routing surface — "
+            "point chaos at individual shards or use the fabric soak's "
+            "shard-kill schedules instead"
+        )
     schedule = FaultSchedule.parse(chaos_cfg.spec, seed=chaos_cfg.seed)
     return ChaosBroker(broker, schedule, t0=t0)
 

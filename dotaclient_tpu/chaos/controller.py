@@ -47,10 +47,19 @@ _log = logging.getLogger(__name__)
 class BrokerIncarnations:
     """N sequential BrokerServer lives on one port, ledgers kept."""
 
-    def __init__(self, port: int = 0, maxlen: int = 4096, shed_high: int = 0, shed_low: int = 0):
+    def __init__(
+        self,
+        port: int = 0,
+        maxlen: int = 4096,
+        shed_high: int = 0,
+        shed_low: int = 0,
+        priority_shed: bool = False,
+    ):
         self.maxlen, self.shed_high, self.shed_low = maxlen, shed_high, shed_low
+        self.priority_shed = priority_shed
         self.server = BrokerServer(
-            port=port, maxlen=maxlen, shed_high=shed_high, shed_low=shed_low
+            port=port, maxlen=maxlen, shed_high=shed_high, shed_low=shed_low,
+            priority_shed=priority_shed,
         ).start()
         self.port = self.server.port
         self.ledgers: List[dict] = []  # one per DEAD incarnation
@@ -86,6 +95,7 @@ class BrokerIncarnations:
                         maxlen=self.maxlen,
                         shed_high=self.shed_high,
                         shed_low=self.shed_low,
+                        priority_shed=self.priority_shed,
                     ).start()
                     break
                 except (RuntimeError, OSError):
@@ -93,6 +103,31 @@ class BrokerIncarnations:
                         raise
                     time.sleep(0.1)
             self.restart_times.append(time.monotonic())
+
+    def wait_first_enqueue(self, timeout: float = 30.0, stop: Optional[threading.Event] = None):
+        """Monotonic time of the reborn incarnation's first post-boot
+        enqueue (None if none landed in time) — the broker recovery
+        probe: how long the fleet's jittered reconnect/backoff took to
+        actually land a frame in the reborn broker. Shared by the bare
+        kill path and the rolling executor."""
+        with self._lock:
+            server = self.server
+        if server is None:
+            return None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and (stop is None or not stop.is_set()):
+            t = server.first_enqueue_t
+            if t is not None:
+                return t
+            time.sleep(0.05)
+        return None
+
+    def replica_count(self) -> int:
+        """One controller = one broker replica; a shard ROUTER (e.g. the
+        fabric soak's round-robin over N BrokerIncarnations) reports N —
+        the rolling@T:P@broker execution contract, same shape as the
+        serve tier's."""
+        return 1
 
     def final_ledger(self) -> dict:
         """Stop the last incarnation (if live) and sum every life's
@@ -108,7 +143,7 @@ class BrokerIncarnations:
                 k: sum(l[k] for l in self.ledgers)
                 for k in (
                     "enqueued", "popped", "dropped_oldest", "shed",
-                    "shed_closes", "reply_lost", "resident",
+                    "shed_closes", "reply_lost", "evicted_low", "resident",
                 )
             }
             total["incarnations"] = len(self.ledgers)
@@ -481,32 +516,39 @@ class ScheduleRunner:
         return False
 
     def _run_rolling(self, k: int, ev) -> bool:
-        """Execute one rolling@T:P@server event: kill replica i, hold it
-        down P seconds, restart it, wait for its recovery probe, then
-        move to replica i+1 — sequential, so at most ONE replica is ever
-        down (the property the zero-abandon handoff soak rides on). The
-        controller's kill()/restart() rotation supplies the fan-out; a
-        bare ServeIncarnations rolls its single replica."""
-        count_fn = getattr(self.server_inc, "replica_count", None)
+        """Execute one rolling@T:P@server|broker event: kill replica i,
+        hold it down P seconds, restart it, wait for its recovery probe,
+        then move to replica i+1 — sequential, so at most ONE replica is
+        ever down (the property the zero-abandon handoff soak and the
+        fabric shard-kill soak both ride on). The controller's
+        kill()/restart() rotation supplies the fan-out; a bare
+        ServeIncarnations / BrokerIncarnations rolls its single
+        replica. Probe: first served step for the serve tier
+        (wait_first_request), first re-enqueued frame for a broker
+        shard (wait_first_enqueue)."""
+        inc = self.server_inc if ev.target == "server" else self.broker
+        count_fn = getattr(inc, "replica_count", None)
         n = int(count_fn()) if count_fn is not None else 1
-        probe = getattr(self.server_inc, "wait_first_request", None)
+        probe = getattr(inc, "wait_first_request", None) or getattr(
+            inc, "wait_first_enqueue", None
+        )
         for r in range(n):
-            self.server_inc.kill()
+            inc.kill()
             if not self._sleep_wall(ev.duration_s):
                 return False
-            self.server_inc.restart()
+            inc.restart()
             restarted = time.monotonic()
-            # Bounded probe: with session continuity on, clients resume
-            # onto the SURVIVOR, so the reborn replica legitimately
-            # idles until the next roll forces them back — a short probe
-            # keeps the roll moving and None is not an error here.
+            # Bounded probe: with session continuity (serve) or sibling
+            # shards (fabric), clients legitimately stay on the
+            # survivors — a short probe keeps the roll moving and None
+            # is not an error here.
             first = None
             if probe is not None:
                 first = probe(timeout=1.5, stop=self._stop)
             self.recovery.append(
                 {
                     "kill_index": k,
-                    "target": "server",
+                    "target": ev.target,
                     "kind": "rolling",
                     "replica": r,
                     "at_s": ev.at_s,
@@ -586,14 +628,8 @@ class ScheduleRunner:
             restarted = time.monotonic()
             # recovery probe: poll the reborn incarnation's first-enqueue
             # stamp for up to 30s (clients are backing off with jitter)
-            first = None
-            deadline = time.monotonic() + 30.0
-            while time.monotonic() < deadline and not self._stop.is_set():
-                t = self.broker.server.first_enqueue_t
-                if t is not None:
-                    first = t
-                    break
-                time.sleep(0.05)
+            probe = getattr(self.broker, "wait_first_enqueue", None)
+            first = probe(timeout=30.0, stop=self._stop) if probe is not None else None
             self.recovery.append(
                 {
                     "kill_index": k,

@@ -19,18 +19,23 @@ Timed one-shots (wall-clock offsets from the schedule epoch `t0`):
 
     stall@T:D       every broker op blocks for the window [T, T+D)
     rolling@T:P@server
-                    staggered sequential restarts across the serve
-                    tier's replicas, starting at T: kill replica 0,
-                    keep it down P seconds, restart it, wait for its
-                    recovery probe, then replica 1, and so on — at most
-                    ONE replica is ever down, the rolling-deploy shape.
-                    Executed by a ScheduleRunner whose server
+    rolling@T:P@broker
+                    staggered sequential restarts across a replicated
+                    tier, starting at T: kill replica 0, keep it down
+                    P seconds, restart it, wait for its recovery probe,
+                    then replica 1, and so on — at most ONE replica is
+                    ever down, the rolling-deploy shape. `server` rolls
+                    the serve tier (PR 13); `broker` rolls the broker
+                    fabric's shard fleet (transport/fabric.py — the
+                    shard-kill soak's at-most-one-shard-down arm).
+                    Executed by a ScheduleRunner whose matching
                     controller fans kills across replicas (a
                     replica_count()-bearing router, or a bare
-                    ServeIncarnations = 1 replica). The selector rides
-                    the ARG side like the kill targets, so existing
-                    specs parse byte-identically and no rate draw ever
-                    moves (the golden decision-sequence pin covers it).
+                    ServeIncarnations/BrokerIncarnations = 1 replica).
+                    The selector rides the ARG side like the kill
+                    targets, so existing specs parse byte-identically
+                    and no rate draw ever moves (the golden
+                    decision-sequence pin covers it).
     kill@T:D        kill the broker at T, restart it at T+D — executed
                     by a ScheduleRunner against a controller that owns
                     the broker process (chaos/controller.py), because a
@@ -125,13 +130,17 @@ class FaultSchedule:
                         )
                     target, _, sig_s = tail.partition(":")
                     if kind == "rolling":
-                        # rolling is a serve-tier shape: N replicas
-                        # behind one endpoint list; broker/learner are
-                        # singletons where rolling degenerates to kill.
-                        if target != "server" or sig_s:
+                        # rolling targets the two N-replica tiers: the
+                        # serve tier (PR 13) and the broker fabric's
+                        # shard fleet (transport/fabric.py — a shard
+                        # router with replica_count() fans the kills).
+                        # The learner stays a singleton where rolling
+                        # degenerates to kill.
+                        if target not in ("server", "broker") or sig_s:
                             raise ValueError(
-                                f"rolling restarts target the serve tier only "
-                                f"(rolling@T:P@server) in {clause!r}"
+                                f"rolling restarts target the serve tier or the "
+                                f"broker fabric (rolling@T:P@server|broker) in "
+                                f"{clause!r}"
                             )
                     elif target not in ("broker", "learner", "server"):
                         raise ValueError(f"unknown kill target {target!r} in {clause!r}")

@@ -488,6 +488,21 @@ class LearnerConfig:
     retry: RetryConfig = field(default_factory=RetryConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     broker_url: str = "mem://"
+    # Broker-fabric shard subset this learner consumes, as a comma-
+    # separated index list into the --broker_url shard list ("0,1").
+    # "" (default) = consume every shard. Only valid when --broker_url
+    # is itself a comma-separated shard list (transport/fabric.py); the
+    # multi-learner data-parallel fan-in assigns each learner a DISJOINT
+    # subset so the steady-state stream is partitioned exactly once.
+    # Known limitation (documented, bounded): a producer FAILOVER
+    # republish follows the key's rendezvous order, which can cross
+    # subset boundaries — each learner's fence is per-consumer, so the
+    # stale original and the republish can each train once, in
+    # DIFFERENT learners. This is the same rare at-least-once duplicate
+    # class the classic tcp reconnect resend has always had ("harmless
+    # to PPO", transport/tcp.py _Conn), at publish-failover frequency.
+    # Publishing (weight fanout) always reaches every shard regardless.
+    broker_shards: str = ""
     checkpoint_dir: str = ""
     # Remote checkpoint mirror (reference behavior: upload finished
     # checkpoints to object storage — SURVEY §3.4). Any epath scheme

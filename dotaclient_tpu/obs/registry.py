@@ -250,6 +250,19 @@ PREFIXES: Dict[str, str] = {
     # staging_pack_wall_s, staging_pack_rows_per_s (packer-proper rate).
     # The per-worker tail is why this is a family, not exact names.
     "staging_pack_": "parallel host feed scoreboard (sharded pack pool + transfer ring)",
+    # broker-fabric fan-in consumer (transport/fabric.py FabricBroker,
+    # emitted by the learner loop only when --broker_url is a shard
+    # list): fanin_queue_depth, fanin_delivered_total,
+    # fanin_fence_dropped_total (epoch-stale deliveries dropped — the
+    # stale-shard-resurrection proof counter), fanin_dup_dropped_total,
+    # fanin_pop_threads, fanin_keys_tracked,
+    # fanin_publish_failovers_total, fanin_publish_failed_total.
+    "fanin_": "broker-fabric fan-in consumer ledgers (transport/fabric.py)",
+    # per-shard fabric meters: broker_shard_<i>_popped_total,
+    # broker_shard_<i>_starved_s (pop thread idle/backing off against
+    # shard i — a starving shard index is the page), broker_shard_<i>_up.
+    # A family because the tail is the shard index.
+    "broker_shard_": "per-shard broker-fabric health (transport/fabric.py)",
     # broker admission control + actor publish degradation:
     # broker_shed_observed_total, broker_shed_publish_failed_total,
     # broker_shed_throttle_s (runtime/actor.py ShedThrottle /

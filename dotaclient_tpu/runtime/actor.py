@@ -155,45 +155,89 @@ class ShedThrottle:
     retry — by the time an overloaded broker would accept it the chunk
     is staler (and the learner's staleness filter or the drop-oldest
     eviction would eat it anyway); what matters is that the PRODUCER
-    slows down, which the awaited backoff does. Backoff resets on the
-    first accepted publish. One instance per publishing agent; counters
-    feed the broker_shed_* scalars (obs/registry.py).
+    slows down, which the backoff does. Backoff resets on the first
+    accepted publish. One instance per publishing agent; counters feed
+    the broker_shed_* scalars (obs/registry.py).
+
+    Backoff state is PER ENDPOINT (the broker-fabric surgery): against
+    a routing broker (one exposing `route_endpoint`, transport/fabric),
+    a shed/failure arms a not-before stamp for THAT shard only, paid
+    just before the next publish that routes there — so one shedding
+    shard never pauses publishes to healthy shards (regression-pinned
+    in tests/test_fabric.py with two in-process brokers). Against a
+    classic single broker there is no routing key: the one shared
+    ladder pays its backoff immediately, byte-for-byte the pre-fabric
+    behavior.
     """
 
     def __init__(self, retry: Optional[RetryPolicy] = None):
         self.retry = retry if retry is not None else RetryPolicy()
-        self._backoff = self.retry.backoff_base_s
+        # endpoint key (None = the classic unrouted broker) → ladder
+        # position / earliest next publish to that endpoint.
+        self._backoff: dict = {}
+        self._not_before: dict = {}
         self.published = 0
         self.shed = 0
         self.failed = 0
         self.throttle_s = 0.0
 
-    async def publish(self, broker: Broker, data: bytes) -> bool:
-        """True = accepted; False = shed/failed (chunk dropped, backoff
-        paid). Raising is reserved for programming errors — transport
-        failure must degrade the actor, not kill it (the broker outlives
-        no one in the k8s model; an actor that dies on every broker
-        hiccup turns one restart into a fleet crashloop)."""
+    def _endpoint_key(self, broker: Broker, data: bytes):
+        route = getattr(broker, "route_endpoint", None)
+        if route is None:
+            return None
         try:
-            broker.publish_experience(data)
-        except BrokerShedError:
+            return route(data)
+        except Exception:  # routing must never break publishing
+            return None
+
+    async def publish(
+        self, broker: Broker, data: bytes, priority: Optional[float] = None
+    ) -> bool:
+        """True = accepted; False = shed/failed (chunk dropped, backoff
+        paid/armed). Raising is reserved for programming errors —
+        transport failure must degrade the actor, not kill it (the
+        broker outlives no one in the k8s model; an actor that dies on
+        every broker hiccup turns one restart into a fleet crashloop).
+        `priority` is the |TD-error| admission stamp, forwarded when the
+        broker wants it (fabric priority-shed admission)."""
+        key = self._endpoint_key(broker, data)
+        pending = self._not_before.get(key, 0.0) - time.monotonic()
+        if pending > 0:
+            # this endpoint's armed backoff comes due now — healthy
+            # endpoints' publishes never enter this branch
+            self.throttle_s += pending
+            await asyncio.sleep(pending)
+        try:
+            if priority is not None and getattr(broker, "wants_priority", False):
+                broker.publish_experience_prioritized(data, priority)
+            else:
+                broker.publish_experience(data)
+        except BrokerShedError as e:
             self.shed += 1
-            await self._pay_backoff()
+            await self._pay_backoff(getattr(e, "endpoint", key))
             return False
         except (ConnectionError, OSError) as e:
             self.failed += 1
             _log.warning("publish failed (%s: %s); dropping chunk and backing off", type(e).__name__, e)
-            await self._pay_backoff()
+            await self._pay_backoff(key)
             return False
         self.published += 1
-        self._backoff = self.retry.backoff_base_s
+        self._backoff.pop(key, None)
+        self._not_before.pop(key, None)
         return True
 
-    async def _pay_backoff(self) -> None:
-        delay = self.retry.sleep_for(self._backoff)
-        self._backoff = self.retry.next_backoff(self._backoff)
-        self.throttle_s += delay
-        await asyncio.sleep(delay)
+    async def _pay_backoff(self, key) -> None:
+        backoff = self._backoff.get(key, self.retry.backoff_base_s)
+        delay = self.retry.sleep_for(backoff)
+        self._backoff[key] = self.retry.next_backoff(backoff)
+        if key is None:
+            # classic broker: the pre-fabric immediate await
+            self.throttle_s += delay
+            await asyncio.sleep(delay)
+        else:
+            # routed broker: arm the endpoint's not-before; the next
+            # publish routed THERE pays it, siblings stay at full rate
+            self._not_before[key] = time.monotonic() + delay
 
     def stats(self) -> dict:
         return {
@@ -201,6 +245,34 @@ class ShedThrottle:
             "broker_shed_publish_failed_total": float(self.failed),
             "broker_shed_throttle_s": self.throttle_s,
         }
+
+
+# Discount used for the publish-time |TD-error| admission priority. The
+# stamp is a RANKING heuristic consumed by the fabric shards' priority
+# shed (transport/fabric.py), not a loss term — the PPOConfig default is
+# close enough that actors need not carry the learner's gamma.
+_PRIORITY_GAMMA = 0.98
+
+
+def rollout_priority_fn(broker: Broker):
+    """The publish-time priority stamp, resolved ONCE at agent boot:
+    None against classic brokers (no replay import, zero per-chunk
+    work); against a fabric broker (`wants_priority`), the PR-1
+    |TD-error| priority computed from the chunk the agent just built —
+    the producer holds the arrays, so the transport never parses a
+    frame to rank it."""
+    if not getattr(broker, "wants_priority", False):
+        return None
+    from dotaclient_tpu.replay import td_error_priority
+
+    def fn(rollout: Rollout) -> float:
+        return float(
+            td_error_priority(
+                rollout.rewards, rollout.behavior_value, rollout.dones, _PRIORITY_GAMMA
+            )
+        )
+
+    return fn
 
 
 def connect_env_async(cfg: ActorConfig) -> AsyncDotaServiceStub:
@@ -464,6 +536,8 @@ class Actor:
         # frames, no ml_dtypes import on the publish path.
         wire_cfg = getattr(cfg, "wire", None)
         self._wire_cast = wire_cast_fn(wire_cfg.obs_dtype if wire_cfg is not None else "f32")
+        # Fabric priority stamp (None against classic brokers).
+        self._priority_fn = rollout_priority_fn(broker)
         self.obs = self._make_obs_runtime()
         # ±1 result of the last finished episode, 0.0 for a decided draw
         # (episode ended with no winning team), None while in flight or
@@ -636,9 +710,16 @@ class Actor:
                 # Cast-at-source wire quantization (identity under the
                 # default f32), then shed/failed publishes drop the chunk
                 # and pay a jittered backoff (ShedThrottle docstring);
-                # the episode continues.
+                # the episode continues. Against a fabric broker the
+                # publish carries the |TD-error| admission priority.
                 if await self.publish_throttle.publish(
-                    self.broker, serialize_rollout(self._wire_cast(rollout))
+                    self.broker,
+                    serialize_rollout(self._wire_cast(rollout)),
+                    priority=(
+                        self._priority_fn(rollout)
+                        if self._priority_fn is not None
+                        else None
+                    ),
                 ):
                     self.rollouts_published += 1
                 state, chunk = next_chunk(cfg.policy, state)

@@ -1099,6 +1099,15 @@ class Learner:
                     scalars["wire_bytes_consumed_total"] = stats["wire_bytes"]
                     scalars["wire_frames_obs_bf16_total"] = stats["wire_frames_obs_bf16"]
                     scalars["wire_frames_obs_f32_total"] = stats["wire_frames_obs_f32"]
+                    # Broker-fabric scoreboard (broker_shard_* / fanin_*
+                    # registry prefix families): per-shard pop/starve
+                    # meters and the fence/dedup ledgers. Pure local
+                    # counters (no RPC); present only when --broker_url
+                    # is a shard list, so classic runs emit nothing new.
+                    fabric_stats = getattr(self.broker, "fabric_stats", None)
+                    if fabric_stats is not None:
+                        for k, v in fabric_stats().items():
+                            scalars[k] = float(v)
                     # Parallel host feed scoreboard (staging_pack_*,
                     # registry prefix family): per-worker busy/stall
                     # seconds, ring occupancy/wait, packer-proper rows/s.
@@ -1203,6 +1212,20 @@ def main(argv=None):
     from dotaclient_tpu.transport.base import RetryPolicy
 
     broker = broker_connect(cfg.broker_url, retry=RetryPolicy.from_config(cfg.retry))
+    if cfg.broker_shards:
+        # Multi-learner fan-in (--broker_shards "0,1"): pin this learner
+        # to a disjoint shard subset of the fabric. Only meaningful
+        # against a shard-list broker_url — anything else is a deploy
+        # mistake that must fail boot loudly, not silently consume the
+        # whole queue.
+        restrict = getattr(broker, "restrict_consume_shards", None)
+        if restrict is None:
+            raise ValueError(
+                f"--broker_shards={cfg.broker_shards!r} needs a broker fabric "
+                f"(comma-separated --broker_url shard list); got "
+                f"{cfg.broker_url!r}"
+            )
+        restrict([int(s) for s in cfg.broker_shards.split(",") if s.strip()])
     if cfg.chaos.enabled:
         # Gated import — chaos off means the package never loads and the
         # broker is the production object (tests/test_chaos.py).

@@ -125,6 +125,13 @@ class SelfPlayActor:
         # the default --wire.obs_dtype f32).
         wire_cfg = getattr(cfg, "wire", None)
         self._wire_cast = wire_cast_fn(wire_cfg.obs_dtype if wire_cfg is not None else "f32")
+        # Fabric priority stamp, same resolution as Actor (None against
+        # classic brokers) — without it, self-play chunks would publish
+        # at priority 0 and be the FIRST evicted by every shard's
+        # priority shed, silently starving the league of its own data.
+        from dotaclient_tpu.runtime.actor import rollout_priority_fn
+
+        self._priority_fn = rollout_priority_fn(broker)
         # Same opt-in trace stamping as Actor (runtime/actor.py): None
         # when --obs.enabled is off, and frames stay legacy DTR1.
         from dotaclient_tpu.obs import ObsRuntime
@@ -187,7 +194,13 @@ class SelfPlayActor:
         if self.obs is not None:
             rollout = self.obs.stamp(rollout, self.actor_id)
         try:
-            self.broker.publish_experience(serialize_rollout(self._wire_cast(rollout)))
+            data = serialize_rollout(self._wire_cast(rollout))
+            if self._priority_fn is not None:
+                self.broker.publish_experience_prioritized(
+                    data, self._priority_fn(rollout)
+                )
+            else:
+                self.broker.publish_experience(data)
             self.rollouts_published += 1
         except BrokerShedError:
             # Admission refusal: drop the chunk and continue the episode.

@@ -618,18 +618,47 @@ class StagingBuffer:
                     continue
             self._packing = False  # batch visible in _ready (or dead with _stop)
 
+    def _drain_residual(self, max_items: int, sink) -> None:
+        """Quiesced-mode residual drain shared by the classic consumer
+        and the pool-mode pop thread: fetch the fabric fan-in residual
+        (already-popped frames) and hand it to `sink`, pacing the loop
+        in place of the consume timeout. _popping makes the locals-held
+        residual visible to drained() — between the fabric queue and the
+        sink the frames live only in this thread's locals. The flag
+        region covers ONLY the non-blocking fetch+sink — the pacing
+        sleep must run with it clear, or the drain's drained() polls
+        livelock against a flag that is true for 99% of every loop
+        iteration."""
+        with self._mutate_lock:
+            self._popping = True
+        try:
+            frames = self._residual_frames(max_items)
+            if frames:
+                sink(frames)
+        finally:
+            with self._mutate_lock:
+                self._popping = False
+        if frames is None:
+            time.sleep(0.02)
+
     def _run(self) -> None:
         """Classic single consumer thread (pack_workers=1): pop → parse →
         pack, all here — byte-for-byte the pre-pool behavior."""
         B = self.cfg.batch_size
+
+        def _ingest_sink(frames):
+            with self._mutate_lock:
+                self._ingest(frames)
+
         while not self._stop.is_set():
             try:
                 if self._quiesce.is_set():
-                    # Draining: no new broker pops; pack out what is
-                    # already pending, pace the loop in place of the
-                    # consume timeout.
+                    # Draining: no new broker pops; ingest any fabric
+                    # fan-in residual (already-popped frames) and pack
+                    # out what is pending (flag/pacing protocol in
+                    # _drain_residual).
+                    self._drain_residual(B, _ingest_sink)
                     frames = None
-                    time.sleep(0.02)
                 else:
                     frames = self.broker.consume_experience(max_items=B, timeout=0.2)
                 if frames:
@@ -653,10 +682,23 @@ class StagingBuffer:
         The intake bound (4 drains) is the backpressure that stops an
         outrun learner from buffering the broker into learner RAM."""
         B = self.cfg.batch_size
+
+        def _intake_sink(frames):
+            while not self._stop.is_set():
+                try:
+                    self._intake.put(frames, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
         while not self._stop.is_set():
             try:
                 if self._quiesce.is_set():
-                    time.sleep(0.02)
+                    # Same residual drain as the classic consumer: the
+                    # fabric's already-popped frames flow on to the
+                    # intake queue; the assembler ingests them as usual
+                    # (flag/pacing protocol in _drain_residual).
+                    self._drain_residual(B, _intake_sink)
                     continue
                 with self._mutate_lock:
                     # drained() must account a drain held in this
@@ -666,12 +708,7 @@ class StagingBuffer:
                 try:
                     frames = self.broker.consume_experience(max_items=B, timeout=0.2)
                     if frames:
-                        while not self._stop.is_set():
-                            try:
-                                self._intake.put(frames, timeout=0.2)
-                                break
-                            except queue.Full:
-                                continue
+                        _intake_sink(frames)
                 finally:
                     with self._mutate_lock:
                         self._popping = False
@@ -1257,9 +1294,29 @@ class StagingBuffer:
             restored_reservoir = self._reservoir.restore(snap["reservoir"])
         return {"pending": len(restored), "reservoir": restored_reservoir}
 
+    def _residual_frames(self, max_items: int):
+        """Quiesced-intake residual: frames a fabric broker's fan-in pop
+        threads already took OFF the shards before quiesce landed
+        (transport/fabric.py consume_residual). They are POPPED frames —
+        the PR-7 zero-loss drain contract owns them — so the quiesced
+        consumer keeps ingesting them instead of new broker pops. None
+        on classic brokers (no such station exists)."""
+        residual = getattr(self.broker, "consume_residual", None)
+        if residual is None:
+            return None
+        frames = residual(max_items)
+        return frames or None
+
     def quiesce(self) -> None:
         """Stop popping the broker; keep packing already-pending frames.
-        The SIGTERM drain's first act — see _quiesce in __init__."""
+        The SIGTERM drain's first act — see _quiesce in __init__. A
+        fabric broker quiesces WITH us (its shard pop threads stop
+        pulling new frames), and its already-popped residual is drained
+        through _residual_frames so no popped frame strands between the
+        shards and staging."""
+        broker_quiesce = getattr(self.broker, "quiesce", None)
+        if broker_quiesce is not None:
+            broker_quiesce()
         self._quiesce.set()
 
     def drained(self) -> bool:
@@ -1277,6 +1334,13 @@ class StagingBuffer:
         # Check stations UPSTREAM-first — frames only move downstream
         # (pop → intake → pending → in-flight pack → ready), so a frame
         # crossing a boundary mid-check is seen at the later station.
+        # A fabric broker adds the MOST upstream station: frames its
+        # fan-in threads popped off the shards before quiesce (they are
+        # popped — the zero-loss contract owns them; the quiesced
+        # consumer drains them via _residual_frames).
+        fanin_residual = getattr(self.broker, "fanin_residual", None)
+        if fanin_residual is not None and fanin_residual():
+            return False
         with self._mutate_lock:
             if self._popping:
                 return False

@@ -111,7 +111,18 @@ def connect(url: str, retry: Optional[RetryPolicy] = None, **kw) -> Broker:
     """`retry` is the shared RetryPolicy for transports with a reconnect
     loop (tcp://; rmq uses its window for op-level retries). mem:// has
     no connection to retry, so the kwarg is accepted-and-ignored there —
-    binaries pass one policy regardless of scheme."""
+    binaries pass one policy regardless of scheme.
+
+    A COMMA-SEPARATED list of urls is the broker fabric (N shards behind
+    a consistent-hash router with epoch-fenced failover —
+    transport/fabric.py). Gated IMPORT, the chaos/serve precedent: a
+    single-endpoint url never loads the fabric module, so the default
+    deployment is byte-for-byte the classic single-broker path
+    (subprocess inertness proof in tests/test_fabric.py)."""
+    if "," in url:
+        from dotaclient_tpu.transport.fabric import FabricBroker, parse_fabric_endpoints
+
+        return FabricBroker(parse_fabric_endpoints(url), retry=retry, **kw)
     if url.startswith("mem://"):
         from dotaclient_tpu.transport.memory import MemoryBroker
 

@@ -1,0 +1,701 @@
+"""Broker fabric: N experience-broker shards behind a consistent-hash
+router, with epoch-fenced failover, in-shard priority admission, and a
+multi-shard fan-in consumer.
+
+AGGREGATE_SOAK measured the pre-fabric topology — 64 senders into ONE
+broker into ONE learner: kill that broker and every actor backs off
+while the learner starves until restart. The fabric removes the
+singleton (ROADMAP item 2, grounded in "Accelerating Distributed Deep
+RL by In-Network Experience Sampling", arXiv 2110.13506):
+
+- ROUTING: `--broker_url` grows to a comma-separated shard list
+  ("tcp://h1:p1,tcp://h2:p2,..."). Every chunk of one trajectory is
+  pinned to ONE shard by rendezvous (HRW) hashing of its route key —
+  the actor_id stamped in the frame header
+  (transport/serialize.peek_rollout_actor_id), so pinning needs no
+  client-side session state and any process computes the same route.
+- EPOCH-FENCED FAILOVER: each published frame travels in a small fabric
+  envelope (key, boot, epoch, seq). When a shard publish fails past its
+  (short) failover window, the client bumps the KEY's epoch, re-routes
+  to the next shard in that key's rendezvous order, and republishes the
+  SAME seq under the new epoch. The consumer-side fence then guarantees
+  a chunk is applied at most once no matter how a stale shard
+  resurrects:
+    * boot newer  → new producer incarnation: reset the key, deliver;
+    * boot older  → stale incarnation: fence-drop;
+    * epoch older → late delivery from a shard the key failed away
+      from: fence-drop (counted — the soak's resurrection phase proves
+      this counter fires);
+    * seq already applied (epoch >= current) → duplicate republish
+      whose first copy made it after all: dup-drop.
+  A fence-dropped frame is a COUNTED loss (same ledger class as the tcp
+  broker's reply_lost), never a silent one: per-shard-generation
+  conservation is popped = delivered + fence_dropped + dup_dropped.
+- PRIORITY ADMISSION: publishes carry the PR-1 |TD-error| priority
+  (stamped by the actor, which has the rollout arrays in hand) via the
+  tcp PUB_EXPP op; a shard running `--priority` admission EVICTS its
+  lowest-effective-priority resident (age-decayed, the reservoir's
+  half-life rule) instead of refusing the newcomer — SHED sheds the
+  least valuable frame, not the newest (transport/tcp.py).
+- FAN-IN: the learner side runs one pop thread per consumed shard, each
+  feeding one bounded fan-in queue the staging consumer drains —
+  per-shard starvation/depth meters, and `consume_shards` restricts a
+  learner to a disjoint shard subset for multi-learner data-parallel
+  fan-in (LearnerConfig.broker_shards). The fence's at-most-once is
+  PER CONSUMER: in disjoint multi-learner mode a failover republish
+  that crosses subset boundaries can train once in each of two
+  learners — the same rare at-least-once duplicate class as the
+  classic tcp resend (see LearnerConfig.broker_shards), accepted
+  rather than hidden behind a shared-fence service this PR does not
+  build.
+
+Inertness: a single-endpoint `--broker_url` never reaches this module
+(transport/base.connect imports it only for comma lists), so the
+default deployment is byte-for-byte the classic path — proven by a
+subprocess test in tests/test_fabric.py.
+
+Shard binary: `python -m dotaclient_tpu.transport.fabric` runs one
+shard (a BrokerServer with the priority-admission flags) — what the
+k8s/broker.yaml StatefulSet pods run, one shard per pod behind per-pod
+DNS (the PR-10 affinity precedent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import queue
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from dotaclient_tpu.transport.base import (
+    Broker,
+    BrokerShedError,
+    RetryPolicy,
+    connect as _connect,
+)
+from dotaclient_tpu.transport.serialize import peek_rollout_actor_id
+
+_log = logging.getLogger(__name__)
+
+FABRIC_MAGIC = b"FAB1"
+# magic | u32 route key | u64 boot | u32 epoch | u32 seq, then the
+# payload (a DTR1/2/3 frame, untouched). 24 bytes against ~1.4 KB
+# frames; stripped by the fan-in before staging ever sees the bytes.
+# boot is MILLISECONDS since the epoch in a u64: the fence orders
+# producer incarnations by it, so it must be strictly increasing across
+# realistic restarts (a same-SECOND supervisor restart is routine; a
+# same-millisecond one is not) and must never wrap (u32 ms would every
+# ~49 days — a wrapped boot would fence a healthy producer forever).
+# The residual exposure is a wall clock stepped backwards between
+# restarts: the new incarnation's frames fence-drop (counted, metered)
+# until the clock passes the old stamp — bounded and self-healing.
+_ENV = struct.Struct("<4sIQII")
+
+# Seq-dedup window per key: a republish only ever duplicates the most
+# recent unacked chunks, so a small window is exact in practice; frames
+# older than the window are fence-dropped (counted), never double-applied.
+FENCE_WINDOW = 512
+
+
+def wrap_fabric(payload: bytes, key: int, boot: int, epoch: int, seq: int) -> bytes:
+    return _ENV.pack(FABRIC_MAGIC, key & 0xFFFFFFFF, boot & 0xFFFFFFFFFFFFFFFF, epoch, seq) + payload
+
+
+def peek_fabric(data: bytes) -> Optional[Tuple[int, int, int, int]]:
+    """(key, boot, epoch, seq) for an enveloped frame, None otherwise —
+    un-enveloped frames (a classic producer publishing straight at one
+    shard) pass the fan-in through unfenced."""
+    if len(data) < _ENV.size or data[:4] != FABRIC_MAGIC:
+        return None
+    _, key, boot, epoch, seq = _ENV.unpack_from(data)
+    return key, boot, epoch, seq
+
+
+def strip_fabric(data: bytes) -> bytes:
+    return data[_ENV.size :]
+
+
+def parse_fabric_endpoints(url: str) -> List[str]:
+    """Validate and split a comma-separated broker shard list. Loud on
+    malformed input — a mistyped shard list must fail the binary at
+    boot, not quietly shrink the fabric (the PR-10 parse_endpoints
+    discipline)."""
+    parts = [p.strip() for p in url.split(",")]
+    if any(not p for p in parts) or len(parts) < 2:
+        raise ValueError(f"malformed broker shard list {url!r}")
+    for p in parts:
+        if not (p.startswith("tcp://") or p.startswith("mem://") or p.startswith("amqp://")):
+            raise ValueError(f"shard {p!r} has no broker url scheme in {url!r}")
+    if len(set(parts)) != len(parts):
+        raise ValueError(f"duplicate shard endpoint in {url!r}")
+    return parts
+
+
+def rendezvous_order(key: int, endpoints: List[str]) -> List[int]:
+    """Shard preference order for a route key — rendezvous (highest-
+    random-weight) hashing: shard i's score is a stable hash of
+    (key, endpoint string), so every process computes the same order,
+    removing one endpoint never re-routes keys between the survivors
+    (the consistent-hash property), and the failover successor is
+    simply the next index in this order."""
+    return sorted(
+        range(len(endpoints)),
+        key=lambda i: zlib.crc32(f"{key}|{endpoints[i]}".encode()),
+        reverse=True,
+    )
+
+
+class ShardFence:
+    """Consumer-side epoch fence + seq dedup (module docstring rules).
+    One lock over the per-key table — fan-in pop threads from different
+    shards can race on the same key exactly when a failover is in
+    flight, which is the moment the fence exists for."""
+
+    def __init__(self, window: int = FENCE_WINDOW):
+        self.window = window
+        self._lock = threading.Lock()
+        self._keys: Dict[int, dict] = {}
+        self.fence_dropped = 0  # stale boot/epoch or beyond-window deliveries
+        self.dup_dropped = 0  # same-seq duplicates (republish + original both landed)
+        self.delivered = 0
+
+    def admit(self, key: int, boot: int, epoch: int, seq: int) -> bool:
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None or boot > st["boot"]:
+                # first sight, or a restarted producer: new seq space
+                st = {"boot": boot, "epoch": epoch, "max_seq": -1, "seen": set()}
+                self._keys[key] = st
+            elif boot < st["boot"]:
+                self.fence_dropped += 1
+                return False
+            if epoch < st["epoch"]:
+                # late delivery from a shard this key failed away from —
+                # the resurrection-phase proof counter
+                self.fence_dropped += 1
+                return False
+            st["epoch"] = epoch
+            if seq in st["seen"]:
+                self.dup_dropped += 1
+                return False
+            if seq <= st["max_seq"] - self.window:
+                # beyond the dedup window: cannot prove it is not a
+                # duplicate — the conservative side is drop-and-count
+                self.fence_dropped += 1
+                return False
+            st["seen"].add(seq)
+            if seq > st["max_seq"]:
+                st["max_seq"] = seq
+            floor = st["max_seq"] - self.window
+            if len(st["seen"]) > self.window:
+                st["seen"] = {s for s in st["seen"] if s > floor}
+            self.delivered += 1
+            return True
+
+    def keys_tracked(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+
+class FabricBroker(Broker):
+    """The sharded-transport client: router on the publish side, fenced
+    fan-in on the consume side. One object serves both roles (actors
+    never consume, learners rarely publish experience), so
+    transport/base.connect stays role-agnostic."""
+
+    def __init__(
+        self,
+        endpoints: List[str],
+        retry: Optional[RetryPolicy] = None,
+        consume_shards: Optional[List[int]] = None,
+        failover_window_s: float = 2.0,
+        cooldown_s: float = 5.0,
+        fanin_depth: int = 4096,
+        pop_batch: int = 64,
+        **shard_kw,
+    ):
+        if len(endpoints) < 2:
+            raise ValueError("FabricBroker needs >= 2 shard endpoints")
+        self.endpoints = list(endpoints)
+        base = retry if retry is not None else RetryPolicy()
+        # Per-shard clients reconnect-retry only within the FAILOVER
+        # window — a shard down longer than this is the router's problem
+        # (re-route + epoch bump), not the socket's.
+        self._shard_retry = RetryPolicy(
+            window_s=min(base.window_s, failover_window_s),
+            backoff_base_s=base.backoff_base_s,
+            backoff_cap_s=base.backoff_cap_s,
+            jitter=base.jitter,
+        )
+        self._shard_kw = shard_kw
+        self.cooldown_s = cooldown_s
+        self._pop_batch = pop_batch
+        self._shards: List[Optional[Broker]] = [None] * len(endpoints)
+        self._down_until = [0.0] * len(endpoints)
+        self._shard_lock = threading.Lock()
+        # Producer identity: boot stamps the incarnation in WALL-CLOCK
+        # MILLISECONDS (a restarted actor must not be fenced by its
+        # predecessor's epoch, and supervisor restarts within one
+        # second are routine — seconds resolution collided there);
+        # epoch/seq are per route key.
+        self._boot = int(time.time() * 1000)
+        self._pub_lock = threading.Lock()
+        self._key_state: Dict[int, dict] = {}  # key -> {"epoch", "seq"}
+        # Publish meters (broker_shard_* / fanin_* scalar families).
+        self.published_total = 0
+        self.failovers_total = 0
+        self.publish_failed_total = 0
+        self.shed_observed = 0
+        self.last_publish_endpoint: Optional[str] = None
+        # Fan-in (consumer side), built lazily on first consume.
+        self.consume_shards = (
+            sorted(set(consume_shards)) if consume_shards is not None else None
+        )
+        self._fence = ShardFence()
+        self._fanin: "queue.Queue" = queue.Queue(maxsize=fanin_depth)
+        self._stop = threading.Event()
+        self._quiesce = threading.Event()
+        self._pop_threads: List[threading.Thread] = []
+        self._fanin_started = False
+        self._fanin_lock = threading.Lock()
+        self._shard_popped = [0] * len(endpoints)
+        self._shard_starved_s = [0.0] * len(endpoints)
+        self._mid_pop = [False] * len(endpoints)
+        self._meters_lock = threading.Lock()
+
+    # ------------------------------------------------------------ shards
+
+    def _my_shards(self) -> List[int]:
+        return (
+            self.consume_shards
+            if self.consume_shards is not None
+            else list(range(len(self.endpoints)))
+        )
+
+    def restrict_consume_shards(self, shards: List[int]) -> None:
+        """Pin this consumer to a disjoint shard subset (multi-learner
+        fan-in; LearnerConfig.broker_shards). Must run before the first
+        consume — the pop threads are built from this list."""
+        with self._fanin_lock:
+            if self._fanin_started:
+                raise RuntimeError("restrict_consume_shards after fan-in started")
+            bad = [s for s in shards if not 0 <= s < len(self.endpoints)]
+            if bad or not shards:
+                raise ValueError(
+                    f"broker_shards {shards} out of range for "
+                    f"{len(self.endpoints)} endpoints"
+                )
+            self.consume_shards = sorted(set(shards))
+
+    def _shard(self, i: int) -> Broker:
+        """The live client for shard i, rebuilt after cooldown. Raises
+        ConnectionError while the shard sits out its cooldown."""
+        with self._shard_lock:
+            b = self._shards[i]
+            if b is not None:
+                return b
+            if time.monotonic() < self._down_until[i]:
+                raise ConnectionError(f"shard {self.endpoints[i]} cooling down")
+        # dial OUTSIDE the lock: a slow connect must not serialize every
+        # other shard's traffic behind it
+        nb = _connect(self.endpoints[i], retry=self._shard_retry, **self._shard_kw)
+        with self._shard_lock:
+            if self._shards[i] is None:
+                self._shards[i] = nb
+            else:  # lost the rebuild race; keep the winner
+                try:
+                    nb.close()
+                except Exception:
+                    pass
+            return self._shards[i]
+
+    def _mark_down(self, i: int) -> None:
+        with self._shard_lock:
+            b, self._shards[i] = self._shards[i], None
+            self._down_until[i] = time.monotonic() + self.cooldown_s
+        if b is not None:
+            try:
+                b.close()
+            except Exception:
+                pass
+
+    def _shard_up(self, i: int) -> bool:
+        with self._shard_lock:
+            return self._shards[i] is not None or time.monotonic() >= self._down_until[i]
+
+    # ----------------------------------------------------------- publish
+
+    @property
+    def wants_priority(self) -> bool:
+        """Producers that can compute the |TD-error| stamp cheaply (the
+        actor, which holds the rollout arrays) should pass it to
+        publish_experience — it drives the in-shard priority admission."""
+        return True
+
+    def _route_key(self, data: bytes) -> int:
+        key = peek_rollout_actor_id(data)
+        if key is None:
+            # non-rollout payloads (tests, foreign frames) still route
+            # deterministically — hash the head bytes
+            key = zlib.crc32(data[:64])
+        return key
+
+    def route_endpoint(self, data: bytes) -> str:
+        """The endpoint this frame would be published to right now —
+        the actor's per-endpoint ShedThrottle keys its backoff on this,
+        so one shedding shard never pauses publishes to healthy ones."""
+        key = self._route_key(data)
+        for i in rendezvous_order(key, self.endpoints):
+            if self._shard_up(i):
+                return self.endpoints[i]
+        return self.endpoints[rendezvous_order(key, self.endpoints)[0]]
+
+    def publish_experience(self, data: bytes, priority: float = 0.0) -> None:
+        """Route → envelope → publish, failing over with an epoch bump.
+        BrokerShedError is NOT failover (the shard is alive and asked
+        for less) — it propagates with `.endpoint` set so the throttle
+        can back off that shard alone.
+
+        _pub_lock guards ONLY the per-key epoch/seq mutations, never
+        the network I/O: a multi-threaded publisher (the ActorPool
+        drivers) must not queue healthy-shard publishes behind another
+        thread's failover dials — the exact head-of-line blocking the
+        per-endpoint ShedThrottle exists to prevent, one layer down.
+        Concurrent same-key publishes (which one env's trajectory never
+        produces) at worst fence an acked frame that raced an epoch
+        bump — a counted loss, never a duplicate."""
+        key = self._route_key(data)
+        with self._pub_lock:
+            st = self._key_state.setdefault(key, {"epoch": 0, "seq": 0})
+            seq = st["seq"]
+            st["seq"] += 1
+            epoch = st["epoch"]
+        order = rendezvous_order(key, self.endpoints)
+        last_error: Optional[Exception] = None
+        hops = 0
+        for i in order:
+            if not self._shard_up(i):
+                continue
+            frame = wrap_fabric(data, key, self._boot, epoch, seq)
+            try:
+                shard = self._shard(i)
+                pub = getattr(shard, "publish_experience_prioritized", None)
+                if pub is not None:
+                    pub(frame, priority)
+                else:
+                    shard.publish_experience(frame)
+                self.published_total += 1
+                self.failovers_total += hops
+                self.last_publish_endpoint = self.endpoints[i]
+                return
+            except BrokerShedError as e:
+                self.shed_observed += 1
+                e.endpoint = self.endpoints[i]
+                raise
+            except (ConnectionError, OSError) as e:
+                # Failover: this shard is unreachable past the failover
+                # window. Bump the key's epoch BEFORE the next hop so
+                # any copy the dead shard still holds is fenced at the
+                # consumer — republishing under the same epoch is the
+                # double-apply bug the ShardEpochModel's no_fence
+                # mutant re-introduces. advance-only under the lock: a
+                # concurrent failover on the same key must never roll
+                # the epoch back.
+                last_error = e
+                self._mark_down(i)
+                with self._pub_lock:
+                    st["epoch"] = max(st["epoch"], epoch + 1)
+                    epoch = st["epoch"]
+                hops += 1
+        self.publish_failed_total += 1
+        raise ConnectionError(
+            f"all {len(self.endpoints)} broker shards unreachable"
+        ) from last_error
+
+    def publish_experience_prioritized(self, data: bytes, priority: float) -> None:
+        self.publish_experience(data, priority=priority)
+
+    # ----------------------------------------------------------- consume
+
+    def _ensure_fanin(self) -> None:
+        with self._fanin_lock:
+            if self._fanin_started:
+                return
+            self._fanin_started = True
+            for i in self._my_shards():
+                t = threading.Thread(
+                    target=self._pop_loop, args=(i,), daemon=True, name=f"fabric-pop-{i}"
+                )
+                self._pop_threads.append(t)
+                t.start()
+
+    def _pop_loop(self, i: int) -> None:
+        """One shard's fan-in pop thread: drain shard i into the shared
+        queue through the fence. A dead shard costs THIS thread backoff
+        time (metered as starvation); the siblings keep the learner fed
+        — the whole point of the fabric."""
+        backoff = self._shard_retry.backoff_base_s
+        while not self._stop.is_set():
+            if self._quiesce.is_set():
+                time.sleep(0.05)
+                continue
+            if not self._shard_up(i):
+                # sit out the cooldown WITHOUT dialing: calling _shard()
+                # here would raise, and marking down on that raise would
+                # re-arm the cooldown every retry — a resurrection-proof
+                # livelock (a reborn shard could never rejoin rotation;
+                # caught by the soak's phase-2 fence arm)
+                with self._meters_lock:
+                    self._shard_starved_s[i] += 0.1
+                self._stop.wait(0.1)
+                continue
+            t0 = time.monotonic()
+            with self._meters_lock:
+                self._mid_pop[i] = True
+            try:
+                try:
+                    frames = self._shard(i).consume_experience(
+                        max_items=self._pop_batch, timeout=0.2
+                    )
+                except (ConnectionError, OSError, ValueError):
+                    self._mark_down(i)
+                    with self._meters_lock:
+                        self._shard_starved_s[i] += time.monotonic() - t0
+                    # jittered, capped — the PR-6 fleet-lockstep lesson
+                    self._stop.wait(self._shard_retry.sleep_for(backoff))
+                    backoff = self._shard_retry.next_backoff(backoff)
+                    continue
+                backoff = self._shard_retry.backoff_base_s
+                if not frames:
+                    with self._meters_lock:
+                        self._shard_starved_s[i] += time.monotonic() - t0
+                    continue
+                with self._meters_lock:
+                    self._shard_popped[i] += len(frames)
+                for f in frames:
+                    env = peek_fabric(f)
+                    if env is not None:
+                        if not self._fence.admit(*env):
+                            continue
+                        f = f[_ENV.size :]
+                    while not self._stop.is_set():
+                        try:
+                            self._fanin.put(f, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+            finally:
+                with self._meters_lock:
+                    self._mid_pop[i] = False
+
+    def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
+        self._ensure_fanin()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[bytes] = []
+        while len(out) < max_items:
+            if out:
+                wait = 0.0  # first frame landed: drain without waiting
+            elif deadline is None:
+                wait = 0.2
+            else:
+                wait = max(0.0, deadline - time.monotonic())
+            try:
+                out.append(self._fanin.get(timeout=min(wait, 0.2) if wait else 0.0))
+            except queue.Empty:
+                if out:
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+        return out
+
+    def consume_residual(self, max_items: int) -> List[bytes]:
+        """Non-blocking drain of frames ALREADY popped off the shards
+        (the fan-in queue). The SIGTERM drain path: staging quiesces the
+        fabric (no new shard pops) and then drains this residual so a
+        popped frame is never stranded between the shard and staging —
+        the PR-7 zero-loss contract extended one station upstream."""
+        out: List[bytes] = []
+        while len(out) < max_items:
+            try:
+                out.append(self._fanin.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def quiesce(self) -> None:
+        """Stop popping the shards; already-popped frames stay readable
+        via consume_residual. Idempotent, thread-safe (an event set)."""
+        self._quiesce.set()
+
+    def fanin_residual(self) -> int:
+        """Frames popped off the shards but not yet handed to staging:
+        the fan-in queue plus any pop thread mid-pop (its drain lives in
+        thread locals between the shard read and the queue put — the
+        staging `_popping` visibility pattern). drained() treats a
+        nonzero here as not-drained."""
+        with self._meters_lock:
+            mid = sum(1 for m in self._mid_pop if m)
+        return self._fanin.qsize() + mid
+
+    # ----------------------------------------------------------- weights
+
+    def publish_weights(self, data: bytes) -> None:
+        """Fan OUT to every shard: actors poll whichever shard answers
+        first, so each must hold the latest frame. Best-effort per
+        shard; raises only when no shard accepted."""
+        ok = 0
+        last_error: Optional[Exception] = None
+        for i in range(len(self.endpoints)):
+            if not self._shard_up(i):
+                continue
+            try:
+                self._shard(i).publish_weights(data)
+                ok += 1
+            except (ConnectionError, OSError) as e:
+                last_error = e
+                self._mark_down(i)
+        if ok == 0:
+            raise ConnectionError("weight publish reached no broker shard") from last_error
+
+    def poll_weights(self) -> Optional[bytes]:
+        """Poll the first healthy shard (stable order — per-shard seq
+        high-water marks live in the shard clients). After a failover
+        the new shard may re-deliver an already-applied version;
+        apply_weight_frame's version/epoch rules make that a no-op."""
+        last_error: Optional[Exception] = None
+        for i in range(len(self.endpoints)):
+            if not self._shard_up(i):
+                continue
+            try:
+                return self._shard(i).poll_weights()
+            except (ConnectionError, OSError) as e:
+                last_error = e
+                self._mark_down(i)
+        if last_error is not None:
+            raise ConnectionError("no broker shard reachable for weights") from last_error
+        return None
+
+    # ------------------------------------------------------------- misc
+
+    def experience_depth(self) -> int:
+        """Sum of reachable shard depths (scrape-path use — this is an
+        RPC per shard; the hot loop never calls it)."""
+        total = 0
+        for i in self._my_shards():
+            if not self._shard_up(i):
+                continue
+            try:
+                d = self._shard(i).experience_depth()
+                if d >= 0:
+                    total += d
+            except (ConnectionError, OSError):
+                self._mark_down(i)
+        return total
+
+    def shard_stats(self, i: int) -> dict:
+        """Shard i's server-side counters (STATS2 when the shard client
+        speaks it, STATS otherwise) — the soak's remote ledger read."""
+        shard = self._shard(i)
+        fn = getattr(shard, "stats2", None) or getattr(shard, "stats", None)
+        if fn is None:
+            return {}
+        return fn()
+
+    def fabric_stats(self) -> Dict[str, float]:
+        """The broker_shard_* / fanin_* scalar families (obs/registry):
+        pure local counters — no RPC, safe in the learner metrics
+        window."""
+        with self._meters_lock:
+            popped = list(self._shard_popped)
+            starved = list(self._shard_starved_s)
+        out: Dict[str, float] = {
+            "fanin_queue_depth": float(self._fanin.qsize()),
+            "fanin_delivered_total": float(self._fence.delivered),
+            "fanin_fence_dropped_total": float(self._fence.fence_dropped),
+            "fanin_dup_dropped_total": float(self._fence.dup_dropped),
+            "fanin_pop_threads": float(len(self._pop_threads)),
+            "fanin_keys_tracked": float(self._fence.keys_tracked()),
+            "fanin_publish_failovers_total": float(self.failovers_total),
+            "fanin_publish_failed_total": float(self.publish_failed_total),
+        }
+        for i in self._my_shards():
+            out[f"broker_shard_{i}_popped_total"] = float(popped[i])
+            out[f"broker_shard_{i}_starved_s"] = round(starved[i], 3)
+            out[f"broker_shard_{i}_up"] = 1.0 if self._shard_up(i) else 0.0
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._pop_threads:
+            t.join(timeout=5)
+        with self._shard_lock:
+            shards, self._shards = list(self._shards), [None] * len(self.endpoints)
+        for b in shards:
+            if b is not None:
+                try:
+                    b.close()
+                except Exception:
+                    pass
+
+
+# ------------------------------------------------------------------ binary
+
+
+def main(argv=None):
+    """One fabric shard: a BrokerServer with the priority-admission
+    flags. The k8s/broker.yaml StatefulSet runs one of these per pod."""
+    from dotaclient_tpu.transport.tcp import BrokerServer
+
+    p = argparse.ArgumentParser(description="dotaclient-tpu broker fabric shard")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=13370)
+    p.add_argument("--maxlen", type=int, default=8192, help="experience queue bound (drop-oldest)")
+    p.add_argument(
+        "--shed_high", type=int, default=0,
+        help="admission-control high watermark (0 = admission control off)",
+    )
+    p.add_argument(
+        "--shed_low", type=int, default=0,
+        help="low watermark: resume admitting at this depth (hysteresis)",
+    )
+    p.add_argument(
+        "--priority", type=lambda s: s.lower() in ("1", "true", "yes", "on"),
+        default=False,
+        help="priority admission: a shedding-window prioritized publish "
+        "evicts the lowest-effective-priority resident instead of being "
+        "refused (PUB_EXPP; classic publishes are unaffected)",
+    )
+    p.add_argument(
+        "--prio_half_life_s", type=float, default=8.0,
+        help="age half-life of the eviction priority decay, seconds",
+    )
+    args = p.parse_args(argv)
+    server = BrokerServer(
+        args.host,
+        args.port,
+        args.maxlen,
+        shed_high=args.shed_high,
+        shed_low=args.shed_low,
+        priority_shed=args.priority,
+        prio_half_life_s=args.prio_half_life_s,
+    ).start()
+    shed = f", shed {args.shed_high}/{args.shed_low}" if args.shed_high else ""
+    prio = ", priority admission" if args.priority else ""
+    print(
+        f"fabric shard listening on {args.host}:{server.port} "
+        f"(queue bound {args.maxlen}{shed}{prio})",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

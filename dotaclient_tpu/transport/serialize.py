@@ -145,6 +145,24 @@ def check_dtr3_dtype_map(data: bytes) -> Optional[str]:
     return None
 
 
+def peek_rollout_actor_id(data: bytes) -> Optional[int]:
+    """Constant-time header peek of the actor_id a rollout frame was
+    stamped with (None for short/foreign frames) — the broker fabric's
+    routing key (transport/fabric.py): every chunk of one trajectory
+    carries one actor_id, so hashing it pins the whole trajectory to one
+    shard. The field sits at the same offset in all three layouts
+    (DTR1/2/3 share the _HDR prefix)."""
+    if len(data) < _HDR.size or data[:4] not in (
+        _ROLLOUT_MAGIC,
+        _ROLLOUT_MAGIC2,
+        _ROLLOUT_MAGIC3,
+    ):
+        return None
+    # _HDR = <4sIHHBIf: magic(4) version(4) L(2) H(2) flags(1) actor_id(4)
+    (actor_id,) = struct.unpack_from("<I", data, 13)
+    return actor_id
+
+
 def wire_obs_is_bf16(data: bytes) -> bool:
     """True iff `data` is a DTR3 frame shipping its float obs leaves as
     bf16 (map code 3 at entry 0). Cheap per-frame meter for the staging

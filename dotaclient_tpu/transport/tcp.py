@@ -65,8 +65,25 @@ _TYPE = struct.Struct("<B")
 PUB_EXP, CONSUME, PUB_W, GET_W, DEPTH, STATS, PUB_EXP2 = (
     0x01, 0x02, 0x03, 0x04, 0x05, 0x06, 0x07,
 )
-R_ACK, R_CONSUME, R_GET_W, R_DEPTH, R_SHED, R_STATS = (
-    0x81, 0x82, 0x84, 0x85, 0x86, 0x87,
+# Priority-aware publish + extended stats (the broker-fabric admission
+# surface, transport/fabric.py):
+#   0x08 PUB_EXPP  payload = f32 priority + frame   → 0x81 ack | 0x86 shed
+#   0x09 STATS2    no payload                       → 0x88 reply (u32 x8:
+#        depth, dropped, shed, enqueued, popped, reply_lost, evicted_low,
+#        priority_mode)
+# With --priority admission on, a PUB_EXPP arriving while the shed
+# hysteresis is engaged EVICTS the lowest-effective-priority resident
+# frame instead of refusing the newcomer — the PR-1 reservoir's
+# |TD-error|/age priority moved into the transport: priority decays with
+# residence age (half-life prio_half_life_s), so a stale high-TD chunk
+# eventually loses to a fresh mediocre one. The newcomer is still SHED
+# when it cannot beat the resident minimum. Old clients never send 0x08
+# and keep the exact pre-fabric behavior; an old broker receiving 0x08
+# kills the connection (unknown type) — upgrade brokers first, the
+# PUB_EXP2 precedent (MIGRATION item 14).
+PUB_EXPP, STATS2 = 0x08, 0x09
+R_ACK, R_CONSUME, R_GET_W, R_DEPTH, R_SHED, R_STATS, R_STATS2 = (
+    0x81, 0x82, 0x84, 0x85, 0x86, 0x87, 0x88,
 )
 
 MAX_FRAME = 256 * 1024 * 1024
@@ -86,6 +103,8 @@ class BrokerServer:
         maxlen: int = 4096,
         shed_high: int = 0,
         shed_low: int = 0,
+        priority_shed: bool = False,
+        prio_half_life_s: float = 8.0,
     ):
         if shed_high and shed_low >= shed_high:
             raise ValueError(
@@ -95,6 +114,18 @@ class BrokerServer:
         self.host, self.port, self.maxlen = host, port, maxlen
         self.shed_high, self.shed_low = shed_high, shed_low
         self._shedding = False
+        # Priority admission (the broker-fabric shard mode): maintain a
+        # parallel (priority, enqueue_time) deque in lockstep with
+        # `experience` so a shedding-window PUB_EXPP can evict the
+        # lowest-effective-priority resident instead of refusing the
+        # newcomer. Off (default) = byte-identical classic behavior and
+        # ZERO per-publish extra work.
+        self.priority_shed = priority_shed
+        self.prio_half_life_s = prio_half_life_s
+        self._prio_meta: Optional[collections.deque] = (
+            collections.deque(maxlen=maxlen) if priority_shed else None
+        )
+        self.evicted_low = 0  # residents evicted to admit a higher priority
         self.experience: collections.deque = collections.deque(maxlen=maxlen)
         self.dropped = 0
         # Conservation-ledger counters (loop-thread-written; cross-thread
@@ -154,24 +185,68 @@ class BrokerServer:
             self._shedding = False
         return not self._shedding
 
+    def _min_priority_index(self, now: float):
+        """(index, effective priority) of the lowest-effective-priority
+        resident — the eviction candidate. Effective priority decays by
+        residence age (half-life prio_half_life_s): the |TD-error|/age
+        rule the replay reservoir applies, moved to admission. Called
+        under the cond; O(depth) only while the hysteresis sheds."""
+        best_i, best_p = -1, float("inf")
+        for i, (p, t_enq) in enumerate(self._prio_meta):
+            eff = p * 0.5 ** ((now - t_enq) / max(self.prio_half_life_s, 1e-9))
+            if eff < best_p:
+                best_i, best_p = i, eff
+        return best_i, best_p
+
+    def _enqueue(self, frame: bytes, priority: float) -> None:
+        """Append one admitted frame (caller holds the cond). The two
+        deques share one maxlen, so a drop-oldest evicts both heads in
+        lockstep and the priority metadata never misaligns."""
+        if len(self.experience) == self.experience.maxlen:
+            self.dropped += 1
+        self.experience.append(frame)
+        if self._prio_meta is not None:
+            self._prio_meta.append((priority, time.monotonic()))
+        self.enqueued_total += 1
+        if self.first_enqueue_t is None:
+            self.first_enqueue_t = time.monotonic()
+
     async def _dispatch(self, mtype: int, payload: bytes, writer: asyncio.StreamWriter):
         assert self._cond is not None
-        if mtype in (PUB_EXP, PUB_EXP2):
+        if mtype in (PUB_EXP, PUB_EXP2, PUB_EXPP):
+            priority = 0.0
+            if mtype == PUB_EXPP:
+                if len(payload) < 4:
+                    raise ValueError("PUB_EXPP payload shorter than its priority prefix")
+                (priority,) = struct.unpack_from("<f", payload)
+                payload = payload[4:]
             async with self._cond:
                 admitted = self._admit()
+                if (
+                    not admitted
+                    and mtype == PUB_EXPP
+                    and self._prio_meta is not None
+                    and self.experience
+                ):
+                    # Priority admission: SHED evicts the lowest-
+                    # effective-priority resident instead of refusing the
+                    # newcomer — unless the newcomer can't beat the
+                    # resident minimum, in which case refusing IT is the
+                    # priority-correct shed.
+                    idx, min_eff = self._min_priority_index(time.monotonic())
+                    if idx >= 0 and priority > min_eff:
+                        del self.experience[idx]
+                        del self._prio_meta[idx]
+                        self.evicted_low += 1
+                        admitted = True
                 if admitted:
-                    if len(self.experience) == self.experience.maxlen:
-                        self.dropped += 1
-                    self.experience.append(payload)
-                    self.enqueued_total += 1
-                    if self.first_enqueue_t is None:
-                        self.first_enqueue_t = time.monotonic()
+                    self._enqueue(payload, priority)
                     self._cond.notify_all()
                 else:
                     self.shed_total += 1
             if admitted:
                 await self._reply(writer, R_ACK, b"")
-            elif mtype == PUB_EXP2:
+            elif mtype in (PUB_EXP2, PUB_EXPP):
                 await self._reply(writer, R_SHED, b"")
             else:
                 # Legacy client: it cannot parse 0x86 (its reply
@@ -198,6 +273,8 @@ class BrokerServer:
                 frames = []
                 while self.experience and len(frames) < max_items:
                     frames.append(self.experience.popleft())
+                    if self._prio_meta is not None:
+                        self._prio_meta.popleft()
                 self.popped_total += len(frames)
             out = [struct.pack("<H", len(frames))]
             for f in frames:
@@ -225,6 +302,25 @@ class BrokerServer:
                     self.enqueued_total,
                     self.popped_total,
                     self.reply_lost_frames,
+                ),
+            )
+        elif mtype == STATS2:
+            # Fabric-era stats: R_STATS stays byte-identical for old
+            # clients (extending its payload would break their fixed
+            # "<6I" unpack); new counters ride a NEW reply type.
+            await self._reply(
+                writer,
+                R_STATS2,
+                struct.pack(
+                    "<8I",
+                    len(self.experience),
+                    self.dropped,
+                    self.shed_total,
+                    self.enqueued_total,
+                    self.popped_total,
+                    self.reply_lost_frames,
+                    self.evicted_low,
+                    1 if self.priority_shed else 0,
                 ),
             )
         elif mtype == PUB_W:
@@ -309,8 +405,11 @@ class BrokerServer:
         """Conservation-counter snapshot. Exact only AFTER stop() has
         joined the loop thread (the soak's post-mortem read); while the
         server is live it is a monotonic best-effort gauge. The identity
-        `enqueued == popped + dropped + resident` holds at any quiescent
-        point — scripts/chaos_soak.py asserts it per broker incarnation."""
+        `enqueued == popped + dropped + evicted_low + resident` holds at
+        any quiescent point (evicted_low is 0 outside priority-shed
+        mode, so the classic chaos_soak identity is unchanged) —
+        scripts/chaos_soak.py and scripts/soak_broker_fabric.py assert
+        it per broker incarnation."""
         return {
             "enqueued": self.enqueued_total,
             "popped": self.popped_total,
@@ -318,6 +417,7 @@ class BrokerServer:
             "shed": self.shed_total,
             "shed_closes": self.shed_closes,
             "reply_lost": self.reply_lost_frames,
+            "evicted_low": self.evicted_low,
             "resident": len(self.experience),
         }
 
@@ -483,6 +583,21 @@ class TcpBroker(Broker):
             self.shed_observed += 1
             raise
 
+    def publish_experience_prioritized(self, data: bytes, priority: float) -> None:
+        """PUB_EXPP: publish with an admission priority (the broker
+        fabric's |TD-error| stamp). Against a priority-shed broker a
+        shedding-window publish evicts the lowest-priority resident
+        instead of being refused; against a classic-admission broker the
+        priority is carried but ignored (identical to
+        publish_experience). Requires a fabric-era broker — an old one
+        kills the connection on the unknown op (broker-first upgrade,
+        MIGRATION item 14)."""
+        try:
+            self._exp.request(PUB_EXPP, struct.pack("<f", priority) + data, R_ACK)
+        except BrokerShedError:
+            self.shed_observed += 1
+            raise
+
     def consume_experience(self, max_items: int, timeout: Optional[float] = None) -> List[bytes]:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
@@ -547,6 +662,26 @@ class TcpBroker(Broker):
             "enqueued": enqueued,
             "popped": popped,
             "reply_lost": reply_lost,
+        }
+
+    def stats2(self) -> dict:
+        """Fabric-era counters (R_STATS2): stats() plus the priority-
+        admission eviction ledger. Only valid against a fabric-era
+        broker — an old one kills the connection on the unknown op."""
+        payload = self._w.request(STATS2, b"", R_STATS2)
+        assert payload is not None
+        (depth, dropped, shed, enqueued, popped, reply_lost, evicted, prio) = (
+            struct.unpack("<8I", payload)
+        )
+        return {
+            "depth": depth,
+            "dropped_oldest": dropped,
+            "shed": shed,
+            "enqueued": enqueued,
+            "popped": popped,
+            "reply_lost": reply_lost,
+            "evicted_low": evicted,
+            "priority_mode": prio,
         }
 
     def close(self) -> None:

@@ -130,6 +130,9 @@ def test_golden_decision_sequence_pinned():
     # the PR-13 rolling-restart grammar is ARG-side too: zero rate draws
     assert seq(_GOLDEN_SPEC + ",rolling@6:1@server") == _GOLDEN_SEQ
     assert seq(_GOLDEN_SPEC + ",rolling@2:0.5@server,kill@9:2@server,rolling@15:1@server") == _GOLDEN_SEQ
+    # and the broker-fabric rolling target (PR 14): still ARG-side only
+    assert seq(_GOLDEN_SPEC + ",rolling@4:1@broker") == _GOLDEN_SEQ
+    assert seq(_GOLDEN_SPEC + ",rolling@2:0.5@broker,kill@9:2@broker,rolling@15:1@server") == _GOLDEN_SEQ
     # latency draw position pinned too (it follows the five rate draws)
     s = FaultSchedule.parse(_GOLDEN_SPEC + ",kill@9:1@learner", seed=3)
     assert round(s.decide(0).latency_s, 9) == 0.00253577
@@ -137,24 +140,80 @@ def test_golden_decision_sequence_pinned():
 
 
 def test_rolling_grammar_parses_and_rejects():
-    """rolling@T:P@server — staggered sequential serve-replica restarts.
-    The selector is server-only (broker/learner are singletons where
-    rolling degenerates to kill), bare form defaults to server, and
-    kills() returns rolling events (they are kill-class work for the
+    """rolling@T:P@server|broker — staggered sequential restarts across
+    a replicated tier (the serve tier, or the broker fabric's shard
+    fleet). The learner is a singleton where rolling degenerates to
+    kill and stays rejected; bare form defaults to server, and kills()
+    returns rolling events (they are kill-class work for the
     ScheduleRunner)."""
     s = FaultSchedule.parse("rolling@5:1.5@server,kill@10:2", seed=0)
     ev, kv = s.kills()
     assert (ev.kind, ev.at_s, ev.duration_s, ev.target) == ("rolling", 5.0, 1.5, "server")
     assert kv.kind == "kill" and kv.target == "broker"
     assert FaultSchedule.parse("rolling@1:2", seed=0).kills()[0].target == "server"
+    # the PR-14 broker-fabric target
+    bv = FaultSchedule.parse("rolling@3:1@broker", seed=0).kills()[0]
+    assert (bv.kind, bv.target, bv.at_s, bv.duration_s) == ("rolling", "broker", 3.0, 1.0)
     for bad in (
-        "rolling@1:2@broker",
         "rolling@1:2@learner",
         "rolling@1:2@server:term",
+        "rolling@1:2@broker:term",
         "stall@1:2@server",
     ):
         with pytest.raises(ValueError):
             FaultSchedule.parse(bad)
+
+
+def test_rolling_broker_runner_routes_to_broker_controller_with_probe():
+    """rolling@T:P@broker fans kill→down→restart→probe across the BROKER
+    controller's replicas (a replica_count() router over fabric shards,
+    or a bare BrokerIncarnations = 1), using the first-enqueue probe —
+    and refuses to start with no broker controller at all."""
+    import time as _time
+
+    from dotaclient_tpu.chaos.controller import ScheduleRunner
+
+    class ShardRouter:
+        def __init__(self, n):
+            self.n = n
+            self.kills = []
+            self.restarts = []
+            self.probes = 0
+
+        def replica_count(self):
+            return self.n
+
+        def kill(self):
+            self.kills.append(_time.monotonic())
+
+        def restart(self):
+            self.restarts.append(_time.monotonic())
+
+        def wait_first_enqueue(self, timeout=30.0, stop=None):
+            self.probes += 1
+            return _time.monotonic()
+
+    router = ShardRouter(3)
+    runner = ScheduleRunner(
+        FaultSchedule.parse("rolling@0.02:0.03@broker", seed=0),
+        broker=router,
+        t0=_time.monotonic(),
+    ).start()
+    deadline = _time.monotonic() + 10
+    while len(router.restarts) < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    runner.stop()
+    assert len(router.kills) == 3 and len(router.restarts) == 3
+    assert router.probes == 3
+    assert [e["replica"] for e in runner.recovery] == [0, 1, 2]
+    assert all(e["kind"] == "rolling" and e["target"] == "broker" for e in runner.recovery)
+    for i in range(2):
+        assert router.restarts[i] <= router.kills[i + 1], "two shards down at once"
+
+    with pytest.raises(ValueError, match="broker"):
+        ScheduleRunner(
+            FaultSchedule.parse("rolling@1:1@broker", seed=0), broker=None, t0=0.0
+        )
 
 
 def test_rolling_runner_fans_kills_across_replicas_sequentially():

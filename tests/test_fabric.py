@@ -1,0 +1,573 @@
+"""Broker-fabric tests (dotaclient_tpu/transport/fabric.py): routing
+determinism + trajectory pinning, epoch-fenced failover end-to-end over
+real tcp shards (incl. a stale-shard resurrection fenced, never
+double-delivered), in-shard priority admission, per-endpoint
+ShedThrottle backoff (one shedding shard never pauses healthy ones),
+multi-learner disjoint fan-in, the SIGTERM-drain residual station,
+default-config inertness, and the committed soak artifact guard +
+nightly --quick wrapper."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dotaclient_tpu.transport import memory as mem
+from dotaclient_tpu.transport.base import BrokerShedError, RetryPolicy, connect
+from dotaclient_tpu.transport.fabric import (
+    FabricBroker,
+    ShardFence,
+    parse_fabric_endpoints,
+    peek_fabric,
+    rendezvous_order,
+    strip_fabric,
+    wrap_fabric,
+)
+from dotaclient_tpu.transport.serialize import (
+    peek_rollout_actor_id,
+    serialize_rollout,
+)
+from dotaclient_tpu.transport.tcp import BrokerServer, TcpBroker
+from tests.conftest import clean_subprocess_env
+from tests.test_transport import make_rollout
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST = RetryPolicy(window_s=0.4, backoff_base_s=0.01, backoff_cap_s=0.05, jitter=0.0)
+
+
+def _fabric(urls, **kw):
+    kw.setdefault("retry", FAST)
+    kw.setdefault("failover_window_s", 0.4)
+    kw.setdefault("cooldown_s", 0.5)
+    return FabricBroker(urls, **kw)
+
+
+# ---------------------------------------------------------------- routing
+
+
+def test_parse_fabric_endpoints_valid_and_loud_on_malformed():
+    assert parse_fabric_endpoints("tcp://a:1, tcp://b:2") == ["tcp://a:1", "tcp://b:2"]
+    for bad in (
+        "tcp://a:1",  # one endpoint is the classic path, not a fabric
+        "tcp://a:1,",  # empty element
+        "tcp://a:1,b:2",  # missing scheme
+        "tcp://a:1,tcp://a:1",  # duplicate shard
+    ):
+        with pytest.raises(ValueError):
+            parse_fabric_endpoints(bad)
+
+
+def test_rendezvous_routing_is_deterministic_and_consistent():
+    eps = ["tcp://h1:1", "tcp://h2:2", "tcp://h3:3", "tcp://h4:4"]
+    for key in range(200):
+        order = rendezvous_order(key, eps)
+        assert order == rendezvous_order(key, eps)
+        assert sorted(order) == [0, 1, 2, 3]
+    # the consistent-hash property: removing one endpoint only re-routes
+    # the keys whose primary it was
+    moved = 0
+    for key in range(200):
+        before = rendezvous_order(key, eps)[0]
+        survivors = eps[:3]
+        after = survivors[rendezvous_order(key, survivors)[0]]
+        if eps[before] != after:
+            moved += 1
+            assert before == 3  # only keys whose primary was removed move
+    assert 0 < moved < 200
+
+
+def test_envelope_roundtrip_and_peek():
+    payload = b"DTR1" + bytes(40)
+    env = wrap_fabric(payload, key=7, boot=123, epoch=2, seq=9)
+    assert peek_fabric(env) == (7, 123, 2, 9)
+    assert strip_fabric(env) == payload
+    assert peek_fabric(payload) is None  # un-enveloped passes through
+
+
+def test_boot_stamp_is_u64_milliseconds():
+    """The incarnation stamp must survive values past 2^32 (it is
+    wall-clock MILLISECONDS in a u64 — seconds resolution collided on
+    same-second supervisor restarts, and a u32 ms field would wrap
+    every ~49 days and fence a healthy producer forever)."""
+    big = (1 << 40) + 123
+    env = wrap_fabric(b"x", key=1, boot=big, epoch=0, seq=0)
+    assert peek_fabric(env) == (1, big, 0, 0)
+    mem.reset("bma"), mem.reset("bmb")
+    fb = _fabric(["mem://bma", "mem://bmb"])
+    assert fb._boot > 1 << 40, "boot should be epoch milliseconds"
+    fb.close()
+
+
+def test_chaos_refuses_to_wrap_a_fabric():
+    """ChaosBroker forwards only the base Broker surface; silently
+    wrapping a fabric would strip quiesce/consume_residual/
+    fanin_residual (the SIGTERM drain would strand popped frames) and
+    fabric_stats — the combination must fail boot loudly instead."""
+    from dotaclient_tpu.chaos import wrap_broker
+    from dotaclient_tpu.config import ChaosConfig
+
+    mem.reset("cwa"), mem.reset("cwb")
+    fb = _fabric(["mem://cwa", "mem://cwb"])
+    with pytest.raises(ValueError, match="fabric"):
+        wrap_broker(fb, ChaosConfig(enabled=True, spec=""))
+    fb.close()
+
+
+def test_all_chunks_of_one_trajectory_pin_to_one_shard():
+    """The pinning contract: every chunk stamped with one actor_id lands
+    on the SAME shard, for any mix of actors."""
+    mem.reset("pina"), mem.reset("pinb"), mem.reset("pinc")
+    fb = _fabric(["mem://pina", "mem://pinb", "mem://pinc"])
+    per_actor_shard = {}
+    for actor_id in (3, 11, 42):
+        for seed in range(4):
+            r = make_rollout(L=4, H=8, version=0, seed=seed)._replace(actor_id=actor_id)
+            fb.publish_experience(serialize_rollout(r))
+    for i, name in enumerate(("pina", "pinb", "pinc")):
+        hub = mem._hub(name, 4096)
+        for f in list(hub.experience):
+            aid = peek_rollout_actor_id(strip_fabric(bytes(f)))
+            assert per_actor_shard.setdefault(aid, i) == i, (
+                f"actor {aid} spread across shards {per_actor_shard[aid]} and {i}"
+            )
+    assert len(per_actor_shard) == 3
+    fb.close()
+
+
+# ------------------------------------------------------------------ fence
+
+
+def test_fence_rules_epoch_seq_and_boot():
+    f = ShardFence()
+    assert f.admit(1, 100, 0, 0) is True
+    assert f.admit(1, 100, 0, 0) is False  # duplicate seq
+    assert f.admit(1, 100, 1, 1) is True  # failover republish
+    assert f.admit(1, 100, 0, 2) is False  # stale epoch → fenced
+    assert f.admit(1, 100, 1, 1) is False  # dup of the republish
+    assert f.admit(1, 200, 0, 0) is True  # restarted producer: new seq space
+    assert f.admit(1, 100, 9, 9) is False  # stale boot → fenced
+    assert f.fence_dropped == 2 and f.dup_dropped == 2 and f.delivered == 3
+
+
+def test_fence_window_bounds_memory():
+    f = ShardFence(window=8)
+    for s in range(40):
+        assert f.admit(5, 1, 0, s)
+    assert len(f._keys[5]["seen"]) <= 9
+    assert f.admit(5, 1, 0, 2) is False  # ancient: dropped, counted
+    assert f.fence_dropped == 1
+
+
+# ----------------------------------------------- failover + resurrection
+
+
+def test_failover_bumps_epoch_and_stale_resurrection_is_fenced():
+    """End-to-end over real tcp shards: kill the primary mid-stream →
+    the publish fails over with an epoch bump; a resurrected primary
+    delivering a STALE-epoch copy is detected and dropped — the chunk
+    is applied exactly once (fence counter > 0 proves the fence fired,
+    the soak's resurrection-phase invariant)."""
+    s0 = BrokerServer(port=0).start()
+    s1 = BrokerServer(port=0).start()
+    urls = [f"tcp://127.0.0.1:{s0.port}", f"tcp://127.0.0.1:{s1.port}"]
+    fb = _fabric(urls)
+    r = make_rollout(L=4, H=8, version=0, seed=0)._replace(actor_id=5)
+    data = serialize_rollout(r)
+    key = peek_rollout_actor_id(data)
+    order = rendezvous_order(key, urls)
+    servers = [s0, s1]
+    primary, successor = servers[order[0]], servers[order[1]]
+
+    fb.publish_experience(data)  # seq 0 → primary, epoch 0
+    assert primary.enqueued_total == 1 and successor.enqueued_total == 0
+    primary.stop()  # shard death
+    fb.publish_experience(data)  # seq 1 → fails over, epoch 1 → successor
+    assert successor.enqueued_total == 1
+    env = peek_fabric(bytes(successor.experience[0]))
+    assert env is not None and env[2] == 1 and env[3] == 1  # epoch bumped, seq 1
+
+    # resurrect the primary on the SAME port and hand it a STALE-epoch
+    # copy of seq 1 (the late delivery a partitioned shard would make)
+    deadline = time.monotonic() + 10
+    reborn = None
+    while reborn is None:
+        try:
+            reborn = BrokerServer(port=primary.port).start()
+        except (RuntimeError, OSError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+    stale = wrap_fabric(data, key=key, boot=fb._boot, epoch=0, seq=1)
+    direct = TcpBroker(port=reborn.port)
+    direct.publish_experience(stale)
+
+    # consumer: one fabric consumer over both shards (cooldown expired →
+    # the reborn primary is polled again)
+    time.sleep(0.6)
+    got = []
+    deadline = time.monotonic() + 5
+    while len(got) < 1 and time.monotonic() < deadline:
+        got.extend(fb.consume_experience(8, timeout=0.3))
+    # exactly ONE copy of seq 1 delivered (the epoch-1 republish); the
+    # stale epoch-0 resurrection copy was fenced and counted
+    deadline = time.monotonic() + 5
+    while fb._fence.fence_dropped < 1 and time.monotonic() < deadline:
+        got.extend(fb.consume_experience(8, timeout=0.2))
+    assert got.count(data) == 1, f"{len(got)} copies delivered"
+    assert fb._fence.fence_dropped >= 1, "the epoch fence never fired"
+    stats = fb.fabric_stats()
+    assert stats["fanin_fence_dropped_total"] >= 1
+    assert stats["fanin_publish_failovers_total"] >= 1
+    direct.close()
+    fb.close()
+    reborn.stop()
+    s1.stop()
+
+
+def test_all_shards_down_raises_and_recovers_after_cooldown():
+    s0 = BrokerServer(port=0).start()
+    s1 = BrokerServer(port=0).start()
+    fb = _fabric([f"tcp://127.0.0.1:{s0.port}", f"tcp://127.0.0.1:{s1.port}"])
+    fb.publish_experience(b"DTR1" + bytes(40))
+    s0.stop(), s1.stop()
+    with pytest.raises((ConnectionError, OSError)):
+        fb.publish_experience(b"DTR1" + bytes(40))
+    assert fb.publish_failed_total == 1
+    fb.close()
+
+
+# -------------------------------------------------- priority admission
+
+
+def test_priority_shed_evicts_lowest_and_age_decays():
+    """A shedding-window PUB_EXPP evicts the lowest-effective-priority
+    resident instead of refusing the newcomer; a newcomer that cannot
+    beat the resident minimum is still SHED; and the ledger identity
+    enqueued = popped + dropped + evicted_low + resident holds."""
+    srv = BrokerServer(
+        port=0, maxlen=16, shed_high=3, shed_low=1, priority_shed=True
+    ).start()
+    c = TcpBroker(port=srv.port)
+    for i, p in enumerate((0.3, 0.5, 0.9)):
+        c.publish_experience_prioritized(b"frame%d" % i, p)
+    c.publish_experience_prioritized(b"winner", 2.0)  # evicts the 0.3
+    with pytest.raises(BrokerShedError):
+        c.publish_experience_prioritized(b"loser", 0.1)  # cannot beat 0.5
+    s = c.stats2()
+    assert s["evicted_low"] == 1 and s["shed"] == 1 and s["priority_mode"] == 1
+    frames = c.consume_experience(16, timeout=1.0)
+    assert b"winner" in frames and b"frame0" not in frames and b"loser" not in frames
+    srv.stop()
+    led = srv.ledger()
+    assert (
+        led["enqueued"]
+        == led["popped"] + led["dropped_oldest"] + led["evicted_low"] + led["resident"]
+    )
+    c.close()
+
+
+def test_priority_op_against_classic_broker_is_classic_admission():
+    """PUB_EXPP against a broker WITHOUT --priority: the stamp is
+    carried but ignored — classic hysteresis refuses the newcomer, no
+    eviction, no new counters."""
+    srv = BrokerServer(port=0, maxlen=16, shed_high=2, shed_low=1).start()
+    c = TcpBroker(port=srv.port)
+    c.publish_experience_prioritized(b"a", 1.0)
+    c.publish_experience_prioritized(b"b", 1.0)
+    with pytest.raises(BrokerShedError):
+        c.publish_experience_prioritized(b"c", 99.0)
+    s = c.stats2()
+    assert s["shed"] == 1 and s["evicted_low"] == 0 and s["priority_mode"] == 0
+    srv.stop()
+    c.close()
+
+
+def test_actor_priority_fn_resolves_only_against_fabric():
+    from dotaclient_tpu.runtime.actor import rollout_priority_fn
+
+    class Classic:
+        pass
+
+    assert rollout_priority_fn(Classic()) is None
+    mem.reset("pfa"), mem.reset("pfb")
+    fb = _fabric(["mem://pfa", "mem://pfb"])
+    fn = rollout_priority_fn(fb)
+    assert fn is not None
+    p = fn(make_rollout(L=4, H=8, version=0, seed=3))
+    assert isinstance(p, float) and p >= 0.0
+    fb.close()
+
+
+# ------------------------------------- per-endpoint ShedThrottle (satellite)
+
+
+def test_shed_throttle_per_endpoint_one_shedding_shard_stays_local():
+    """Regression (the satellite): two in-process brokers behind a
+    fabric, one shedding — the throttle arms backoff for the SHEDDING
+    endpoint only, and a publish routed to the healthy shard is not
+    delayed (its latency stays flat)."""
+    from dotaclient_tpu.runtime.actor import ShedThrottle
+
+    # watermarked hub for shard A, unbounded-ish hub for shard B
+    mem.reset("tsa"), mem.reset("tsb")
+    mem._hub("tsa", 64, shed_high=1, shed_low=0)  # sheds at depth 1
+    fb = _fabric(["mem://tsa", "mem://tsb"])
+    # find two actor ids whose primaries differ
+    aid_a = aid_b = None
+    for aid in range(64):
+        r = make_rollout(L=4, H=8, version=0, seed=0)._replace(actor_id=aid)
+        ep = fb.route_endpoint(serialize_rollout(r))
+        if ep.endswith("tsa") and aid_a is None:
+            aid_a = aid
+        if ep.endswith("tsb") and aid_b is None:
+            aid_b = aid
+        if aid_a is not None and aid_b is not None:
+            break
+    assert aid_a is not None and aid_b is not None
+    data_a = serialize_rollout(make_rollout(L=4, H=8, version=0, seed=1)._replace(actor_id=aid_a))
+    data_b = serialize_rollout(make_rollout(L=4, H=8, version=0, seed=2)._replace(actor_id=aid_b))
+
+    thr = ShedThrottle(RetryPolicy(window_s=5, backoff_base_s=0.5, backoff_cap_s=1.0, jitter=0.0))
+
+    async def go():
+        assert await thr.publish(fb, data_a) is True  # depth 1 on A
+        assert await thr.publish(fb, data_a) is False  # A sheds → backoff ARMED
+        assert thr.shed == 1
+        # healthy shard B: must publish immediately, no shared pause
+        t0 = time.monotonic()
+        assert await thr.publish(fb, data_b) is True
+        healthy_latency = time.monotonic() - t0
+        assert healthy_latency < 0.25, (
+            f"healthy-shard publish waited {healthy_latency:.3f}s behind "
+            f"the shedding shard's backoff"
+        )
+        # the shedding shard's next publish DOES pay its armed backoff
+        t0 = time.monotonic()
+        assert await thr.publish(fb, data_a) is False  # still shedding
+        assert time.monotonic() - t0 >= 0.4
+        assert thr.throttle_s >= 0.4
+
+    asyncio.new_event_loop().run_until_complete(go())
+    fb.close()
+
+
+# ------------------------------------------------- multi-learner fan-in
+
+
+def test_disjoint_consume_shards_split_the_stream():
+    mem.reset("dja"), mem.reset("djb")
+    urls = ["mem://dja", "mem://djb"]
+    pub = _fabric(urls)
+    seen_shards = set()
+    frames = {}
+    for aid in range(24):
+        r = make_rollout(L=4, H=8, version=0, seed=aid)._replace(actor_id=aid)
+        data = serialize_rollout(r)
+        frames[aid] = data
+        pub.publish_experience(data)
+        seen_shards.add(pub.last_publish_endpoint)
+    assert len(seen_shards) == 2  # both shards took traffic
+    c0 = _fabric(urls, consume_shards=[0])
+    c1 = _fabric(urls, consume_shards=[1])
+    got0, got1 = [], []
+    deadline = time.monotonic() + 5
+    while len(got0) + len(got1) < 24 and time.monotonic() < deadline:
+        got0.extend(c0.consume_experience(32, timeout=0.2))
+        got1.extend(c1.consume_experience(32, timeout=0.2))
+    assert len(got0) + len(got1) == 24
+    assert got0 and got1  # genuinely split
+    assert set(map(bytes, got0)).isdisjoint(set(map(bytes, got1)))
+    for b in (pub, c0, c1):
+        b.close()
+
+
+def test_restrict_consume_shards_validates_and_locks():
+    mem.reset("rsa"), mem.reset("rsb")
+    fb = _fabric(["mem://rsa", "mem://rsb"])
+    with pytest.raises(ValueError):
+        fb.restrict_consume_shards([2])
+    fb.restrict_consume_shards([1])
+    fb.consume_experience(1, timeout=0.01)  # starts the fan-in
+    with pytest.raises(RuntimeError):
+        fb.restrict_consume_shards([0])
+    fb.close()
+
+
+def test_learner_main_broker_shards_refuses_classic_url():
+    from dotaclient_tpu.runtime import learner as learner_mod
+
+    with pytest.raises(ValueError, match="broker_shards"):
+        learner_mod.main(
+            ["--broker_url", "mem://classic", "--broker_shards", "0", "--train_steps", "1"]
+        )
+
+
+# --------------------------------------------- staging drain integration
+
+
+@pytest.mark.parametrize("pack_workers", [1, 2])
+def test_staging_drain_accounts_fabric_residual(pack_workers):
+    """The PR-7 zero-loss drain contract extended one station upstream:
+    frames the fabric fan-in already popped off the shards survive a
+    quiesce into staging's pending set, and drained() stays False while
+    any sit in the fan-in queue — on BOTH the classic consumer and the
+    pool-mode pop/assembler split."""
+    from dotaclient_tpu.config import LearnerConfig, PolicyConfig, StagingConfig
+    from dotaclient_tpu.runtime.staging import StagingBuffer
+
+    mem.reset("sda"), mem.reset("sdb")
+    fb = _fabric(["mem://sda", "mem://sdb"])
+    small = PolicyConfig(unit_embed_dim=8, lstm_hidden=8, mlp_hidden=8, dtype="float32")
+    cfg = LearnerConfig(
+        batch_size=4, seq_len=4, policy=small, native_packer=False,
+        staging=StagingConfig(pack_workers=pack_workers),
+    )
+    for aid in range(3):  # fewer than one batch: they can only drain to pending
+        r = make_rollout(L=4, H=8, version=0, seed=aid)._replace(actor_id=aid)
+        fb.publish_experience(serialize_rollout(r))
+    staging = StagingBuffer(cfg, fb)
+    # pre-start: pull the frames into the fan-in queue, then quiesce
+    fb._ensure_fanin()
+    deadline = time.monotonic() + 5
+    while fb._fanin.qsize() < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert fb._fanin.qsize() == 3
+    assert fb.fanin_residual() >= 3  # qsize plus any mid-pop thread
+    staging.start()
+    staging.quiesce()
+    assert fb._quiesce.is_set()  # quiesce propagated to the fabric
+    deadline = time.monotonic() + 5
+    while not staging.drained() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert staging.drained()
+    snap = staging.snapshot_state()
+    assert len(snap["pending"]) == 3, "popped frames lost across the drain"
+    assert fb.fanin_residual() == 0
+    staging.stop()
+    fb.close()
+
+
+# ----------------------------------------------------------- inertness
+
+
+def test_single_endpoint_default_config_never_imports_fabric():
+    """Default-config inertness: a single-endpoint --broker url is the
+    byte-for-byte classic path — the fabric module is never imported by
+    connect(), the actor, or the learner config plumbing."""
+    code = f"""
+import sys
+sys.path.insert(0, {REPO_ROOT!r})
+from dotaclient_tpu.transport.base import connect
+from dotaclient_tpu.config import LearnerConfig, ActorConfig, parse_config
+cfg = parse_config(LearnerConfig(), [])
+acfg = parse_config(ActorConfig(), [])
+assert cfg.broker_shards == ""
+b = connect("mem://inert")
+b.publish_experience(b"x")
+assert b.consume_experience(1, timeout=0.5) == [b"x"]
+assert "dotaclient_tpu.transport.fabric" not in sys.modules, "fabric imported on the classic path"
+print("INERT_OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=clean_subprocess_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "INERT_OK" in proc.stdout
+
+
+# -------------------------------------------------- fabric shard binary
+
+
+def test_fabric_binary_boots_a_priority_shard():
+    """`python -m dotaclient_tpu.transport.fabric` is the shard binary
+    the k8s StatefulSet runs — boot one with priority admission and
+    drive the new wire ops against it."""
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dotaclient_tpu.transport.fabric",
+            "--host", "127.0.0.1", "--port", "0", "--maxlen", "8",
+            "--shed_high", "3", "--shed_low", "1", "--priority", "true",
+        ],
+        cwd=REPO_ROOT,
+        stdout=subprocess.PIPE,
+        text=True,
+        env=clean_subprocess_env(),
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "fabric shard listening" in line and "priority admission" in line, line
+        port = int(line.split(":")[1].split(" ")[0])
+        c = TcpBroker(port=port)
+        c.publish_experience_prioritized(b"x", 1.0)
+        assert c.stats2()["priority_mode"] == 1
+        c.close()
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# ------------------------------------------- committed artifact + nightly
+
+
+def test_broker_fabric_soak_committed_artifact_verdict():
+    """The committed BROKER_FABRIC_SOAK.json must be ALL GREEN: zero
+    unaccounted frames across shard generations, the epoch fence fired
+    under resurrection with no duplicate apply, the 2-learner fan-in
+    resumed bit-exact, and the host-capability disclosure is present
+    (the PACK_SCALE precedent)."""
+    path = os.path.join(REPO_ROOT, "BROKER_FABRIC_SOAK.json")
+    artifact = json.load(open(path))
+    v = artifact["verdict"]
+    assert v["all_green"] is True
+    assert v["unaccounted_frames"] == 0
+    assert v["fence_fired_under_resurrection"] is True
+    assert v["duplicate_applied_chunks"] == 0
+    assert v["two_learner_resume_bit_exact"] is True
+    assert artifact["host_probe"]["disclosed"] is True
+    assert "host_preflight" in artifact
+    # per-shard-generation conservation: every generation's ledger sums
+    for gen in artifact["phase_kill"]["shard_generations"]:
+        assert (
+            gen["enqueued"]
+            == gen["popped"] + gen["dropped_oldest"] + gen["evicted_low"] + gen["resident"]
+        ), gen
+
+
+@pytest.mark.nightly
+@pytest.mark.slow  # tier-1 runs -m 'not slow', which would override the
+# nightly exclusion and pull this multi-minute closed loop into the gate
+def test_broker_fabric_soak_quick_rerun(tmp_path):
+    out = tmp_path / "BROKER_FABRIC_SOAK.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "soak_broker_fabric.py"),
+            "--quick",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+        env=clean_subprocess_env(),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    artifact = json.loads(out.read_text())
+    v = artifact["verdict"]
+    assert v["all_green"] is True, v
+    assert v["unaccounted_frames"] == 0
+    assert v["fence_fired_under_resurrection"] is True
+    assert v["duplicate_applied_chunks"] == 0

@@ -8,6 +8,7 @@ that every --flag it passes is a real config field, and that broker URLs
 point at a Service that exists.
 """
 
+import json
 import pathlib
 import re
 import subprocess
@@ -122,8 +123,13 @@ def test_broker_urls_resolve_to_a_service():
         args = c.get("args", [])
         for flag, val in zip(args, args[1:]):
             if flag.endswith("broker_url"):
-                host = url_re.match(val).group(2)
-                assert host in services, f"{fname}: broker host {host!r} has no Service"
+                # a comma list is the broker fabric: every shard must
+                # resolve; per-pod DNS (pod-i.service) resolves through
+                # its headless Service, the PR-10 affinity pattern
+                for url in val.split(","):
+                    host = url_re.match(url.strip()).group(2)
+                    svc = host.split(".", 1)[1] if "." in host else host
+                    assert svc in services, f"{fname}: broker host {host!r} has no Service"
                 found += 1
     assert found >= 3  # learner + actor + evaluator all wired
 
@@ -216,16 +222,58 @@ def test_learner_drain_grace_pairing():
 
 
 def test_broker_ships_admission_watermarks():
-    """The production broker must run with load-shed armed: shed_high
-    below the drop-oldest bound (overload surfaces at producers, not as
-    silent oldest-frame loss) and a real hysteresis band under it."""
+    """Every production broker shard must run with load-shed armed:
+    shed_high below the drop-oldest bound (overload surfaces at
+    producers, not as silent oldest-frame loss) and a real hysteresis
+    band under it."""
     (_, doc), = [
         (f, d) for f, d in DOCS
-        if d["metadata"]["name"] == "broker" and d["kind"] == "Deployment"
+        if d["metadata"]["name"] == "broker" and d["kind"] in ("Deployment", "StatefulSet")
     ]
     args = doc["spec"]["template"]["spec"]["containers"][0]["args"]
     vals = {k: int(args[args.index(k) + 1]) for k in ("--maxlen", "--shed_high", "--shed_low")}
     assert 0 < vals["--shed_low"] < vals["--shed_high"] < vals["--maxlen"]
+
+
+def test_broker_fabric_statefulset_and_shard_lists_match_replicas():
+    """The broker fabric (PR 14), GATED on the committed
+    BROKER_FABRIC_SOAK verdict (the WIRE_SOAK flip pattern): the broker
+    is a StatefulSet of fabric-shard pods behind a HEADLESS Service
+    (per-pod DNS is the shard identity clients hash against), priority
+    admission is armed, and EVERY --broker_url shard list in the fleet
+    names exactly one endpoint per replica, in per-pod DNS form — a
+    list/replica mismatch would silently re-route every key's
+    rendezvous hash."""
+    verdict = json.loads((K8S.parent / "BROKER_FABRIC_SOAK.json").read_text())["verdict"]
+    assert verdict["all_green"] is True, (
+        "the fabric manifests require a green BROKER_FABRIC_SOAK verdict"
+    )
+    (_, doc), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "broker" and d["kind"] != "Service"
+    ]
+    assert doc["kind"] == "StatefulSet"
+    assert doc["spec"]["serviceName"] == "broker"
+    replicas = int(doc["spec"]["replicas"])
+    assert replicas >= 2
+    c = doc["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][2] == "dotaclient_tpu.transport.fabric"
+    args = c["args"]
+    assert args[args.index("--priority") + 1] == "true"
+    (_, svc), = [
+        (f, d) for f, d in DOCS
+        if d["kind"] == "Service" and d["metadata"]["name"] == "broker"
+    ]
+    assert svc["spec"].get("clusterIP") == "None", "fabric needs a HEADLESS service"
+    expect = ",".join(f"tcp://broker-{i}.broker:13370" for i in range(replicas))
+    lists = 0
+    for fname, cc in _our_containers():
+        cargs = cc.get("args", [])
+        for flag, val in zip(cargs, cargs[1:]):
+            if flag.endswith("broker_url"):
+                assert val == expect, f"{fname}: shard list {val!r} != {expect!r}"
+                lists += 1
+    assert lists >= 4  # learner, multihost learner, actors, evaluator, serve
 
 
 def test_chaos_pinned_off_in_all_prod_manifests():
@@ -237,6 +285,7 @@ def test_chaos_pinned_off_in_all_prod_manifests():
         cmd = c.get("command")
         if cmd is None or cmd[2] in (
             "dotaclient_tpu.transport.tcp_server",  # broker: no chaos surface
+            "dotaclient_tpu.transport.fabric",  # fabric shard: no chaos surface
             "dotaclient_tpu.env.fake_dotaservice",  # env stub: no flags at all
             "dotaclient_tpu.serve.handoff",  # carry store: no chaos surface
         ):
@@ -301,7 +350,10 @@ def test_inference_service_manifest():
     c = doc["spec"]["template"]["spec"]["containers"][0]
     assert c["command"][2] == "dotaclient_tpu.serve.server"
     args = c["args"]
-    assert args[args.index("--broker_url") + 1] == "tcp://broker:13370"
+    # the weight-fanout subscription rides the same broker FABRIC shard
+    # list the actors use (PR 14; the shard-list/replica cross-check
+    # lives in test_broker_fabric_statefulset_and_shard_lists_match_replicas)
+    assert args[args.index("--broker_url") + 1].startswith("tcp://broker-0.broker:13370,")
     assert args[args.index("--obs.enabled") + 1] == "true"
     mport = int(args[args.index("--obs.metrics_port") + 1])
     assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"

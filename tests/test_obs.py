@@ -623,6 +623,22 @@ def test_chaos_and_shed_scalars_are_registered():
     assert not missing, f"chaos meters not in obs/registry.py: {missing}"
 
 
+def test_fabric_scalars_are_registered():
+    """Broker-fabric names (ISSUE 14): everything FabricBroker emits
+    through the learner metrics window — the fanin_* fence/queue
+    ledgers and the per-shard broker_shard_* family — must be in the
+    registry, for every shard index a real list could carry."""
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.fabric import FabricBroker
+
+    mem.reset("obs-fab-a"), mem.reset("obs-fab-b"), mem.reset("obs-fab-c")
+    fb = FabricBroker(["mem://obs-fab-a", "mem://obs-fab-b", "mem://obs-fab-c"])
+    missing = registry.unregistered(fb.fabric_stats().keys())
+    assert not missing, f"fabric scalars not in obs/registry.py: {missing}"
+    fb.close()
+
+
 # --------------------------------------------------- scrape surface
 
 

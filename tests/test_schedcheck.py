@@ -21,6 +21,7 @@ from dotaclient_tpu.analysis.schedcheck import (
     HandoffModel,
     HotSwapModel,
     RingLeaseModel,
+    ShardEpochModel,
     explore,
     head_models,
     random_walks,
@@ -329,6 +330,90 @@ def test_schedcheck_runs_without_jax_in_subprocess():
         timeout=120,
         env=clean_subprocess_env(),
     )
+
+
+# --------------------------------------- broker-fabric shard epoch fence
+
+
+def test_shard_epoch_head_exhausts_clean_both_partition_fates():
+    """The fabric routing/failover protocol (route → publish →
+    fence-check → apply) explores its full bounded interleaving set
+    clean under BOTH partition-publish fates: the frame landing with
+    the ack lost (duplicate hazard) and the frame lost with it
+    (liveness hazard)."""
+    for land in (True, False):
+        r = explore(ShardEpochModel(chunks=3, land_on_partition=land))
+        assert r.exhausted, f"land={land}: truncated at {r.states}"
+        assert r.violations == [], (land, r.violations)
+        assert r.states > 50, f"vacuous model ({r.states} states)"
+
+
+def test_shard_epoch_mutants_all_fail_exploration():
+    """Each mutant re-introduces a bug class the shipped protocol
+    excludes; exploration must FIND every one (the failing half of the
+    failing-then-fixed pair — HEAD clean is the fixed half)."""
+    expect = {
+        "no_fence": "applied twice",
+        "reroute_before_drain": "UNACCOUNTED",
+        "shed_newest": "lower-priority",
+    }
+    for mutant, needle in expect.items():
+        hits = []
+        for land in (True, False):
+            r = explore(ShardEpochModel(chunks=3, land_on_partition=land, mutant=mutant))
+            hits.extend(r.violations)
+        assert hits, f"mutant {mutant} explored clean — the model lost its teeth"
+        assert any(needle in v for v in hits), (mutant, hits[:3])
+
+
+def test_shard_epoch_model_cross_validated_against_real_fence():
+    """The model's fence-decision table IS ShardFence.admit (single
+    producer boot): replay representative (epoch, seq) arrival
+    sequences — including the resurrection orderings the model
+    explores — through the REAL fence and assert identical verdicts."""
+    from dotaclient_tpu.transport.fabric import ShardFence
+
+    # (epoch, seq) arrival order → expected admit verdicts, from the
+    # model's _apply rules. Cases: in-order, failover republish, stale
+    # copy after the republish (fenced), stale copy BEFORE the republish
+    # (applied; republish then dup-dropped), ancient epoch.
+    cases = [
+        ([(0, 0), (0, 1), (1, 1), (0, 2)], [True, True, False, False]),
+        ([(0, 0), (1, 1), (0, 1)], [True, True, False]),
+        ([(0, 1), (1, 1)], [True, False]),  # stale-first: seq dedup holds
+        ([(0, 0), (2, 3), (1, 2)], [True, True, False]),
+    ]
+    for arrivals, expected in cases:
+        fence = ShardFence()
+        model = ShardEpochModel()
+        st = model.init()
+        got_real = [fence.admit(7, 100, e, s) for e, s in arrivals]
+        got_model = []
+        for e, s in arrivals:
+            before = len(st["applied"])
+            model._apply(st, e, s)
+            got_model.append(len(st["applied"]) == before + 1)
+        assert got_real == expected, (arrivals, got_real)
+        assert got_model == expected, (arrivals, got_model)
+
+
+def test_shard_epoch_model_cross_validated_against_real_router():
+    """The model's A-primary/B-successor shape is the real rendezvous
+    router's: for any key, every seq routes to ONE shard (the pinning
+    contract), and removing the primary makes the model's successor the
+    real router's next choice."""
+    from dotaclient_tpu.transport.fabric import rendezvous_order
+
+    endpoints = ["tcp://shard-a:1", "tcp://shard-b:2", "tcp://shard-c:3"]
+    for key in range(64):
+        order = rendezvous_order(key, endpoints)
+        assert sorted(order) == [0, 1, 2]
+        assert rendezvous_order(key, endpoints) == order  # deterministic
+        # consistency: dropping the primary leaves the survivors' order
+        survivors = [e for i, e in enumerate(endpoints) if i != order[0]]
+        sub = rendezvous_order(key, survivors)
+        expect = [e for e in (endpoints[j] for j in order[1:])]
+        assert [survivors[i] for i in sub] == expect, key
 
 
 # ------------------------------------------------------------- nightly lane
