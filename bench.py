@@ -297,12 +297,19 @@ def main() -> None:
     pack_workers = int(os.environ.get("DOTACLIENT_TPU_BENCH_PACK_WORKERS", "1") or 1)
     cfg.staging.pack_workers = pack_workers
     mesh = mesh_lib.make_mesh(cfg.mesh_shape)
-    # The production flagship path: fused 4-buffer H2D + host-side bf16
-    # obs cast, exactly what the Learner runs with default config.
-    from dotaclient_tpu.parallel.train_step import build_fused_train_step
+    # The production flagship path, exactly what the Learner runs with
+    # default config: fused SINGLE-buffer H2D (the ISSUE-15 flip — one
+    # [B, row_bytes] u8 put per batch, 1.961→0.105 ms on the tunneled
+    # chip per the committed transfer A/B) + host-side bf16 obs cast.
+    # fused_single_h2d=false falls back to the 4-buffer group layout.
+    from dotaclient_tpu.parallel.train_step import (
+        build_fused_train_step,
+        build_single_train_step,
+    )
     from dotaclient_tpu.runtime.staging import cast_obs_to_compute_dtype
 
-    train_step, state_sh, io = build_fused_train_step(cfg, mesh)
+    build = build_single_train_step if cfg.fused_single_h2d else build_fused_train_step
+    train_step, state_sh, io = build(cfg, mesh)
     state = jax.device_put(init_train_state(cfg, jax.random.PRNGKey(0)), state_sh)
 
     # ---- device-only rate (context): pre-packed batch, no host pipeline.
@@ -311,7 +318,7 @@ def main() -> None:
     # the already-compiled program instead of a second multi-minute
     # compile inside a scarce TPU window).
     host_batch = cast_obs_to_compute_dtype(cfg, jax.tree.map(np.asarray, make_train_batch(cfg, 0)))
-    batch = jax.device_put(io.pack(host_batch), io.shardings)
+    batch = jax.device_put(io.pack_transfer(host_batch), io.transfer_shardings())
     state, metrics = train_step(state, batch)
     jax.block_until_ready(metrics["loss"])
     t0 = time.perf_counter()
@@ -352,13 +359,19 @@ def main() -> None:
     staging.stop()
 
     # ---- end-to-end rate: producers → broker → staging → device, with
-    # the learner's round-3 overlap (prefetch + device_put of batch N+1
-    # while step N runs; no per-iteration device sync), INCLUDING the
-    # per-step weight publish exactly as Learner.run does it at the
-    # default publish_every=1 (one async on-device flatten dispatch on
-    # this thread; single-buffer host read + serialize on the publisher
+    # the learner's PIPELINED loop (--learner.prefetch, the production
+    # default): the SAME PrefetchLane the Learner runs stages batch N+1
+    # — staging pop, device_put dispatch, transfer retire, lease release
+    # — on its own thread while step N executes, INCLUDING the per-step
+    # weight publish exactly as Learner.run does it at the default
+    # publish_every=1 (one async on-device flatten dispatch on the loop
+    # thread; single-buffer host read + serialize on the publisher
     # thread) — the headline covers the full production loop.
-    from dotaclient_tpu.runtime.learner import ParamFlattener, WeightPublisher
+    from dotaclient_tpu.runtime.learner import (
+        ParamFlattener,
+        PrefetchLane,
+        WeightPublisher,
+    )
 
     stop = _start_producers(cfg, "bench")
     staging = StagingBuffer(
@@ -368,11 +381,12 @@ def main() -> None:
     publisher = WeightPublisher(connect("mem://bench"), materialize=flattener.to_named).start()
 
     def fetch():
-        # staging already packed into the transfer buffers (groups);
-        # wait bucket = queue wait + mask sum, device_put_s stays a pure
-        # H2D-transfer attribution (mirrors learner._fetch_next)
+        # staging already packed into the transfer buffers; wait bucket
+        # = queue wait, device_put_s stays a pure H2D-transfer
+        # attribution (mirrors learner._fetch_next — this closure runs
+        # on the PrefetchLane thread in the timed loop below)
         t0 = time.perf_counter()
-        b, groups = staging.get_batch_groups(timeout=120.0)
+        b, payload = staging.get_batch_groups(timeout=120.0)
         if b is None:
             # mirror fetch_single: a starved pipe inside a scarce TPU
             # window must be a diagnosable error, not b.mask on None
@@ -380,85 +394,108 @@ def main() -> None:
         steps = int(np.sum(b.mask))
         lease = staging.last_batch_lease
         t1 = time.perf_counter()
-        dev = jax.device_put(groups, io.shardings)
+        dev = jax.device_put(payload, io.transfer_shardings())
         if lease is not None:
             # ring mode: the slot may be repacked the moment it is
             # released — wait for the transfer to retire first
             # (runtime/learner.py _fetch_next is the production twin)
             jax.block_until_ready(dev)
             lease.release()
-        return dev, steps, t1 - t0, time.perf_counter() - t1
+        return dev, steps, t1 - t0, time.perf_counter() - t1, None
 
-    warm, _, _, _ = fetch()
+    warm, _, _, _, _ = fetch()
     state, metrics = train_step(state, warm)
     jax.block_until_ready(metrics["loss"])
     jax.block_until_ready(flattener.flatten_on_device(state.params))  # compile outside the window
     n_iters = 12
     env_steps = 0
-    t_wait = t_put = 0.0
-    nxt, nxt_steps, w, p = fetch()
+    t_wait = t_put = t_take = 0.0
+    # t0 BEFORE lane.start(): the lane's first fetch begins immediately,
+    # and its wait/put land in the accumulators below — the window must
+    # cover that work or lane_work_s counts out-of-window seconds and
+    # inflates pipeline_overlap_ratio (item 1's fetch is genuinely
+    # exposed — the device has nothing to run yet — and reads as take).
     t0 = time.perf_counter()
+    lane = PrefetchLane(fetch, depth=1, limit=n_iters).start()
     for i in range(n_iters):
-        dev, env_n = nxt, nxt_steps
-        state, metrics = train_step(state, dev)  # async dispatch
+        tb = time.perf_counter()
+        item = lane.get(timeout=150.0)  # the lane's own fetch bounds at 120s
+        t_take += time.perf_counter() - tb
+        if item.kind == "error":
+            raise item.error
+        state, metrics = train_step(state, item.batch)  # async dispatch
         publisher.submit(flattener.flatten_on_device(state.params), i + 1)
-        env_steps += env_n
-        nxt, nxt_steps, w, p = fetch()  # overlaps the in-flight step
-        t_wait += w
-        t_put += p
+        env_steps += item.env_steps
+        t_wait += item.wait_s
+        t_put += item.put_s
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
+    lane.stop()  # teardown outside the timed window
     publisher.stop()  # outside the timed window: drain is teardown, not loop cost
     stop.set()
     staging.stop()
 
     e2e_rate = env_steps / dt
+    # Overlap accounting (the pipelined loop's scoreboard): lane work =
+    # fetch wait + put, exposed loop time = the take-wait; device idle
+    # per step is bounded from the measured device-only rate.
+    lane_work_s = t_wait + t_put
+    pipeline_overlap_ratio = (
+        max(0.0, min(1.0, 1.0 - t_take / lane_work_s)) if lane_work_s > 0 else 1.0
+    )
+    device_s_per_iter = cfg.batch_size * cfg.seq_len / device_rate
+    device_idle_s_per_iter = max(dt / n_iters - device_s_per_iter, 0.0)
 
-    # --- optional: full e2e with the SINGLE-buffer H2D mode (opt-in via
-    # env because it costs a second full XLA compile — the prober sets it
-    # inside chip windows, where the per-window compilation cache and the
-    # transfer_layout_ab data give the 4-vs-1 decision real numbers on
-    # the real link). Best-effort: failure degrades to an error field,
+    # --- optional: full e2e with the ALTERNATE transfer layout (opt-in
+    # via env because it costs a second full XLA compile — the prober
+    # sets it inside chip windows, where the per-window compilation
+    # cache and the transfer_layout_ab data keep the layout decision
+    # anchored to real link numbers). With the single-buffer mode now
+    # the production default headline, this arm measures the 4-buffer
+    # GROUP layout (the pre-ISSUE-15 default) — the rollback
+    # comparison. Best-effort: failure degrades to an error field,
     # never touches the primary (already measured) rate.
-    e2e_single = e2e_single_err = None
+    e2e_alt = e2e_alt_err = None
+    alt_layout = "groups_4_buffers" if cfg.fused_single_h2d else "single_buffer"
     if os.environ.get("DOTACLIENT_TPU_BENCH_SINGLE") == "1":
         stop_s = s_staging = None
         try:
-            from dotaclient_tpu.parallel.train_step import build_single_train_step
-
             scfg = LearnerConfig(batch_size=256, seq_len=16, mesh_shape="dp=-1",
-                                 fused_single_h2d=True)
-            single_step, s_state_sh, s_io = build_single_train_step(scfg, mesh)
+                                 fused_single_h2d=not cfg.fused_single_h2d)
+            alt_build = (
+                build_single_train_step if scfg.fused_single_h2d else build_fused_train_step
+            )
+            alt_step, s_state_sh, s_io = alt_build(scfg, mesh)
             s_state = jax.device_put(
                 init_train_state(scfg, jax.random.PRNGKey(0)), s_state_sh
             )
-            stop_s = _start_producers(scfg, "bench_single")
+            stop_s = _start_producers(scfg, "bench_alt")
             s_staging = StagingBuffer(
-                scfg, connect("mem://bench_single"), version_fn=lambda: 0, fused_io=s_io
+                scfg, connect("mem://bench_alt"), version_fn=lambda: 0, fused_io=s_io
             ).start()
 
-            def fetch_single():
+            def fetch_alt():
                 b, payload = s_staging.get_batch_groups(timeout=120.0)
                 if b is None:
-                    raise RuntimeError("single-buffer staging starved (timeout)")
+                    raise RuntimeError("alt-layout staging starved (timeout)")
                 steps = int(np.sum(b.mask))
-                return jax.device_put(payload, s_io.single_sharding), steps
+                return jax.device_put(payload, s_io.transfer_shardings()), steps
 
-            warm_s, _ = fetch_single()
-            s_state, s_metrics = single_step(s_state, warm_s)
+            warm_s, _ = fetch_alt()
+            s_state, s_metrics = alt_step(s_state, warm_s)
             jax.block_until_ready(s_metrics["loss"])
-            nxt_s, nxt_steps_s = fetch_single()
+            nxt_s, nxt_steps_s = fetch_alt()
             steps_done = 0
             t0 = time.perf_counter()
             for _ in range(n_iters):
                 dev_s, n_s = nxt_s, nxt_steps_s
-                s_state, s_metrics = single_step(s_state, dev_s)
+                s_state, s_metrics = alt_step(s_state, dev_s)
                 steps_done += n_s
-                nxt_s, nxt_steps_s = fetch_single()
+                nxt_s, nxt_steps_s = fetch_alt()
             jax.block_until_ready(s_metrics["loss"])
-            e2e_single = steps_done / (time.perf_counter() - t0)
+            e2e_alt = steps_done / (time.perf_counter() - t0)
         except Exception as e:
-            e2e_single_err = f"{type(e).__name__}: {e}"[:300]
+            e2e_alt_err = f"{type(e).__name__}: {e}"[:300]
         finally:
             # Leaked producers/consumer would burn the 1-core host for the
             # rest of main() and skew the transfer A/B measured next.
@@ -548,10 +585,10 @@ def main() -> None:
         ph = StepPhaseTimer()
         for _ in range(4):
             t0p = time.perf_counter()
-            groups_p = io.pack(host_batch)
+            groups_p = io.pack_transfer(host_batch)
             t1p = time.perf_counter()
             ph.add("pack", t1p - t0p)
-            dev_p = jax.device_put(groups_p, io.shardings)
+            dev_p = jax.device_put(groups_p, io.transfer_shardings())
             jax.block_until_ready(dev_p)
             t2p = time.perf_counter()
             ph.add("h2d", t2p - t1p)
@@ -712,12 +749,14 @@ def main() -> None:
             f"{round(device_rate, 1)}; host-packer-only rate {round(packer_rate, 1)})"
         ),
         "vs_baseline": round(e2e_rate / baseline, 3),
-        # per-stage split, seconds per iteration averaged over the run
-        # (residual = device step + dispatch; the loop never syncs per-step)
+        # per-stage split, seconds per iteration averaged over the run.
+        # Pipelined loop: wait/put are PREFETCH-LANE time (overlapping
+        # the device step); residual = wall minus the exposed take-wait.
         "split": {
             "wait_batch_s": round(t_wait / n_iters, 5),
             "device_put_s": round(t_put / n_iters, 5),
-            "residual_step_s": round(max(dt - t_wait - t_put, 0.0) / n_iters, 5),
+            "take_wait_s": round(t_take / n_iters, 5),
+            "residual_step_s": round(max(dt - t_take, 0.0) / n_iters, 5),
         },
         "device_only_steps_per_sec": round(device_rate, 1),
         "packer_only_steps_per_sec": round(packer_rate, 1),
@@ -725,6 +764,18 @@ def main() -> None:
         # the 1/2/4-worker scaling artifact, PACK_SCALE_AB.json)
         "pack_workers": pack_workers,
         "e2e_over_device_only": round(e2e_rate / device_rate, 3),
+        # Overlapped-loop scoreboard (--learner.prefetch, ISSUE 15):
+        # share of prefetch-lane work hidden behind the device step, the
+        # lane's per-iteration busy time, the loop's exposed take-wait,
+        # and device idle bounded from the measured device-only rate.
+        "pipeline_overlap_ratio": round(pipeline_overlap_ratio, 3),
+        "pipeline": {
+            "prefetch_s_per_iter": round(lane_work_s / n_iters, 5),
+            "take_wait_s_per_iter": round(t_take / n_iters, 5),
+            "device_idle_s_per_iter": round(device_idle_s_per_iter, 5),
+            "prefetch_depth": 1,
+            "transfer_layout": "single_buffer" if cfg.fused_single_h2d else "groups_4_buffers",
+        },
         # Utilization accounting (SURVEY §6): analytic matmul FLOPs/step
         # (ops/flops.py, fwd+bwd), XLA's compiled count when the backend
         # reports one, achieved FLOP/s at the e2e rate, and MFU against
@@ -758,10 +809,11 @@ def main() -> None:
         # from the post-headline compute section (obs/compute.py)
         "compute_breakdown": compute_section,
     }
-    if e2e_single is not None:
-        out["e2e_single_buffer_steps_per_sec"] = round(e2e_single, 1)
-    if e2e_single_err is not None:
-        out["e2e_single_buffer_error"] = e2e_single_err
+    if e2e_alt is not None:
+        out["e2e_alt_layout_steps_per_sec"] = round(e2e_alt, 1)
+        out["e2e_alt_layout"] = alt_layout
+    if e2e_alt_err is not None:
+        out["e2e_alt_layout_error"] = e2e_alt_err
     if on_cpu_fallback and fallback_reason:
         out["fallback_reason"] = fallback_reason
     if on_cpu_fallback:

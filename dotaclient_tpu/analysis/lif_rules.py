@@ -314,32 +314,76 @@ class RingLeaseLifecycle(Rule):
         if not lease_names:
             return []
         findings: List[Finding] = []
+        put_names = self._put_result_names(fn)
         qual = None
         for call in _release_calls(fn, lease_names):
             if qual is None:
                 qual = module.qualname_at(call)
-            if not self._retired_before(module, fn, call):
+            if not self._retired_before(module, fn, call, put_names):
                 findings.append(
                     self.make(
                         module,
                         call.lineno,
                         "lease from last_batch_lease released before the "
                         "device transfer retired — no unconditional "
-                        "block_until_ready precedes this release(), so the "
-                        "slot can be re-zeroed and repacked under an "
-                        "in-flight H2D read (the PR-11 corruption)",
+                        "block_until_ready of THIS batch's device_put "
+                        "result precedes this release(), so the slot can "
+                        "be re-zeroed and repacked under an in-flight H2D "
+                        "read (the PR-11 corruption; the prefetch lane "
+                        "moves the release off the loop thread but never "
+                        "before the retire)",
                         context=qual or module.qualname_at(fn),
                     )
                 )
         _ = bind_line
         return findings
 
+    _PUT_CALLS = ("device_put", "make_array_from_process_local_data")
+
+    @classmethod
+    def _put_result_names(cls, fn: ast.AST) -> Set[str]:
+        """Names bound from a device-transfer dispatch anywhere in the
+        function: ``X = jax.device_put(...)`` or any assignment whose
+        value CONTAINS a device_put / make_array_from_process_local_data
+        call (the ``jax.tree.map(lambda ...: make_array...(...), ...)``
+        multihost idiom). These are the only objects whose
+        block_until_ready proves the lease's transfer retired — fencing
+        anything else (metrics, params) leaves the slot repackable under
+        the in-flight read."""
+        names: Set[str] = set()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Assign):
+                continue
+            has_put = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in cls._PUT_CALLS
+                for n in ast.walk(sub.value)
+            )
+            if not has_put:
+                continue
+            for tgt in sub.targets:
+                tgt_elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                for t in tgt_elts:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
     @staticmethod
-    def _retired_before(module: ModuleUnit, fn: ast.AST, release_call: ast.Call) -> bool:
+    def _retired_before(
+        module: ModuleUnit,
+        fn: ast.AST,
+        release_call: ast.Call,
+        put_names: Optional[Set[str]] = None,
+    ) -> bool:
         """True iff an UNCONDITIONAL ``block_until_ready(...)`` sibling
         statement precedes the release in its own block or an ancestor
         block (a block_until_ready nested under some other If does not
-        count — the retire fence must dominate the release)."""
+        count — the retire fence must dominate the release), AND — when
+        the function binds any device-put result names — the fence
+        blocks on one of THOSE names: a block_until_ready of some other
+        object (the step metrics, a param buffer) orders nothing about
+        the lease's own transfer (the prefetch-lane release-site rule)."""
         # the statement that contains the release call
         stmt = release_call
         parents = module.parents
@@ -363,7 +407,17 @@ class RingLeaseLifecycle(Rule):
                                 if isinstance(f, ast.Attribute)
                                 else getattr(f, "id", "")
                             )
-                            if name == "block_until_ready":
+                            if name != "block_until_ready":
+                                continue
+                            if not put_names:
+                                return True  # no put bound here: any fence
+                            fence_args = {
+                                n.id
+                                for a in before.value.args
+                                for n in ast.walk(a)
+                                if isinstance(n, ast.Name)
+                            }
+                            if fence_args & put_names:
                                 return True
                     break
             stmt = parent

@@ -64,6 +64,7 @@ __all__ = [
     "HotSwapModel",
     "HandoffModel",
     "ShardEpochModel",
+    "PrefetchModel",
 ]
 
 
@@ -1202,11 +1203,245 @@ class ShardEpochModel(_Model):
         return out
 
 
+# ------------------------------------------------------------ prefetch lane
+
+
+class PrefetchModel(_Model):
+    """The overlapped learner pipeline lifecycle (runtime/learner.py
+    PrefetchLane + _fetch_next, --learner.prefetch):
+
+        ready --lane-take--> fetch-locals --put-dispatch--> in-flight
+              --retire--> retired (lease released) --enqueue--> slot
+              --loop-take--> train(N+1)  ‖  device still running step N
+
+    One prefetch lane and one loop thread share a depth-1 handoff slot;
+    the lane's device_put reads the staged buffer ASYNCHRONOUSLY (jax
+    defers the host read of a put numpy buffer), modeled as a dispatch
+    step and a separate retire step, with the ring-slot repack hazard
+    carried over from RingLeaseModel: once the lease is released, the
+    packer may re-zero and repack the buffer. A drain controller
+    quiesces the source and polls the drained() stations — ready,
+    lane-locals (the _inflight flag), handoff slot — before declaring
+    the zero-loss verdict.
+
+    Invariants: the retire observes the generation the dispatch read
+    (anything else is the PR-11 H2D corruption); the loop trains only
+    RETIRED batches (a batch handed over before its put retired could
+    have its lease released and the buffer repacked under the in-flight
+    read); drained()==True implies every popped batch was trained or is
+    still visibly pending — never held invisibly by the lane.
+
+    Mutants (the classes this PR's protocol must exclude):
+    - ``release_before_retire``: the lane releases the ring lease at
+      put-DISPATCH — the packer repacks under the in-flight transfer
+      (the PR-11 bug, now one thread further from the loop).
+    - ``train_consumes_inflight``: the lane enqueues the batch BEFORE
+      the retire, so the loop can train a batch whose transfer is
+      un-retired while its lease is already back with the packers.
+    - ``drain_ignores_prefetch``: drained() skips the lane stations
+      (inflight flag + handoff slot) — a SIGTERM drain declares victory
+      over the batch the lane holds (the PR-7 loss class, one station
+      further downstream)."""
+
+    threads = ("packer", "lane", "loop", "drainer")
+
+    def __init__(self, depth: int = 2, batches: int = 3, mutant: Optional[str] = None):
+        assert mutant in (
+            None,
+            "release_before_retire",
+            "train_consumes_inflight",
+            "drain_ignores_prefetch",
+        )
+        self.depth = depth
+        self.batches = batches
+        self.mutant = mutant
+
+    def init(self) -> dict:
+        return {
+            # ring slots (the staging-side buffers the lane leases)
+            "free": tuple(range(self.depth)),
+            "slot_gen": {i: 0 for i in range(self.depth)},
+            "in_flight": {},  # slot -> generation the dispatch read
+            "ready": (),  # (slot, generation) packed, awaiting the lane
+            "p_pc": "acquire",
+            "p_slot": None,
+            "packed": 0,
+            "gen": 0,
+            # prefetch lane
+            "lane_pc": "take",
+            "lane_slot": None,
+            "lane_gen": None,
+            "lane_inflight": False,  # the holding() flag drained() reads
+            "handoff": (),  # (slot?, gen, retired) — depth-1 queue
+            # loop
+            "trained": 0,
+            # drain controller
+            "quiesce": False,
+            "drained_true": False,
+            "violations": [],
+        }
+
+    # -- enabledness ---------------------------------------------------
+
+    def enabled(self, st: dict, tid: str) -> bool:
+        if tid == "packer":
+            if st["p_pc"] == "acquire":
+                return (
+                    not st["quiesce"]
+                    and st["packed"] < self.batches
+                    and bool(st["free"])
+                )
+            if st["p_pc"] == "put":
+                return len(st["ready"]) < 2
+            return st["p_pc"] not in ("acquire", "done")
+        if tid == "lane":
+            if st["lane_pc"] == "take":
+                return bool(st["ready"])
+            if st["lane_pc"] == "enqueue":
+                return not st["handoff"]  # depth-1 handoff slot
+            return st["lane_pc"] != "take"
+        if tid == "loop":
+            return bool(st["handoff"]) and st["trained"] < self.batches
+        # drainer: quiesce once the pipe has material, then poll until
+        # the verdict lands
+        return not st["drained_true"]
+
+    # -- transitions ---------------------------------------------------
+
+    def step(self, st: dict, tid: str) -> None:
+        if tid == "packer":
+            pc = st["p_pc"]
+            if pc == "acquire":
+                sid, st["free"] = st["free"][0], st["free"][1:]
+                st["p_slot"] = sid
+                st["p_pc"] = "pack"
+            elif pc == "pack":
+                sid = st["p_slot"]
+                st["gen"] += 1
+                st["slot_gen"][sid] = st["gen"]
+                if sid in st["in_flight"]:
+                    st["violations"].append(
+                        f"packer repacked slot {sid} under an in-flight H2D "
+                        f"read — the device receives the next batch's bytes "
+                        f"(the PR-11 early-release corruption, via the lane)"
+                    )
+                st["p_pc"] = "put"
+            elif pc == "put":
+                sid = st["p_slot"]
+                st["ready"] += ((sid, st["slot_gen"][sid]),)
+                st["p_slot"] = None
+                st["packed"] += 1
+                st["p_pc"] = "acquire"
+            return
+        if tid == "lane":
+            pc = st["lane_pc"]
+            if pc == "take":
+                # one region: the pop AND the inflight flag (the
+                # holding() visibility contract — set before the batch
+                # can live only in lane locals)
+                st["lane_inflight"] = True
+                (sid, gen), st["ready"] = st["ready"][0], st["ready"][1:]
+                st["lane_slot"], st["lane_gen"] = sid, gen
+                st["lane_pc"] = "dispatch"
+            elif pc == "dispatch":
+                sid = st["lane_slot"]
+                st["in_flight"][sid] = st["lane_gen"]
+                if self.mutant == "release_before_retire":
+                    st["free"] += (sid,)  # lease back at dispatch: the bug
+                if self.mutant == "train_consumes_inflight":
+                    st["lane_pc"] = "enqueue"  # hand over un-retired
+                else:
+                    st["lane_pc"] = "retire"
+            elif pc == "retire":
+                sid = st["lane_slot"]
+                observed = st["slot_gen"][sid]
+                if observed != st["lane_gen"]:
+                    st["violations"].append(
+                        f"transfer of slot {sid} retired holding generation "
+                        f"{observed}, dispatched with {st['lane_gen']} — H2D "
+                        f"read tore across a repack"
+                    )
+                st["in_flight"].pop(sid, None)
+                if self.mutant != "release_before_retire":
+                    st["free"] += (sid,)  # release AFTER retire (HEAD)
+                st["lane_pc"] = "enqueue"
+            elif pc == "enqueue":
+                retired = st["lane_slot"] not in st["in_flight"]
+                st["handoff"] = ((st["lane_slot"], st["lane_gen"], retired),)
+                st["lane_slot"] = st["lane_gen"] = None
+                # flag cleared AFTER the handoff put (holding() gap rule)
+                st["lane_inflight"] = False
+                st["lane_pc"] = "take"
+            return
+        if tid == "loop":
+            (sid, gen, retired), st["handoff"] = st["handoff"][0], ()
+            if not retired:
+                # the mutant path: finish the lifecycle the lane skipped
+                # — but the TRAIN below already consumed an un-retired
+                # transfer, which is the violation
+                st["violations"].append(
+                    f"loop trained a batch whose H2D transfer had not "
+                    f"retired (slot {sid}) — with the lease released, the "
+                    f"packer can repack the buffer under the read"
+                )
+                st["in_flight"].pop(sid, None)
+                st["free"] += (sid,)
+            st["trained"] += 1
+            return
+        # drainer
+        if not st["quiesce"]:
+            st["quiesce"] = True
+            return
+        # drained() poll — stations in downstream order: ready, lane
+        # locals, handoff slot. One atomic poll per drainer step is
+        # CONSERVATIVE for finding the mutant (the real drained() reads
+        # stations one lock at a time, strictly weaker), and the mutant
+        # must fail even against the strong form — which it does,
+        # because the skipped stations are simply never read.
+        stations_clear = not st["ready"]
+        if self.mutant != "drain_ignores_prefetch":
+            stations_clear = (
+                stations_clear
+                and not st["lane_inflight"]
+                and not st["handoff"]
+            )
+        if stations_clear:
+            st["drained_true"] = True
+            held = (1 if st["lane_inflight"] else 0) + len(st["handoff"]) + len(st["ready"])
+            if held:
+                st["violations"].append(
+                    f"drained() returned True with {held} batch(es) still "
+                    f"held by the prefetch pipe — a SIGTERM drain would "
+                    f"lose them (the PR-7 class, prefetch station)"
+                )
+            if st["packed"] > st["trained"]:
+                st["violations"].append(
+                    f"drain verdict with {st['packed'] - st['trained']} "
+                    f"packed-but-untrained batch(es) unaccounted"
+                )
+
+    def is_local(self, st: dict, tid: str) -> bool:
+        return False
+
+    def done(self, st: dict) -> bool:
+        return st["drained_true"]
+
+    def final_check(self, st: dict) -> List[str]:
+        out = []
+        if st["trained"] != st["packed"]:
+            out.append(
+                f"conservation: {st['packed']} batches packed but "
+                f"{st['trained']} trained at drain"
+            )
+        return out
+
+
 def head_models() -> Dict[str, _Model]:
     """The HEAD-protocol model set the nightly soak and the acceptance
     tests exhaust — one entry per protocol, no mutants."""
     return {
         "ring_lease": RingLeaseModel(depth=2, batches=3),
+        "prefetch": PrefetchModel(depth=2, batches=3),
         "drained": DrainedModel(frames=2),
         "coalesce": CoalesceModel(versions=3),
         "hot_swap": HotSwapModel(swaps=2, ticks=2, rows=2),

@@ -178,6 +178,33 @@ class StagingConfig:
 
 
 @dataclass
+class LearnerPipelineConfig:
+    """Overlapped learner step loop (runtime/learner.py PrefetchLane):
+    a dedicated prefetch thread runs the whole host side of batch N+1 —
+    staging pop, pack-pool pack, device_put dispatch, lease retire —
+    WHILE the device executes train step N, so the host wall disappears
+    behind the device step (ROADMAP item 1; OPPO 2509.25762 pipeline
+    overlap, PAPERS.md). Batch ORDER is unchanged (the lane is the same
+    single staging consumer, FIFO), so the pipelined loop's params are
+    BITWISE identical to the serial loop over the same frame schedule —
+    OVERLAP_AB.json commits the proof. The PR-7 SIGTERM-drain contract
+    survives: an in-flight prefetched batch is trained out (never
+    dropped) and staging.drained() gains the prefetch-lane station."""
+
+    # Master switch. True (default) = the pipelined loop. False restores
+    # the serial fetch-after-step loop byte-for-byte (no lane thread, no
+    # pipeline_* scalars — the rollback path, MIGRATION item 15).
+    prefetch: bool = True
+    # Batches the lane may hold fetched-ahead (the handoff queue bound).
+    # 1 = classic double buffering: batch N+1 fully staged while step N
+    # runs. Sizing rule (README "Pipelined learner"): every queued batch
+    # ages one extra learner version before training, so keep
+    # prefetch_depth well under ppo.max_staleness (default 4) — depth 1
+    # is right unless a single fetch is slower than a device step.
+    prefetch_depth: int = 1
+
+
+@dataclass
 class WireConfig:
     """Experience-wire quantization (transport/serialize.py DTR3).
     Producer-side only — consumers (staging, the native packer) accept
@@ -457,12 +484,15 @@ class ObsConfig:
     install_handlers: bool = True
     # Learner step-phase decomposition (obs/compute.py StepPhaseTimer):
     # fetch/pack/h2d/device_step/host wall time per iteration, logged as
-    # compute_phase_* scalars. COSTS THE PIPELINE OVERLAP: the loop
-    # fences the device (block_until_ready) once per step so each phase
-    # is causally attributable — exactly the round-3 overlap the normal
-    # loop exists to avoid. On by default under obs.enabled because a
-    # deploy that opted into observability wants the decomposition; set
-    # false to keep tracing/scrape at full pipelined speed.
+    # compute_phase_* scalars. Under the pipelined loop
+    # (--learner.prefetch, the default) the timer runs in OVERLAP mode:
+    # fetch/pack/h2d are recorded on the prefetch lane (fenced there —
+    # the lane's own time, hidden behind the device step), the loop lane
+    # reports take-wait/residual/host, phases still tile the wall, and
+    # the pipeline_* scalars carry the overlap accounting — no per-step
+    # device fence, no overlap forfeited. Only the SERIAL loop
+    # (--learner.prefetch false) still pays the per-step
+    # block_until_ready fence for causal attribution.
     step_phases: bool = True
     # Where POST /profile?seconds=N captures land (jax.profiler.trace
     # TensorBoard dirs). "" = dump_dir (or cwd). Replaces the deprecated
@@ -535,6 +565,11 @@ class LearnerConfig:
     native_packer: bool = True
     # Parallel host feed (--staging.pack_workers / --staging.transfer_depth).
     staging: StagingConfig = field(default_factory=StagingConfig)
+    # Overlapped step loop (--learner.prefetch / --learner.prefetch_depth):
+    # the field is named `learner` so the flags spell --learner.* on the
+    # learner binary — the pipeline knobs of the loop itself, as opposed
+    # to the staging/transport layers above.
+    learner: LearnerPipelineConfig = field(default_factory=LearnerPipelineConfig)
     # Stage obs floats in the policy compute dtype (bf16) on the host:
     # numerically identical (the policy's first op is the same cast) and
     # halves the dominant host→device transfer (runtime/staging.py
@@ -549,9 +584,13 @@ class LearnerConfig:
     # ONE [B, row_bytes] u8 buffer per batch (free in-jit bitcasts
     # unpack it). Saves the remaining 3 per-transfer RPC overheads on
     # tunneled/remote chips; a wash on directly-attached hardware.
-    # Default off until bench's transfer_layout_ab justifies it on the
-    # target link (decide-with-data).
-    fused_single_h2d: bool = False
+    # Default ON (the production pipelined path): the committed transfer
+    # A/B on the tunneled chip put the same batch bytes at 1.961 ms as
+    # 4 group buffers vs 0.105 ms as one buffer
+    # (BENCH_TPU_20260730T0510.json transfer_layout_ab; OVERLAP_AB.json
+    # re-records the layout A/B beside the pipelined-loop evidence).
+    # Set false to fall back to the 4-buffer layout.
+    fused_single_h2d: bool = True
     # jax.profiler server port (0 = off); connect with TensorBoard's
     # profile plugin or jax.profiler.trace to capture device traces
     profile_port: int = 0

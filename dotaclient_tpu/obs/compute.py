@@ -9,11 +9,15 @@ causes:
 
 - StepPhaseTimer   every learner iteration split into
                    fetch / pack / h2d / device_step / host wall time.
-                   Needs block_until_ready fencing (the loop gives up
-                   the round-3 prefetch overlap while on), so it only
-                   exists under --obs.enabled + --obs.step_phases; the
-                   disabled path constructs nothing and the loop keeps
-                   its pipelined shape.
+                   Exists only under --obs.enabled + --obs.step_phases;
+                   the disabled path constructs nothing. In the SERIAL
+                   loop it fences per step (block_until_ready) for
+                   causal attribution; under the pipelined loop
+                   (--learner.prefetch) it runs in OVERLAP mode — the
+                   prefetch lane records its own fetch/pack/h2d, the
+                   loop lane reports the exposed wait/residual/host,
+                   and the pipeline_* family carries the overlap
+                   accounting with no per-step fence.
 - RecompileSentinel wraps the jitted train step, hashes the abstract
                    avals + treedef of every call, counts signatures
                    beyond the first as recompiles, records compile wall
@@ -71,17 +75,54 @@ class StepPhaseTimer:
     closing step(), so a STARVED window's fetch mean can exceed its wall
     mean — starvation is exactly when that should read loud. In a fed
     window the phases tile the wall (the acceptance property).
+
+    OVERLAP mode (``overlap=True`` — the pipelined loop,
+    ``--learner.prefetch``): the host side of batch N+1 runs on a
+    dedicated prefetch lane WHILE the device executes step N, so
+    fencing the loop per step would destroy exactly what it measures.
+    Instead the accounting splits into two lanes:
+
+    - the LOOP lane keeps the single-writer add()/step() contract, but
+      ``fetch`` now means the loop's wait for a prefetched batch (the
+      exposed, un-hidden host time — the device-idle upper bound),
+      ``pack``/``h2d`` stay 0 there, ``device_step`` is the UNFENCED
+      residual (the in-flight device window from the loop's clock), and
+      ``host`` is publish/checkpoint work as before — phases still tile
+      the wall, by construction rather than by fencing;
+    - the PREFETCH lane records its own fetch/pack/h2d wall via
+      add_overlap() — called from the lane thread, so those sums live
+      under a lock (``overlap_s`` accounting) — and window_scalars()
+      reports them as the ``pipeline_*`` family: per-lane means,
+      ``pipeline_prefetch_s`` (lane busy per step),
+      ``pipeline_device_idle_s`` (the exposed loop wait), and
+      ``pipeline_overlap_ratio`` (share of lane work hidden behind the
+      device step).
     """
 
     PHASES = ("fetch", "pack", "h2d", "device_step", "host")
+    LANE_PHASES = ("fetch", "pack", "h2d")
 
-    def __init__(self):
+    def __init__(self, overlap: bool = False):
+        self.overlap = overlap
         self._sums: Dict[str, float] = dict.fromkeys(self.PHASES, 0.0)
         self._wall = 0.0
         self._steps = 0
+        # Prefetch-lane sums (overlap mode only): written by the lane
+        # thread, read by the loop thread at window close — the one
+        # cross-thread surface, so it gets its own lock (a handful of
+        # acquisitions per step against a multi-ms step).
+        self._lane_lock = threading.Lock()
+        self._lane_sums: Dict[str, float] = dict.fromkeys(self.LANE_PHASES, 0.0)
 
     def add(self, phase: str, seconds: float) -> None:
         self._sums[phase] += max(float(seconds), 0.0)
+
+    def add_overlap(self, phase: str, seconds: float) -> None:
+        """Prefetch-lane attribution (overlap mode): fetch/pack/h2d time
+        the lane paid for a batch, hidden behind the device step. Called
+        from the lane thread — the only writer of these sums."""
+        with self._lane_lock:
+            self._lane_sums[phase] += max(float(seconds), 0.0)
 
     def step(self, wall_seconds: float) -> None:
         """Close one loop iteration: its total wall time."""
@@ -91,13 +132,28 @@ class StepPhaseTimer:
     def window_scalars(self, reset: bool = True) -> Dict[str, float]:
         """Mean seconds per step for each phase over the window, the
         mean iteration wall, and the fetch fraction (the watchdog's
-        starvation signal). Resets the window by default (the learner
-        logs once per metrics window, like its win_* accumulators)."""
+        starvation signal). Overlap mode adds the pipeline_* lane
+        scalars. Resets the window by default (the learner logs once
+        per metrics window, like its win_* accumulators)."""
         n = max(self._steps, 1)
         out = {f"compute_phase_{p}_s": self._sums[p] / n for p in self.PHASES}
         out["compute_phase_wall_s"] = self._wall / n
         if self._wall > 0:
             out["compute_phase_fetch_frac"] = self._sums["fetch"] / self._wall
+        if self.overlap:
+            with self._lane_lock:
+                lane = dict(self._lane_sums)
+                if reset:
+                    self._lane_sums = dict.fromkeys(self.LANE_PHASES, 0.0)
+            lane_total = sum(lane.values())
+            exposed = self._sums["fetch"]  # loop wait for a prefetched batch
+            for p in self.LANE_PHASES:
+                out[f"pipeline_prefetch_{p}_s"] = lane[p] / n
+            out["pipeline_prefetch_s"] = lane_total / n
+            out["pipeline_device_idle_s"] = exposed / n
+            out["pipeline_overlap_ratio"] = (
+                max(0.0, min(1.0, 1.0 - exposed / lane_total)) if lane_total > 0 else 1.0
+            )
         if reset:
             self._sums = dict.fromkeys(self.PHASES, 0.0)
             self._wall = 0.0
@@ -334,8 +390,9 @@ class ComputeObserver:
         peak_flops: Optional[float],
         recorder=None,
         step_phases: bool = True,
+        overlap: bool = False,
     ):
-        self.timer = StepPhaseTimer() if step_phases else None
+        self.timer = StepPhaseTimer(overlap=overlap) if step_phases else None
         self.mfu = MfuAccountant(flops_per_step, peak_flops)
         self.sentinel: Optional[RecompileSentinel] = None
         self._recorder = recorder

@@ -53,9 +53,18 @@ SCALARS: Dict[str, str] = {
     "ppo_kl_stopped": "1 if the KL early stop fired for this batch",
     # --- learner loop (runtime/learner.py) -----------------------------
     "env_steps_per_sec": "real (unmasked) env steps trained per second",
-    "time_wait_batch_s": "per-step host wait for a packed batch",
-    "time_device_put_s": "per-step host→device transfer time",
-    "time_step_s": "per-step residual (device step + dispatch)",
+    "time_wait_batch_s": (
+        "per-step host wait for a packed batch (pipelined loop: paid on "
+        "the prefetch lane, hidden behind the device step)"
+    ),
+    "time_device_put_s": (
+        "per-step host→device transfer time (pipelined loop: paid on "
+        "the prefetch lane)"
+    ),
+    "time_step_s": (
+        "per-step residual — device step + dispatch (pipelined loop: "
+        "wall minus the exposed take-wait)"
+    ),
     "active_actors": "actors heard from within the heartbeat window",
     "staleness_dropped": "rollouts dropped for version staleness (cumulative)",
     "staging_quarantined": (
@@ -238,6 +247,19 @@ PREFIXES: Dict[str, str] = {
     # actor_batch_occupancy. Exported by vector actors AND the
     # inference service (same batcher, same distribution semantics).
     "actor_tick_rows_": "rows-per-fired-tick occupancy histogram (runtime/actor.py InferenceBatcher)",
+    # overlapped learner pipeline (--learner.prefetch, runtime/learner.py
+    # PrefetchLane + obs/compute.py StepPhaseTimer overlap mode):
+    # pipeline_prefetch_s (prefetch-lane busy seconds per step:
+    # fetch+pack+h2d, hidden behind the device step),
+    # pipeline_prefetch_fetch_s / _pack_s / _h2d_s (the lane's own phase
+    # split, fenced ON THE LANE so attribution costs no overlap),
+    # pipeline_device_idle_s (the loop's exposed wait for a prefetched
+    # batch — the device-idle-per-step upper bound),
+    # pipeline_overlap_ratio (share of lane work hidden behind the
+    # device step; 1.0 = the host fully disappeared). Emitted only in
+    # pipelined mode — serial runs (--learner.prefetch false) emit
+    # nothing new. A family: the lane split can grow phases.
+    "pipeline_": "overlapped learner pipeline lane accounting (runtime/learner.py)",
     # parallel host feed scoreboard (runtime/staging.py _PackPool +
     # parallel/fused_io.py TransferRing, emitted by the learner loop
     # only when --staging.pack_workers > 1):

@@ -16,26 +16,37 @@ The python-side `version` counter mirrors state.step without forcing a
 device sync every iteration; it is the version actors stamp on their
 rollouts and the learner's staleness filter reads.
 
-Pipelining (round-3): the loop never blocks on the device except where
-semantics require it —
-- the NEXT batch is fetched from staging and device_put while the
-  current step runs (double buffering; jax async dispatch);
+Pipelining (--learner.prefetch, default ON — the ISSUE-15 overlapped
+loop): the loop never blocks on the device except where semantics
+require it —
+- a dedicated PREFETCH LANE thread runs the whole host side of batch
+  N+1 — staging pop, pack wait, device_put dispatch, transfer retire,
+  ring-lease release — WHILE the device executes train step N, so the
+  loop thread's per-iteration host cost collapses to one queue pop plus
+  the async train-step dispatch (double buffering with a real second
+  lane, not just jax async dispatch; OVERLAP_AB.json commits the
+  serial-vs-pipelined evidence and the bitwise-params parity proof);
 - metrics are device_get only every `metrics_every` steps (each fetch is
   a full device sync);
 - weight publishes dispatch ONE on-device flatten (ParamFlattener) and
   hand the device buffer to a dedicated publisher thread, which pays
   the blocking single-transfer host read + serialize + broker I/O with
   latest-wins coalescing. Stream ordering keeps this safe against the
-  train step's state donation (flatten is dispatched first).
+  train step's state donation (flatten is dispatched first, on the loop
+  thread — the lane never touches the state);
+- `--learner.prefetch false` restores the serial fetch-after-step loop
+  byte-for-byte (no lane thread, no pipeline_* scalars — the rollback
+  path, MIGRATION item 15).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import queue
 import threading
 import time
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -291,11 +302,180 @@ class CheckpointWorker:
             t.join(timeout=60)
 
 
+class _LaneItem(NamedTuple):
+    """One prefetch-lane handoff: kind ∈ {"batch", "idle", "exhausted",
+    "error"}. `wait_s`/`put_s` are the lane's own fetch-wait and
+    device-put attribution for the window accumulators (an "idle" item
+    carries the empty wait so starvation stays visible)."""
+
+    kind: str
+    batch: object
+    env_steps: int
+    wait_s: float
+    put_s: float
+    trace: object
+    error: Optional[BaseException]
+
+
+class PrefetchLane:
+    """The dedicated prefetch stage of the pipelined learner loop
+    (--learner.prefetch): runs the WHOLE host side of batch N+1 —
+    staging pop, pack wait, device_put dispatch, transfer retire, ring
+    lease release — on its own thread while the loop thread keeps the
+    device busy with step N, handing finished batches over a bounded
+    queue (depth = --learner.prefetch_depth; 1 = classic double
+    buffering).
+
+    Ownership rules carried over from the serial loop, unchanged:
+    - the lane is the ONE staging consumer, popping FIFO — batch order
+      is identical to the serial loop, which is why the pipelined
+      params are BITWISE equal to the serial params over the same
+      frame schedule (OVERLAP_AB.json parity arm);
+    - a ring lease is released only after ITS device_put retired
+      (inside Learner._fetch_next — the PR-11 donation-safety rule;
+      the lane moves the release off the loop thread, it never moves
+      it before the retire);
+    - `holding()` makes a popped-but-untrained batch visible to
+      staging.drained() as the prefetch station, so the PR-7 SIGTERM
+      zero-loss contract extends through the lane: a drain trains the
+      in-flight prefetched batch out, never drops it.
+
+    Budget (`limit` = the run's num_steps): the lane never fetches more
+    batches than the loop will train, so a finite phased run
+    (train → eval → train, scripts/train_north_star.py) cannot eat and
+    discard a trailing batch — exactly the serial loop's
+    no-trailing-prefetch rule. Empty waits ("idle" items) consume no
+    budget. Fetch errors surface on the loop thread via "error" items
+    (the staging _check_fatal fast-failure contract survives the lane).
+    """
+
+    def __init__(
+        self,
+        fetch_fn,
+        depth: int = 1,
+        limit: Optional[int] = None,
+        drain: Optional[threading.Event] = None,
+        abort: Optional[threading.Event] = None,
+        upstream_drained=None,
+        stop_event: Optional[threading.Event] = None,
+    ):
+        self._fetch = fetch_fn  # () -> (batch, env_steps, wait_s, put_s, trace)
+        self._out: "queue.Queue[_LaneItem]" = queue.Queue(maxsize=max(int(depth), 1))
+        self._limit = limit
+        self._drain = drain
+        self._abort = abort
+        self._upstream_drained = upstream_drained
+        # Doubles as the staging-getter cancel hook (the caller threads
+        # it into _fetch_next): a stopping lane aborts its in-flight
+        # wait within one 0.2s slice instead of sitting out a full
+        # batch timeout (and overlapping a successor lane's pops on a
+        # phased driver's next run()).
+        self.stop_event = stop_event if stop_event is not None else threading.Event()
+        # True from just before a fetch (which may pop a batch into this
+        # thread's locals) until the item is in the handoff queue — the
+        # drained() visibility contract (the _popping/_packing pattern,
+        # one station further downstream). Atomically-rebound bool,
+        # read once by holding().
+        self._inflight = False
+        self._thread: Optional[threading.Thread] = None
+        self.fetched = 0  # successful batches delivered (telemetry/tests)
+
+    def start(self) -> "PrefetchLane":
+        t = threading.Thread(target=self._run, daemon=True, name="learner-prefetch")
+        self._thread = t
+        t.start()
+        return self
+
+    def holding(self) -> bool:
+        """True while the lane holds popped-but-untrained frames — in
+        its thread locals (mid-fetch) or the handoff queue. This is
+        staging's prefetch drained() station; single reads of a
+        rebound bool + one queue empty-check (gauge semantics: a
+        False->True flicker only delays a drain verdict one poll)."""
+        inflight = self._inflight
+        return inflight or not self._out.empty()
+
+    def get(self, timeout: float) -> _LaneItem:
+        """Next handoff item (the loop thread's side). Raises
+        queue.Empty on timeout — callers poll in short slices so
+        abort/deadline stay responsive."""
+        return self._out.get(timeout=timeout)
+
+    def _put(self, item: _LaneItem) -> None:
+        while not self.stop_event.is_set():
+            try:
+                self._out.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _run(self) -> None:
+        while not self.stop_event.is_set():
+            if self._limit is not None and self.fetched >= self._limit:
+                # Budget consumed: every batch the loop will train is
+                # fetched (or queued) — never eat a trailing batch.
+                return
+            self._inflight = True
+            try:
+                try:
+                    batch, env_steps, wait_s, put_s, trace = self._fetch()
+                except BaseException as e:  # surfaces on the loop thread
+                    self._put(_LaneItem("error", None, 0, 0.0, 0.0, None, e))
+                    return
+                if batch is None:
+                    if self._abort is not None and self._abort.is_set():
+                        return
+                    if (
+                        self._drain is not None
+                        and self._drain.is_set()
+                        and (
+                            self._upstream_drained is None
+                            or self._upstream_drained()
+                        )
+                    ):
+                        # SIGTERM drain: nothing upstream will ever
+                        # arrive again. FIFO guarantees this lands
+                        # AFTER any still-queued batch, so the loop
+                        # trains everything out first.
+                        self._put(_LaneItem("exhausted", None, 0, wait_s, 0.0, None, None))
+                        return
+                    self._put(_LaneItem("idle", None, 0, wait_s, 0.0, None, None))
+                    continue
+                self.fetched += 1
+                self._put(_LaneItem("batch", batch, env_steps, wait_s, put_s, trace, None))
+            finally:
+                # Cleared AFTER the handoff put: the queue's own
+                # non-emptiness covers the item from here, so holding()
+                # never has a gap a drain could slip through.
+                self._inflight = False
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+
+
 class Learner:
     def __init__(self, cfg: LearnerConfig, broker: Broker, mesh=None):
         self.cfg = cfg
         self.broker = broker
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(cfg.mesh_shape)
+        # Overlapped step loop (--learner.prefetch, PrefetchLane): ON by
+        # default; False restores the serial fetch-after-step loop
+        # byte-for-byte (no lane thread, no pipeline_* scalars, no
+        # staging probe — the flag-off inertness contract).
+        pipeline_cfg = getattr(cfg, "learner", None)
+        self._prefetch_enabled = bool(
+            pipeline_cfg is not None and pipeline_cfg.prefetch
+        )
+        self._prefetch_depth = (
+            max(int(pipeline_cfg.prefetch_depth), 1) if pipeline_cfg is not None else 1
+        )
+        # The live lane of the CURRENT run() (None between runs and in
+        # serial mode); staging's prefetch drained() station reads it
+        # through _prefetch_holding.
+        self._prefetch_lane: Optional[PrefetchLane] = None
         # Fused 4-buffer H2D path when enabled and not sequence-parallel
         # (fused_io.py); per-leaf tree path otherwise. Same compiled math.
         # The replay reservoir also forces the tree path: the per-row
@@ -409,6 +589,12 @@ class Learner:
             tracer=self.obs.tracer if self.obs is not None else None,
             recorder=self.obs.recorder if self.obs is not None else None,
         )
+        if self._prefetch_enabled:
+            # The prefetch station of the zero-loss drain contract: a
+            # batch the lane popped but the loop has not trained is
+            # visible to staging.drained() (PR-7, one station further
+            # downstream). Serial mode attaches nothing.
+            self.staging.attach_prefetch_probe(self._prefetch_holding)
         self.flattener = ParamFlattener(state.params)
         # Full-state mode: every fanned-out version is persisted as a
         # high-water mark (tiny atomic file, publisher thread) so a
@@ -444,7 +630,14 @@ class Learner:
             from dotaclient_tpu.ops.flops import aggregate_peak_flops, train_step_flops
 
             compute = self.obs.attach_compute(
-                train_step_flops(cfg), aggregate_peak_flops(jax.devices())
+                train_step_flops(cfg),
+                aggregate_peak_flops(jax.devices()),
+                # Pipelined loop: the phase timer runs in OVERLAP mode —
+                # fetch/pack/h2d recorded on the prefetch lane (fenced
+                # there, hidden behind the device step), loop lane
+                # reports take-wait/residual/host, pipeline_* scalars
+                # carry the overlap accounting. No per-step fence.
+                overlap=self._prefetch_enabled,
             )
             self.train_step = compute.wrap_train_step(self.train_step)
             # (The liveness watchdog attaches at the END of __init__,
@@ -576,6 +769,13 @@ class Learner:
             )
 
     # ---------------------------------------------------------------- ops
+
+    def _prefetch_holding(self) -> bool:
+        """staging.drained()'s prefetch station: True while the current
+        run's lane holds popped-but-untrained frames. Single read of a
+        rebound attribute — safe from any thread."""
+        lane = self._prefetch_lane
+        return lane is not None and lane.holding()
 
     def _obs_gauges(self):
         """Live gauges for the /metrics scrape (obs_ prefix = the
@@ -834,12 +1034,16 @@ class Learner:
 
     # --------------------------------------------------------------- loop
 
-    def _fetch_next(self, batch_timeout: float):
+    def _fetch_next(self, batch_timeout: float, lane: bool = False, cancel=None):
         """Pull one batch off staging and device_put it (dp-sharded).
 
-        Called AFTER the current step has been dispatched, so the host
-        wait and the transfer overlap the running device step. Returns
-        (batch_dev, env_steps, wait_s, put_s, trace) or
+        Serial loop: called AFTER the current step has been dispatched,
+        so the host wait and the transfer overlap the running device
+        step. Pipelined loop (`lane=True`): called on the PrefetchLane
+        thread — the same work, now FULLY off the loop thread, with
+        phase attribution routed to the timer's overlap-lane sums
+        (add_overlap) and the staging wait cancellable at lane teardown.
+        Returns (batch_dev, env_steps, wait_s, put_s, trace) or
         (None, 0, w, 0.0, None); `trace` is the batch's obs trace refs
         (staging.last_batch_trace) with the h2d hop already recorded —
         at DISPATCH time, like every hop this loop records (the loop
@@ -850,11 +1054,17 @@ class Learner:
         put_s — that bucket is the pure H2D transfer).
         """
         timer = self.obs.compute.timer if self.obs is not None and self.obs.compute else None
-        t0 = time.perf_counter()
-        batch, groups = self.staging.get_batch_groups(timeout=batch_timeout)
-        t1 = time.perf_counter()
+        add = None
         if timer is not None:
-            timer.add("fetch", t1 - t0)
+            # Overlap mode attributes fetch/pack/h2d to the prefetch
+            # lane (its own fenced wall, hidden behind the device step);
+            # the serial timer keeps the loop-lane single-writer path.
+            add = timer.add_overlap if lane else timer.add
+        t0 = time.perf_counter()
+        batch, groups = self.staging.get_batch_groups(timeout=batch_timeout, cancel=cancel)
+        t1 = time.perf_counter()
+        if add is not None:
+            add("fetch", t1 - t0)
         if batch is None:
             return None, 0, t1 - t0, 0.0, None
         trace = self.staging.last_batch_trace
@@ -874,8 +1084,8 @@ class Learner:
             if groups is None:
                 groups = self.fused_io.pack_transfer(batch)
             t2 = time.perf_counter()
-            if timer is not None:
-                timer.add("pack", t2 - t1)
+            if add is not None:
+                add("pack", t2 - t1)
             shardings = self.fused_io.transfer_shardings()
             if self._n_proc > 1:
                 # Each process contributes its local rows; the result is
@@ -888,10 +1098,12 @@ class Learner:
                 )
             else:
                 batch_dev = jax.device_put(groups, shardings)
-            if timer is not None:
+            if add is not None:
                 # Fence: the phase is the real transfer, not its dispatch.
+                # On the prefetch lane the fence blocks only the lane —
+                # attribution costs no overlap there.
                 jax.block_until_ready(batch_dev)
-                timer.add("h2d", time.perf_counter() - t2)
+                add("h2d", time.perf_counter() - t2)
             if lease is not None:
                 # Release the ring slot only after the device_put RETIRES:
                 # jax may defer the host read of a put numpy buffer, and a
@@ -915,9 +1127,9 @@ class Learner:
             )
         else:
             batch_dev = jax.device_put(batch, self.batch_sharding)
-        if timer is not None:
+        if add is not None:
             jax.block_until_ready(batch_dev)
-            timer.add("h2d", time.perf_counter() - t1)
+            add("h2d", time.perf_counter() - t1)
         if self.obs is not None and trace is not None:
             self.obs.tracer.hop_batch("h2d", trace)
         return batch_dev, env_steps, t1 - t0, time.perf_counter() - t1, trace
@@ -939,24 +1151,19 @@ class Learner:
         `max_seconds`: stop cleanly once this much wall clock has elapsed
         (checked between steps) — for soak/bench drivers with a time
         budget rather than a step budget.
+
+        Loop shape: --learner.prefetch (default ON) runs the pipelined
+        loop — a PrefetchLane thread stages batch N+1 while the device
+        executes step N (_run_pipelined); prefetch=False runs the
+        serial fetch-after-step loop byte-for-byte (_run_serial).
         """
-        cfg = self.cfg
         self.staging.start()
         self.publisher.start()
-        # Step-phase decomposition (obs/compute.py): when the timer
-        # exists the loop FENCES the device once per step so each phase
-        # is causally attributable — trading the round-3 prefetch overlap
-        # for legibility. timer=None keeps the pipelined shape untouched.
-        compute = self.obs.compute if self.obs is not None else None
-        timer = compute.timer if compute is not None else None
         done_steps = 0
-        # per-window accumulators, reset at every metrics log
-        win_wait = win_put = 0.0
-        win_env_steps = 0
-        win_steps = 0
-        t_win = time.perf_counter()
-        metrics = None
-        idle = 0
+        # The latest dispatched metrics handle, shared with the finally
+        # fence: an exception mid-loop must still drain the in-flight
+        # device step before the staging/publisher teardown.
+        metrics_box = [None]
         try:
             # Inside the try so a failed publish or first fetch still
             # stops the staging/publisher threads (a leaked consumer
@@ -977,56 +1184,264 @@ class Learner:
                     return batch_timeout
                 return max(0.05, min(batch_timeout, deadline - time.monotonic()))
 
-            next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
-            win_wait += w
-            win_put += p
-            while num_steps is None or done_steps < num_steps:
-                if self._abort.is_set():
-                    # SIGKILL emulation: return NOW, staged work dies
-                    # with the incarnation (chaos controller contract).
-                    break
+            if self._prefetch_enabled:
+                done_steps = self._run_pipelined(
+                    num_steps, batch_timeout, max_idle, deadline, _bt, metrics_box
+                )
+            else:
+                done_steps = self._run_serial(
+                    num_steps, batch_timeout, max_idle, deadline, _bt, metrics_box
+                )
+        finally:
+            if metrics_box[0] is not None:
+                jax.block_until_ready(metrics_box[0])
+            self.staging.stop()
+            self.publisher.stop()
+            # flush, don't close: run() is re-entrant (phased drivers call
+            # it repeatedly); close() below releases the logger for good
+            self.metrics.flush()
+        return done_steps
+
+    def _run_serial(
+        self, num_steps, batch_timeout, max_idle, deadline, _bt, metrics_box
+    ) -> int:
+        """The serial fetch-after-step loop (--learner.prefetch false) —
+        the pre-pipeline behavior, byte-for-byte (the rollback path;
+        tests/test_pipeline.py pins the flag-off inertness)."""
+        cfg = self.cfg
+        # Step-phase decomposition (obs/compute.py): when the timer
+        # exists the SERIAL loop FENCES the device once per step so each
+        # phase is causally attributable — trading the round-3 prefetch
+        # overlap for legibility. (The pipelined loop instead runs the
+        # timer in overlap mode: attribution moves to the prefetch lane
+        # and no fence is paid — _run_pipelined.) timer=None keeps the
+        # async-dispatch shape untouched.
+        compute = self.obs.compute if self.obs is not None else None
+        timer = compute.timer if compute is not None else None
+        done_steps = 0
+        # per-window accumulators, reset at every metrics log
+        win_wait = win_put = 0.0
+        win_env_steps = 0
+        win_steps = 0
+        t_win = time.perf_counter()
+        metrics = None
+        idle = 0
+        next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
+        win_wait += w
+        win_put += p
+        while num_steps is None or done_steps < num_steps:
+            if self._abort.is_set():
+                # SIGKILL emulation: return NOW, staged work dies
+                # with the incarnation (chaos controller contract).
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if next_batch is None:
+                if self._drain.is_set():
+                    # Drain: staging intake is quiesced; an empty
+                    # fetch with nothing left to pack means the
+                    # in-flight work is trained out — return so the
+                    # caller can drain_save().
+                    if self.staging.drained():
+                        break
+                    next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
+                    win_wait += w
+                    win_put += p
+                    continue
+                idle += 1
+                if max_idle is not None and idle >= max_idle:
+                    raise TimeoutError(
+                        f"no batch for {idle} consecutive {batch_timeout:.0f}s waits "
+                        f"— producers dead or stalled"
+                    )
                 if deadline is not None and time.monotonic() >= deadline:
                     break
-                if next_batch is None:
-                    if self._drain.is_set():
-                        # Drain: staging intake is quiesced; an empty
-                        # fetch with nothing left to pack means the
-                        # in-flight work is trained out — return so the
-                        # caller can drain_save().
-                        if self.staging.drained():
-                            break
-                        next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
-                        win_wait += w
-                        win_put += p
+                _log.warning("no batch within %.0fs; waiting", batch_timeout)
+                next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
+                win_wait += w
+                win_put += p
+                continue
+            idle = 0
+            batch_dev, env_steps, batch_trace = next_batch, next_env_steps, next_trace
+            t_pass = time.perf_counter()
+            # Async dispatch: returns immediately, device runs the step.
+            self.state, metrics = self.train_step(self.state, batch_dev)
+            metrics_box[0] = metrics
+            if timer is not None:
+                # Fence: device_step is dispatch + execution wall. The
+                # prefetch below then runs AFTER the device finished —
+                # the overlap cost the serial step_phases mode documents.
+                jax.block_until_ready(metrics)
+                timer.add("device_step", time.perf_counter() - t_pass)
+            if self.obs is not None and batch_trace is not None:
+                # Terminal hops at DISPATCH (the loop's only routine
+                # sync is the metrics fetch): per-stage apply delta +
+                # the e2e actor→apply scalar that decomposes staleness.
+                self.obs.tracer.hop_batch("apply", batch_trace)
+                self.obs.tracer.e2e(batch_trace)
+            self.version += 1
+            done_steps += 1
+            self.env_steps_done += env_steps
+            win_env_steps += env_steps
+            win_steps += 1
+
+            last = num_steps is not None and done_steps >= num_steps
+            if not last:
+                # Host work below overlaps the in-flight device step.
+                # Skipped on the final step: a trailing prefetch would
+                # eat (and discard) one packed batch per phased-run
+                # call and could stall up to batch_timeout.
+                next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
+                win_wait += w
+                win_put += p
+            else:
+                next_batch, next_env_steps, next_trace = None, 0, None
+
+            t_host = time.perf_counter()
+            if self.version % cfg.publish_every == 0 and self._primary:
+                # One async on-device flatten dispatch; the blocking
+                # host read of the single buffer happens on the
+                # publisher thread. Donation-safe because this
+                # dispatch precedes the next (state-donating) train
+                # step in stream order (ParamFlattener docstring).
+                # Non-primary processes skip: weights are replicated
+                # and one fanout per version is the contract.
+                self.publisher.submit(
+                    self.flattener.flatten_on_device(self.state.params), self.version
+                )
+            if self.checkpointer is not None and self.version % cfg.checkpoint_every == 0:
+                self.checkpoint()
+
+            if timer is not None:
+                # Close the pass BEFORE a possible metrics window so
+                # window_scalars only ever aggregates fully-closed
+                # passes (a half-recorded pass would make the phase
+                # sum drift from the wall). The metrics sync/log below
+                # is the observer's own cost and stays outside the
+                # decomposition by design.
+                t_end = time.perf_counter()
+                timer.add("host", t_end - t_host)
+                timer.step(t_end - t_pass)
+
+            if self.version % cfg.metrics_every == 0 or last:
+                now = time.perf_counter()
+                self._log_window(
+                    metrics, now, t_win, win_steps, win_env_steps, win_wait, win_put
+                )
+                win_wait = win_put = 0.0
+                win_env_steps = win_steps = 0
+                t_win = now
+        return done_steps
+
+    def _run_pipelined(
+        self, num_steps, batch_timeout, max_idle, deadline, _bt, metrics_box
+    ) -> int:
+        """The overlapped loop (--learner.prefetch, default): a
+        PrefetchLane thread runs the whole host side of batch N+1 —
+        staging pop, pack wait, device_put dispatch, retire, ring-lease
+        release — while the device executes step N, so the loop thread's
+        per-iteration host cost is one queue pop + the async train-step
+        dispatch. Batch order is FIFO-identical to the serial loop (the
+        lane is the same single staging consumer), so params are BITWISE
+        equal to a serial run over the same frame schedule
+        (OVERLAP_AB.json). The SIGTERM drain trains out every batch the
+        lane holds (the "exhausted" sentinel lands FIFO-last), and the
+        lane's fetch budget is capped at num_steps so a phased run never
+        eats a trailing batch."""
+        cfg = self.cfg
+        compute = self.obs.compute if self.obs is not None else None
+        timer = compute.timer if compute is not None else None
+        # The lane's staging wait is cancellable at teardown via the
+        # lane's stop event — a stopping lane must never sit out a full
+        # batch timeout (nor overlap a successor lane's pops on a phased
+        # driver's next run()).
+        cancel = threading.Event()
+        lane = PrefetchLane(
+            lambda: self._fetch_next(_bt(), lane=True, cancel=cancel),
+            depth=self._prefetch_depth,
+            limit=num_steps,
+            drain=self._drain,
+            abort=self._abort,
+            upstream_drained=lambda: self.staging.drained(include_prefetch=False),
+            stop_event=cancel,
+        )
+        self._prefetch_lane = lane
+        lane.start()
+        done_steps = 0
+        win_wait = win_put = win_take = 0.0
+        win_env_steps = 0
+        win_steps = 0
+        t_win = time.perf_counter()
+        metrics = None
+        idle = 0
+        try:
+            while num_steps is None or done_steps < num_steps:
+                # Take the next prefetched item, staying responsive to
+                # abort/deadline in 0.2s slices (the lane's fetch waits
+                # park against _bt() on its own thread).
+                item = None
+                t_take0 = time.perf_counter()
+                while item is None:
+                    if self._abort.is_set():
+                        break
+                    if deadline is not None and time.monotonic() >= deadline:
+                        break
+                    try:
+                        item = lane.get(timeout=0.2)
+                    except queue.Empty:
                         continue
+                if item is None:
+                    break  # abort / deadline
+                take_s = time.perf_counter() - t_take0
+                if item.kind == "error":
+                    raise item.error
+                if item.kind == "exhausted":
+                    # Drain complete: the lane emits this sentinel ONLY
+                    # under a set _drain (budget exhaustion ends the
+                    # lane silently — the loop's own step bound ends
+                    # us), it proved nothing more can arrive upstream,
+                    # and FIFO put every remaining batch ahead of it —
+                    # everything the drain owed is trained out.
+                    break
+                if item.kind == "idle":
+                    # Starvation must read LOUD, exactly like the serial
+                    # loop's empty fetches: the wall spent polling for
+                    # this (empty) item is exposed loop wait — charge it
+                    # to the take accumulator and the timer's fetch
+                    # phase (compute_phase_fetch_frac is the watchdog's
+                    # starvation signal), not the device residual. A
+                    # starved window's fetch mean may exceed its wall
+                    # mean — the documented, intended read.
+                    win_take += take_s
+                    win_wait += item.wait_s
+                    if timer is not None:
+                        timer.add("fetch", take_s)
+                    if self._drain.is_set():
+                        continue  # the lane signals "exhausted" when done
                     idle += 1
                     if max_idle is not None and idle >= max_idle:
                         raise TimeoutError(
                             f"no batch for {idle} consecutive {batch_timeout:.0f}s waits "
                             f"— producers dead or stalled"
                         )
-                    if deadline is not None and time.monotonic() >= deadline:
-                        break
                     _log.warning("no batch within %.0fs; waiting", batch_timeout)
-                    next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
-                    win_wait += w
-                    win_put += p
                     continue
                 idle = 0
-                batch_dev, env_steps, batch_trace = next_batch, next_env_steps, next_trace
-                t_pass = time.perf_counter()
-                # Async dispatch: returns immediately, device runs the step.
-                self.state, metrics = self.train_step(self.state, batch_dev)
+                win_take += take_s
+                win_wait += item.wait_s
+                win_put += item.put_s
                 if timer is not None:
-                    # Fence: device_step is dispatch + execution wall. The
-                    # prefetch below then runs AFTER the device finished —
-                    # the overlap cost the step_phases flag documents.
-                    jax.block_until_ready(metrics)
-                    timer.add("device_step", time.perf_counter() - t_pass)
+                    # Loop-lane "fetch" = the EXPOSED wait for a
+                    # prefetched batch: host time the lane failed to
+                    # hide — the device-idle-per-step upper bound.
+                    timer.add("fetch", take_s)
+                batch_dev, env_steps, batch_trace = item.batch, item.env_steps, item.trace
+                t_pass = time.perf_counter()
+                # Async dispatch: returns immediately, device runs the
+                # step; the lane is already staging batch N+1 beside it.
+                self.state, metrics = self.train_step(self.state, batch_dev)
+                metrics_box[0] = metrics
                 if self.obs is not None and batch_trace is not None:
-                    # Terminal hops at DISPATCH (the loop's only routine
-                    # sync is the metrics fetch): per-stage apply delta +
-                    # the e2e actor→apply scalar that decomposes staleness.
                     self.obs.tracer.hop_batch("apply", batch_trace)
                     self.obs.tracer.e2e(batch_trace)
                 self.version += 1
@@ -1034,28 +1449,14 @@ class Learner:
                 self.env_steps_done += env_steps
                 win_env_steps += env_steps
                 win_steps += 1
-
                 last = num_steps is not None and done_steps >= num_steps
-                if not last:
-                    # Host work below overlaps the in-flight device step.
-                    # Skipped on the final step: a trailing prefetch would
-                    # eat (and discard) one packed batch per phased-run
-                    # call and could stall up to batch_timeout.
-                    next_batch, next_env_steps, w, p, next_trace = self._fetch_next(_bt())
-                    win_wait += w
-                    win_put += p
-                else:
-                    next_batch, next_env_steps, next_trace = None, 0, None
 
                 t_host = time.perf_counter()
                 if self.version % cfg.publish_every == 0 and self._primary:
-                    # One async on-device flatten dispatch; the blocking
-                    # host read of the single buffer happens on the
-                    # publisher thread. Donation-safe because this
-                    # dispatch precedes the next (state-donating) train
-                    # step in stream order (ParamFlattener docstring).
-                    # Non-primary processes skip: weights are replicated
-                    # and one fanout per version is the contract.
+                    # Same donation-safety as the serial loop: the
+                    # flatten dispatch precedes the next state-donating
+                    # train step in THIS thread's stream order (the lane
+                    # only ever touches batch buffers, never the state).
                     self.publisher.submit(
                         self.flattener.flatten_on_device(self.state.params), self.version
                     )
@@ -1063,117 +1464,147 @@ class Learner:
                     self.checkpoint()
 
                 if timer is not None:
-                    # Close the pass BEFORE a possible metrics window so
-                    # window_scalars only ever aggregates fully-closed
-                    # passes (a half-recorded pass would make the phase
-                    # sum drift from the wall). The metrics sync/log below
-                    # is the observer's own cost and stays outside the
-                    # decomposition by design.
+                    # Overlap mode: no per-step fence. device_step is
+                    # the UNFENCED residual — the in-flight device
+                    # window from the loop's clock — so the loop-lane
+                    # phases tile the wall by construction; the causal
+                    # fetch/pack/h2d split lives in the lane's own
+                    # pipeline_* sums (recorded fenced, on the lane).
                     t_end = time.perf_counter()
-                    timer.add("host", t_end - t_host)
-                    timer.step(t_end - t_pass)
+                    host_s = t_end - t_host
+                    timer.add("host", host_s)
+                    wall = t_end - t_take0
+                    timer.add("device_step", max(wall - take_s - host_s, 0.0))
+                    timer.step(wall)
 
                 if self.version % cfg.metrics_every == 0 or last:
-                    # The ONLY routine device sync in the loop.
-                    scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
                     now = time.perf_counter()
-                    stats = self.staging.stats()
-                    dt = max(now - t_win, 1e-9)
-                    n = max(win_steps, 1)
-                    scalars["env_steps_per_sec"] = win_env_steps / dt
-                    # per-stage split (SURVEY.md §5): window averages.
-                    # time_step_s is the residual — device step + dispatch
-                    # + publish-get — since the loop never syncs per step.
-                    scalars["time_wait_batch_s"] = win_wait / n
-                    scalars["time_device_put_s"] = win_put / n
-                    scalars["time_step_s"] = max(dt - win_wait - win_put, 0.0) / n
-                    scalars["active_actors"] = stats["active_actors"]
-                    scalars["staleness_dropped"] = stats["dropped_stale"]
-                    scalars["staging_quarantined"] = stats["quarantined"]
-                    scalars["queue_ready"] = stats["ready_batches"]
-                    scalars["episodes"] = stats["episodes"]
-                    # Experience-wire meters (DTR3 quantized wire): bytes
-                    # entering the staging intake and the fleet's frame
-                    # split by obs wire dtype — the consumers-first
-                    # rolling upgrade's progress gauge.
-                    scalars["wire_bytes_consumed_total"] = stats["wire_bytes"]
-                    scalars["wire_frames_obs_bf16_total"] = stats["wire_frames_obs_bf16"]
-                    scalars["wire_frames_obs_f32_total"] = stats["wire_frames_obs_f32"]
-                    # Broker-fabric scoreboard (broker_shard_* / fanin_*
-                    # registry prefix families): per-shard pop/starve
-                    # meters and the fence/dedup ledgers. Pure local
-                    # counters (no RPC); present only when --broker_url
-                    # is a shard list, so classic runs emit nothing new.
-                    fabric_stats = getattr(self.broker, "fabric_stats", None)
-                    if fabric_stats is not None:
-                        for k, v in fabric_stats().items():
-                            scalars[k] = float(v)
-                    # Parallel host feed scoreboard (staging_pack_*,
-                    # registry prefix family): per-worker busy/stall
-                    # seconds, ring occupancy/wait, packer-proper rows/s.
-                    # The pack_* keys exist only when --staging.pack_workers
-                    # > 1, so default runs emit nothing new here.
-                    for k, v in stats.items():
-                        if k.startswith("pack_"):
-                            scalars[f"staging_{k}"] = float(v)
-                    # Replay reservoir health (replay.enabled only):
-                    # occupancy, hit ratio, replayed-frame age histogram
-                    # buckets, bytes spilled — all pre-flattened scalars.
-                    for k, v in stats.items():
-                        if k.startswith("replay_"):
-                            scalars[k] = v
-                    scalars["weights_published"] = self.publisher.published
-                    scalars["weights_coalesced"] = self.publisher.coalesced
-                    if self.checkpointer is not None:
-                        # Remote-mirror health (ADVICE r4): a growing lag
-                        # means uploads can't keep the checkpoint cadence
-                        # and durability is silently behind.
-                        for k, v in self.checkpointer.mirror_stats().items():
-                            if isinstance(v, (int, float)):
-                                scalars[f"ckpt_mirror_{k}"] = v
-                        # Full-state save health (ckpt_* in obs/registry):
-                        # empty dict (no keys emitted) until the first
-                        # aux save, so default runs log nothing new.
-                        for k, v in self.checkpointer.save_stats().items():
-                            scalars[f"ckpt_{k}"] = float(v)
-                        if self._ckpt_worker is not None:
-                            scalars["ckpt_async_saves_total"] = float(
-                                self._ckpt_worker.saved
-                            )
-                            scalars["ckpt_async_coalesced_total"] = float(
-                                self._ckpt_worker.coalesced
-                            )
-                    if self._resume_scalars:
-                        # One-shot: the restore's provenance rides the
-                        # first logged window, then clears.
-                        scalars.update(self._resume_scalars)
-                        self._resume_scalars = {}
-                    if stats["episodes"] > 0:
-                        scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
-                    if self.obs is not None:
-                        # Per-stage pipeline latency histograms + the e2e
-                        # actor→apply decomposition (obs/trace.py). Empty
-                        # until traced frames flow (actors opted in).
-                        scalars.update(self.obs.tracer.scalars())
-                    if compute is not None:
-                        # compute_* families (obs/compute.py): phase means
-                        # over this window (every pass fully closed — see
-                        # the timer close above), cumulative recompile
-                        # counters, cumulative MFU.
-                        scalars.update(compute.window_scalars(win_steps, dt))
-                    self.metrics.log(self.version, scalars)
-                    win_wait = win_put = 0.0
+                    self._log_window(
+                        metrics, now, t_win, win_steps, win_env_steps,
+                        win_wait, win_put, win_take=win_take,
+                    )
+                    win_wait = win_put = win_take = 0.0
                     win_env_steps = win_steps = 0
                     t_win = now
         finally:
-            if metrics is not None:
-                jax.block_until_ready(metrics)
-            self.staging.stop()
-            self.publisher.stop()
-            # flush, don't close: run() is re-entrant (phased drivers call
-            # it repeatedly); close() below releases the logger for good
-            self.metrics.flush()
+            lane.stop()
+            self._prefetch_lane = None
         return done_steps
+
+    def _log_window(
+        self,
+        metrics,
+        now: float,
+        t_win: float,
+        win_steps: int,
+        win_env_steps: int,
+        win_wait: float,
+        win_put: float,
+        win_take: Optional[float] = None,
+    ) -> None:
+        """One metrics window — the ONLY routine device sync in the loop
+        (jax.device_get of the step metrics). Shared by both loop shapes;
+        `win_take` is the pipelined loop's exposed take-wait accumulator
+        (None = serial split)."""
+        compute = self.obs.compute if self.obs is not None else None
+        scalars = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        stats = self.staging.stats()
+        dt = max(now - t_win, 1e-9)
+        n = max(win_steps, 1)
+        scalars["env_steps_per_sec"] = win_env_steps / dt
+        # per-stage split (SURVEY.md §5): window averages. time_step_s is
+        # the residual — device step + dispatch + publish-get — since the
+        # loop never syncs per step.
+        scalars["time_wait_batch_s"] = win_wait / n
+        scalars["time_device_put_s"] = win_put / n
+        if win_take is None:
+            scalars["time_step_s"] = max(dt - win_wait - win_put, 0.0) / n
+        else:
+            # Pipelined loop: wait/put were paid on the prefetch lane,
+            # overlapping the device step — only the take-wait is
+            # exposed loop time, so the residual subtracts just that.
+            # The pipeline_* family carries the overlap accounting
+            # (obs overlap-mode timer refines these with fenced lane
+            # sums when step_phases is on — same keys, logged after).
+            lane_s = win_wait + win_put
+            scalars["time_step_s"] = max(dt - win_take, 0.0) / n
+            scalars["pipeline_prefetch_s"] = lane_s / n
+            scalars["pipeline_device_idle_s"] = win_take / n
+            scalars["pipeline_overlap_ratio"] = (
+                max(0.0, min(1.0, 1.0 - win_take / lane_s)) if lane_s > 0 else 1.0
+            )
+        scalars["active_actors"] = stats["active_actors"]
+        scalars["staleness_dropped"] = stats["dropped_stale"]
+        scalars["staging_quarantined"] = stats["quarantined"]
+        scalars["queue_ready"] = stats["ready_batches"]
+        scalars["episodes"] = stats["episodes"]
+        # Experience-wire meters (DTR3 quantized wire): bytes
+        # entering the staging intake and the fleet's frame
+        # split by obs wire dtype — the consumers-first
+        # rolling upgrade's progress gauge.
+        scalars["wire_bytes_consumed_total"] = stats["wire_bytes"]
+        scalars["wire_frames_obs_bf16_total"] = stats["wire_frames_obs_bf16"]
+        scalars["wire_frames_obs_f32_total"] = stats["wire_frames_obs_f32"]
+        # Broker-fabric scoreboard (broker_shard_* / fanin_* registry
+        # prefix families): per-shard pop/starve meters and the
+        # fence/dedup ledgers. Pure local counters (no RPC); present
+        # only when --broker_url is a shard list, so classic runs emit
+        # nothing new.
+        fabric_stats = getattr(self.broker, "fabric_stats", None)
+        if fabric_stats is not None:
+            for k, v in fabric_stats().items():
+                scalars[k] = float(v)
+        # Parallel host feed scoreboard (staging_pack_*, registry prefix
+        # family): per-worker busy/stall seconds, ring occupancy/wait,
+        # packer-proper rows/s. The pack_* keys exist only when
+        # --staging.pack_workers > 1, so default runs emit nothing new.
+        for k, v in stats.items():
+            if k.startswith("pack_"):
+                scalars[f"staging_{k}"] = float(v)
+        # Replay reservoir health (replay.enabled only): occupancy, hit
+        # ratio, replayed-frame age histogram buckets, bytes spilled —
+        # all pre-flattened scalars.
+        for k, v in stats.items():
+            if k.startswith("replay_"):
+                scalars[k] = v
+        scalars["weights_published"] = self.publisher.published
+        scalars["weights_coalesced"] = self.publisher.coalesced
+        if self.checkpointer is not None:
+            # Remote-mirror health (ADVICE r4): a growing lag means
+            # uploads can't keep the checkpoint cadence and durability
+            # is silently behind.
+            for k, v in self.checkpointer.mirror_stats().items():
+                if isinstance(v, (int, float)):
+                    scalars[f"ckpt_mirror_{k}"] = v
+            # Full-state save health (ckpt_* in obs/registry): empty
+            # dict (no keys emitted) until the first aux save, so
+            # default runs log nothing new.
+            for k, v in self.checkpointer.save_stats().items():
+                scalars[f"ckpt_{k}"] = float(v)
+            if self._ckpt_worker is not None:
+                scalars["ckpt_async_saves_total"] = float(self._ckpt_worker.saved)
+                scalars["ckpt_async_coalesced_total"] = float(
+                    self._ckpt_worker.coalesced
+                )
+        if self._resume_scalars:
+            # One-shot: the restore's provenance rides the first logged
+            # window, then clears.
+            scalars.update(self._resume_scalars)
+            self._resume_scalars = {}
+        if stats["episodes"] > 0:
+            scalars["mean_episode_return"] = stats["episode_return_sum"] / stats["episodes"]
+        if self.obs is not None:
+            # Per-stage pipeline latency histograms + the e2e
+            # actor→apply decomposition (obs/trace.py). Empty until
+            # traced frames flow (actors opted in).
+            scalars.update(self.obs.tracer.scalars())
+        if compute is not None:
+            # compute_* families (obs/compute.py): phase means over this
+            # window (every pass fully closed — the loops close the pass
+            # before logging), cumulative recompile counters, cumulative
+            # MFU; in overlap mode also the fenced pipeline_* lane sums.
+            scalars.update(compute.window_scalars(win_steps, dt))
+        self.metrics.log(self.version, scalars)
 
     def close(self) -> None:
         if self._ckpt_worker is not None:

@@ -387,6 +387,14 @@ class StagingBuffer:
         # the classic path). Single-consumer contract, like
         # last_batch_trace: only the learner loop pops batches.
         self.last_batch_lease = None
+        # Downstream prefetch-lane station (--learner.prefetch): the
+        # pipelined learner's PrefetchLane pops batches off _ready and
+        # holds them (locals or its handoff queue) until the loop trains
+        # them. drained() must see those popped-but-untrained frames or
+        # a SIGTERM drain could declare victory one batch early — the
+        # PR-7 loss class, one station further downstream. None = no
+        # lane (the serial loop, or a non-learner consumer).
+        self._prefetch_probe = None
         # SIGTERM drain: once set, the consumer stops popping the broker
         # but keeps packing already-pending frames into full batches —
         # the learner trains those out, then checkpoints the (< B)
@@ -1173,19 +1181,34 @@ class StagingBuffer:
                 "batch would fail; fix the builder/staging config disagreement"
             ) from fatal
 
-    def _get_ready(self, timeout: Optional[float]):
+    def _get_ready(self, timeout: Optional[float], cancel=None):
         """queue.get that stays responsive to a consumer death: waits in
         short slices and re-checks _fatal between them, so a learner
         already blocked when the consumer dies on a BatchLayoutError
         fails within ~0.2s instead of sitting out its full batch timeout
-        against a queue nothing will ever fill again."""
+        against a queue nothing will ever fill again. `cancel` (an
+        Event) aborts the wait within one slice — the prefetch lane's
+        teardown hook, so a stopping lane never sits out a full batch
+        timeout (and never overlaps a successor lane's pops)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             self._check_fatal()
-            if self._quiesce.is_set() and self.drained():
+            if cancel is not None and cancel.is_set():
+                raise queue.Empty
+            if self._quiesce.is_set() and self.drained(include_prefetch=False):
                 # SIGTERM drain: nothing left to pack and nothing queued —
                 # waiting out the full batch timeout would only burn the
                 # drain budget against a queue nothing will ever fill.
+                # UPSTREAM stations only: the caller here IS the consumer
+                # (the prefetch lane in pipelined mode), and its own
+                # mid-fetch _inflight flag covers this very wait — the
+                # full-station drained() would read False forever and the
+                # fast-exit would never fire, burning the whole
+                # batch_timeout of the k8s drain budget (review catch;
+                # regression-pinned in test_pipeline). Anything already
+                # past this pop (handoff queue) is trained out by the
+                # loop regardless — the "exhausted" sentinel lands
+                # FIFO-last.
                 raise queue.Empty
             if deadline is None:
                 step = 0.2
@@ -1198,21 +1221,23 @@ class StagingBuffer:
             except queue.Empty:
                 continue
 
-    def get_batch(self, timeout: Optional[float] = None) -> Optional[TrainBatch]:
+    def get_batch(
+        self, timeout: Optional[float] = None, cancel=None
+    ) -> Optional[TrainBatch]:
         """One packed batch (or None on timeout). On the ring path
         (pack_workers > 1 with fused_io) the batch's leaves are views
         into a leased ring slot — the caller must release
         `last_batch_lease` once done, exactly like get_batch_groups, or
         the ring stalls after transfer_depth batches."""
         try:
-            item = self._get_ready(timeout)
+            item = self._get_ready(timeout, cancel=cancel)
         except queue.Empty:
             self.last_batch_lease = None
             return None
         self.last_batch_lease = item[3]
         return item[0]
 
-    def get_batch_groups(self, timeout: Optional[float] = None):
+    def get_batch_groups(self, timeout: Optional[float] = None, cancel=None):
         """(TrainBatch, groups) — `groups` is the ready-to-ship fused-H2D
         buffer dict when the buffer was built with fused_io, else None
         (caller falls back to io.pack). The batch's leaves are views into
@@ -1232,7 +1257,7 @@ class StagingBuffer:
         None). Single-consumer by contract (only the learner loop pops
         batches), so the attribute reads are race-free."""
         try:
-            batch, groups, traces, lease = self._get_ready(timeout)
+            batch, groups, traces, lease = self._get_ready(timeout, cancel=cancel)
         except queue.Empty:
             self.last_batch_trace = None
             self.last_batch_lease = None
@@ -1319,12 +1344,28 @@ class StagingBuffer:
             broker_quiesce()
         self._quiesce.set()
 
-    def drained(self) -> bool:
+    def attach_prefetch_probe(self, probe: Callable[[], bool]) -> None:
+        """Register the pipelined learner's prefetch-lane station
+        (runtime/learner.py PrefetchLane.holding): a callable that is
+        True while the lane holds popped-but-untrained frames — in its
+        thread locals mid-fetch or in its handoff queue. drained()
+        checks it LAST (the lane sits downstream of the ready queue;
+        frames only move downstream, the upstream-first rule)."""
+        self._prefetch_probe = probe
+
+    def drained(self, include_prefetch: bool = True) -> bool:
         """True once a quiesced buffer can produce no further batch: the
         ready queue is empty and pending holds fewer frames than the
         next batch's fresh-row requirement. Learner-thread gauge reads
         of consumer-owned counters (len/occupancy) are single GIL-atomic
-        calls; a one-frame drift only delays the verdict by one poll."""
+        calls; a one-frame drift only delays the verdict by one poll.
+
+        `include_prefetch=False` is the prefetch lane's OWN exhaustion
+        check ("will anything more ever arrive upstream?") — the lane
+        must not count its already-delivered holdings against itself, or
+        a drain would livelock on the batch the loop is about to train.
+        Every external caller keeps the default: the full zero-loss
+        verdict includes the lane station."""
         if not self._quiesce.is_set():
             return False
         # Pool mode adds two upstream stations frames can occupy: the
@@ -1359,7 +1400,15 @@ class StagingBuffer:
                 need -= min(self._replay_target, self._reservoir.occupancy)
             if len(self._pending) >= need:  # graftlint: disable=THR001(read is under _mutate_lock; the consumer's mutation call sites (_ingest/_next_batch_items in _run) hold the same lock — lexically outside the mutating functions, so the rule cannot see it)
                 return False
-        return self._ready.empty()
+        if not self._ready.empty():
+            return False
+        # The most DOWNSTREAM station: a batch the prefetch lane popped
+        # off _ready but the loop has not trained yet (--learner.prefetch).
+        if include_prefetch:
+            probe = self._prefetch_probe
+            if probe is not None and probe():
+                return False
+        return True
 
     def stats(self) -> Dict[str, float]:
         with self._stats_lock:

@@ -190,10 +190,11 @@ def window_tasks(ts: str, cache_dir: str | None = None):
         (
             "e2e bench (fused pipeline)",
             [sys.executable, "bench.py"],
-            # BENCH_SINGLE: also measure the opt-in one-buffer H2D e2e —
-            # the window is the only place the 4-vs-1 transfer decision
-            # gets real-link data, and the window cache absorbs the
-            # second compile.
+            # BENCH_SINGLE: also measure the ALTERNATE transfer layout
+            # (the 4-buffer groups arm, now that single-buffer is the
+            # production default headline) — the window is the only
+            # place the layout decision gets real-link data, and the
+            # window cache absorbs the second compile.
             {"DOTACLIENT_TPU_BENCH_PLATFORM": "tpu", "DOTACLIENT_TPU_BENCH_SINGLE": "1", **cache},
             # BENCH_SINGLE adds a SECOND full compile and bench prints its
             # JSON only at the end — budget both compiles, or a slow

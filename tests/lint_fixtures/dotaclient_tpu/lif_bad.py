@@ -59,6 +59,25 @@ class EarlyReleaseFetcher:
         return batch_dev
 
 
+class WrongFenceFetcher:
+    """The prefetch-lane bug shape (ISSUE 15): a block_until_ready IS
+    present, but it fences the step METRICS — not the lease's own
+    device_put result — which orders nothing about the transfer the
+    lease guards."""
+
+    def __init__(self, staging):
+        self.staging = staging
+
+    def fetch(self, groups, shardings, metrics):
+        batch_dev = jax.device_put(groups, shardings)  # noqa: F821 (never imported)
+        lease = self.staging.last_batch_lease
+        if lease is not None:
+            jax.block_until_ready(metrics)  # noqa: F821
+            # LIF001: the fence is not THIS batch's put result
+            lease.release()
+        return batch_dev
+
+
 class LossyDrainBuffer:
     """The PR-7 bug shape: a station drained() cannot see, and a popper
     holding frames in locals with no in-flight flag."""

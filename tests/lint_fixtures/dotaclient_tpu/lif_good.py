@@ -40,6 +40,23 @@ class RetiringFetcher:
         return put_result
 
 
+class LaneRetiringFetcher:
+    """The prefetch-lane shape (ISSUE 15): the put result is bound IN
+    the function, and the pre-release fence blocks on exactly that name
+    — the same-put rule must accept it."""
+
+    def __init__(self, staging):
+        self.staging = staging
+
+    def fetch(self, groups, shardings):
+        batch_dev = jax.device_put(groups, shardings)
+        lease = self.staging.last_batch_lease
+        if lease is not None:
+            jax.block_until_ready(batch_dev)
+            lease.release()
+        return batch_dev
+
+
 class FinallyPacker:
     """The idiomatic cleanup shape: a finally-block release covers every
     raise inside the try by construction — must lint clean."""

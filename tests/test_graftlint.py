@@ -163,9 +163,10 @@ def test_specific_known_bad_lines():
     obs002_s = by_rule[("OBS002", "spawn_fixture.py")]
     flagged_s = {f.message.split(" ", 1)[0] for f in obs002_s}
     assert flagged_s == {"--not_a_learner_flag"}, obs002_s
-    # LIF001: all five shapes — leak, raise-edge leak, double release,
-    # second-acquire leak, release-before-retire — each on its labeled
-    # method
+    # LIF001: all six shapes — leak, raise-edge leak, double release,
+    # second-acquire leak, release-before-retire, wrong-object fence
+    # (the prefetch-lane rule: the block_until_ready must cover THIS
+    # batch's put result) — each on its labeled method
     lif001 = {f.context for f in by_rule[("LIF001", "lif_bad.py")]}
     assert lif001 == {
         "LeakyPacker.pack_leak",
@@ -173,6 +174,7 @@ def test_specific_known_bad_lines():
         "LeakyPacker.pack_double_release",
         "DoubleBufferPacker.pack_pair",
         "EarlyReleaseFetcher.fetch",
+        "WrongFenceFetcher.fetch",
     }, lif001
     # LIF002: the drain-invisible queue AND the flag-less popper
     lif002 = by_rule[("LIF002", "lif_bad.py")]

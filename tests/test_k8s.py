@@ -172,20 +172,30 @@ def test_multihost_learner_slice_consistency():
 
 
 def test_learner_manifests_keep_pipelined_loop():
-    """Production learner deploys opt into the scrape surface, NOT phase
-    fencing: obs.step_phases defaults to true under --obs.enabled, and a
-    manifest that forgets to disable it silently pays a per-step device
-    fence and forfeits the prefetch overlap the pipelined loop exists
-    for."""
+    """Production learner deploys pin the overlapped loop (ISSUE 15,
+    OVERLAP_AB.json): --learner.prefetch true explicitly (the loop shape
+    must survive a default change, and rollback is exactly this flag —
+    MIGRATION item 15), and --obs.step_phases true WITH it — phase
+    attribution is free under the pipelined loop (obs/compute.py overlap
+    mode fences the prefetch lane, never the loop) and exports the
+    pipeline_* overlap scoreboard. A manifest pairing step_phases true
+    with prefetch false would silently pay a per-step device fence —
+    the pairing is the contract."""
     for name in ("learner", "learner-multihost"):
         (_, doc), = [
             (f, d) for f, d in DOCS
             if d["metadata"]["name"] == name and d["kind"] != "Service"
         ]
         args = doc["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--learner.prefetch" in args, f"{name}: prefetch not pinned"
+        assert args[args.index("--learner.prefetch") + 1] == "true", (
+            f"{name}: production learner must run the overlapped loop"
+        )
         assert "--obs.step_phases" in args, f"{name}: step_phases not pinned"
-        assert args[args.index("--obs.step_phases") + 1] == "false", (
-            f"{name}: production learner must run the pipelined (unfenced) loop"
+        assert args[args.index("--obs.step_phases") + 1] == "true", (
+            f"{name}: step_phases is free (overlap mode) under the "
+            "pipelined loop and carries the pipeline_* scoreboard — "
+            "pin it on"
         )
 
 

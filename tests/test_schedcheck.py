@@ -100,6 +100,90 @@ def test_drained_loss_schedules_found_then_fixed(mutant):
     assert fixed.exhausted and fixed.violations == []
 
 
+# -------------------------------- ISSUE 15: the prefetch-lane lifecycle
+
+
+def test_prefetch_head_exhausts_clean():
+    """The overlapped-loop protocol (PrefetchLane + _fetch_next:
+    take → put-dispatch → retire → release → handoff → train, with the
+    drain stations one hop further downstream) explores its entire
+    bounded interleaving set clean — including schedules where the
+    drain quiesces mid-lifecycle."""
+    from dotaclient_tpu.analysis.schedcheck import PrefetchModel
+
+    explore(PrefetchModel(depth=2, batches=3)).require_exhausted_clean()
+
+
+@pytest.mark.parametrize(
+    "mutant, needle",
+    [
+        ("release_before_retire", "early-release corruption"),
+        ("train_consumes_inflight", "had not retired"),
+        ("drain_ignores_prefetch", "prefetch station"),
+    ],
+)
+def test_prefetch_mutants_found_then_fixed(mutant, needle):
+    """Each mutant re-introduces a bug class the pipelined loop must
+    exclude: the PR-11 early lease release (now one thread further from
+    the loop), training a batch whose H2D never retired (the handoff
+    ordering rule), and a drain that cannot see the lane's holdings
+    (the PR-7 loss class at the new station). Exploration finds each;
+    HEAD is exhausted clean (the test above)."""
+    from dotaclient_tpu.analysis.schedcheck import PrefetchModel
+
+    broken = explore(PrefetchModel(depth=2, batches=3, mutant=mutant))
+    assert any(needle in v for v in broken.violations), (mutant, broken.violations)
+
+
+def test_prefetch_model_matches_real_lane():
+    """Cross-validate the model's lane semantics against the REAL
+    PrefetchLane: the holding() flag covers the whole pop-to-handoff
+    window (no gap a drain could slip through), FIFO order is
+    preserved, the fetch budget caps deliveries, and idle results
+    consume no budget."""
+    import queue as _q
+    import threading
+    import time
+
+    from dotaclient_tpu.runtime.learner import PrefetchLane
+
+    source = _q.Queue()
+    for i in range(3):
+        source.put(i)
+
+    observed_holding_during_fetch = []
+
+    lane_box = []
+
+    def fetch():
+        try:
+            item = source.get(timeout=0.3)
+        except _q.Empty:
+            return None, 0, 0.3, 0.0, None
+        # mid-fetch, after the pop: holding() must already be True
+        observed_holding_during_fetch.append(lane_box[0].holding())
+        return item, 1, 0.0, 0.0, None
+
+    lane = PrefetchLane(fetch, depth=1, limit=2)
+    lane_box.append(lane)
+    lane.start()
+    got = []
+    deadline = time.monotonic() + 5
+    while len(got) < 2 and time.monotonic() < deadline:
+        try:
+            item = lane.get(timeout=0.2)
+        except _q.Empty:
+            continue
+        if item.kind == "batch":
+            got.append(item.batch)
+    lane.stop()
+    assert got == [0, 1]  # FIFO, budget-capped at limit=2
+    assert lane.fetched == 2
+    assert source.qsize() == 1  # the third batch was never eaten
+    assert all(observed_holding_during_fetch)
+    assert not lane.holding()
+
+
 # --------------------------------------------- the other two protocols
 
 
