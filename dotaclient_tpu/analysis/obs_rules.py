@@ -96,6 +96,7 @@ _BINARY_CONFIGS = {
     "dotaclient_tpu.eval.evaluator": "EvalConfig",
     "dotaclient_tpu.serve.server": "InferenceConfig",
     "dotaclient_tpu.serve.handoff": "HandoffConfig",
+    "dotaclient_tpu.control.server": "ControlConfig",
     "dotaclient_tpu.transport.tcp_server": "argparse:transport/tcp_server.py",
     "dotaclient_tpu.transport.fabric": "argparse:transport/fabric.py",
 }

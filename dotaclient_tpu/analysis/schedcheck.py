@@ -805,9 +805,25 @@ class HandoffModel(_Model):
       step, the server re-writes the same boundary, the duplicate shift
       evicts the previous entry — and a second kill before the re-issued
       ack lands abandons an episode keep-two was supposed to save.
-      CarryStore.put replaces on equal episode_step because of this."""
+      CarryStore.put replaces on equal episode_step because of this.
+    - ``reshard_primary_only`` (requires ``shards`` > 1): after a
+      topology change the failover read consults ONLY the key's NEW
+      rendezvous primary. Entries written before the reshard still live
+      on the OLD primary (rendezvous moves a key only TO the added
+      shard — survivors never trade keys), so a post-reshard resume of
+      a pre-reshard boundary finds nothing and abandons. The fixed
+      protocol walks the key's full shard preference order until an
+      exact match — ShardedCarryStore.get mirrors this rule.
 
-    threads = ("client", "server", "chaos")
+    Sharding (``shards`` > 1): the store is N independent keep-two
+    shards plus a bounded ``reshard`` thread that ADDS a shard
+    mid-episode. Placement models the adversarial rendezvous case — the
+    added shard becomes the key's new primary (rendezvous guarantees
+    only that a moved key moves TO the new shard), so writes land on
+    the newest shard while older boundaries stay where they were.
+    Shard REMOVAL is deliberately out of scope: a removed store pod's
+    entries are gone (a drain problem, not a read-protocol problem) —
+    k8s store scale-down is operator-gated (MIGRATION)."""
 
     def __init__(
         self,
@@ -815,6 +831,7 @@ class HandoffModel(_Model):
         chunk: int = 2,
         kills: int = 2,
         mutant: Optional[str] = None,
+        shards: int = 1,
     ):
         assert mutant in (
             None,
@@ -822,12 +839,25 @@ class HandoffModel(_Model):
             "resume_from_stale",
             "single_entry",
             "dup_shift",
+            "reshard_primary_only",
+        )
+        assert shards >= 1
+        assert mutant != "reshard_primary_only" or shards > 1, (
+            "reshard_primary_only only differs from the fixed protocol "
+            "once a reshard can happen (shards > 1)"
         )
         self.steps = steps
         self.chunk = chunk
         self.kills = kills
         self.mutant = mutant
+        self.shards = shards
         self.keep = 1 if mutant == "single_entry" else 2
+        # The reshard thread exists only when a topology change can:
+        # shards=1 keeps the thread set (and the explored state space)
+        # exactly the single-store model's.
+        self.threads = ("client", "server", "chaos") + (
+            ("reshard",) if shards > 1 else ()
+        )
 
     def init(self) -> dict:
         return {
@@ -840,7 +870,10 @@ class HandoffModel(_Model):
             "carry": None,  # server-resident carry position
             "s_pc": "idle",
             "pending_write": None,  # mutant handoff_after_ack: write after ack
-            "store": (),  # retained entry positions, newest first
+            # per-shard retained entry positions, newest first; topo =
+            # shards currently in the ring (grows on reshard)
+            "stores": ((),),
+            "topo": 1,
             "kills": 0,
             "violations": [],
         }
@@ -858,20 +891,36 @@ class HandoffModel(_Model):
             if st["s_pc"] == "idle":
                 return st["issued"] is not None and not st["ack"] and not st["failed"]
             return True  # write / ack / late_write stages pending
+        if tid == "reshard":
+            # bounded topology growth while the episode is still running
+            return st["topo"] < self.shards and st["c_steps"] < self.steps
         # chaos: bounded kills while the episode is still running
         return st["kills"] < self.kills and st["c_steps"] < self.steps
 
     # -- transitions ---------------------------------------------------
 
+    @staticmethod
+    def _shard_order(st: dict):
+        """The key's shard preference order under the CURRENT topology:
+        newest shard first (the adversarial-rendezvous primary), older
+        shards after — the ordered walk ShardedCarryStore.get runs."""
+        return range(st["topo"] - 1, -1, -1)
+
     def _store_push(self, st: dict, value: int) -> None:
-        # Same-boundary puts REPLACE the head entry (a resumed client
+        # Writes land on the key's CURRENT primary (placement is
+        # computed at put time, the ShardedCarryStore rule). Per shard,
+        # same-boundary puts REPLACE the head entry (a resumed client
         # re-issuing its chunk-fill step re-writes the same boundary;
         # shifting would evict the previous entry keep-two exists for —
         # the dup_shift mutant is that bug, found by exploring this
         # model; CarryStore.put mirrors this rule).
-        if st["store"] and st["store"][0] == value and self.mutant != "dup_shift":
+        p = st["topo"] - 1
+        shard = st["stores"][p]
+        if shard and shard[0] == value and self.mutant != "dup_shift":
             return
-        st["store"] = (value,) + st["store"][: self.keep - 1]
+        stores = list(st["stores"])
+        stores[p] = (value,) + shard[: self.keep - 1]
+        st["stores"] = tuple(stores)
 
     def step(self, st: dict, tid: str) -> None:
         if tid == "client":
@@ -897,22 +946,37 @@ class HandoffModel(_Model):
                 if st["c_boundary"] == 0:
                     restored = 0  # episode-start zeros; no store needed
                 elif self.mutant == "resume_from_stale":
-                    if not st["store"]:
+                    nonempty = [
+                        st["stores"][i] for i in self._shard_order(st) if st["stores"][i]
+                    ]
+                    if not nonempty:
                         st["violations"].append(
                             "episode abandoned: resume found an empty store "
                             "for an observed boundary"
                         )
                         restored = st["c_boundary"]
                     else:
-                        restored = st["store"][0]  # newest, match ignored
+                        restored = nonempty[0][0]  # newest, match ignored
                 else:
-                    matches = [e for e in st["store"] if e == st["c_boundary"]]
+                    # The fixed read walks the key's FULL shard
+                    # preference order (exact match per shard); the
+                    # reshard_primary_only mutant stops at the new
+                    # primary — pre-reshard boundaries become unreadable.
+                    order = list(self._shard_order(st))
+                    if self.mutant == "reshard_primary_only":
+                        order = order[:1]
+                    matches = [
+                        e
+                        for i in order
+                        for e in st["stores"][i]
+                        if e == st["c_boundary"]
+                    ]
                     if matches:
                         restored = matches[0]
                     else:
                         st["violations"].append(
                             f"episode abandoned: no store entry matches observed "
-                            f"boundary {st['c_boundary']} (store {st['store']}) — "
+                            f"boundary {st['c_boundary']} (stores {st['stores']}) — "
                             f"a durable boundary went missing"
                         )
                         restored = st["c_boundary"]  # keep exploring past it
@@ -955,6 +1019,15 @@ class HandoffModel(_Model):
                 st["pending_write"] = None
                 st["s_pc"] = "idle"
             return
+        if tid == "reshard":
+            # controller adds a store shard mid-episode; by adversarial
+            # placement it becomes the key's new rendezvous primary.
+            # Entries already durable on the old primary stay where they
+            # are (rendezvous never moves keys between survivors) — a
+            # correct read must keep walking to them.
+            st["topo"] += 1
+            st["stores"] = st["stores"] + ((),)
+            return
         # chaos: kill + immediate restart (the in-process controller
         # shape): resident carry gone, un-landed pipeline work gone, an
         # unacked in-flight step surfaces as a connection failure; a
@@ -973,9 +1046,10 @@ class HandoffModel(_Model):
         out = []
         if st["c_steps"] != self.steps:
             out.append(f"episode finished {st['c_steps']} of {self.steps} steps")
-        for e in st["store"]:
-            if e % self.chunk != 0:
-                out.append(f"store entry {e} is not a chunk boundary")
+        for shard in st["stores"]:
+            for e in shard:
+                if e % self.chunk != 0:
+                    out.append(f"store entry {e} is not a chunk boundary")
         return out
 
 

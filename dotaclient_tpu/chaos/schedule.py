@@ -36,6 +36,18 @@ Timed one-shots (wall-clock offsets from the schedule epoch `t0`):
                     targets, so existing specs parse byte-identically
                     and no rate draw ever moves (the golden
                     decision-sequence pin covers it).
+    scale@T:N@TIER  set TIER's replica count to N at T. TIER is
+                    `broker` (fabric shards), `server` (serve
+                    replicas), or `actor` (the actor fleet). Executed
+                    by the control tier (dotaclient_tpu/control/) or a
+                    soak harness against a driver that owns the tier's
+                    replica routers — a client-side wrapper cannot add
+                    or remove processes, the kill@ argument again. The
+                    replica count rides the duration slot and the tier
+                    selector rides the ARG side, so existing specs
+                    parse byte-identically and scale clauses consume
+                    ZERO per-op rate draws (the golden
+                    decision-sequence pin covers it).
     kill@T:D        kill the broker at T, restart it at T+D — executed
                     by a ScheduleRunner against a controller that owns
                     the broker process (chaos/controller.py), because a
@@ -77,10 +89,10 @@ _RATE_FAULTS = ("corrupt", "truncate", "dup", "reset", "shed")
 
 @dataclass
 class TimedEvent:
-    kind: str  # "stall" | "kill" | "rolling"
+    kind: str  # "stall" | "kill" | "rolling" | "scale"
     at_s: float  # offset from the schedule epoch
-    duration_s: float  # down window (per replica, for rolling)
-    target: str = "broker"  # "broker" | "learner" | "server"
+    duration_s: float  # down window (per replica, for rolling); replica count for scale
+    target: str = "broker"  # "broker" | "learner" | "server" | "actor" (scale only)
     signal: str = "kill"  # "kill" (SIGKILL) | "term" (SIGTERM drain); learner only
 
 
@@ -114,8 +126,28 @@ class FaultSchedule:
             name, _, arg = clause.partition(":")
             if "@" in name:
                 kind, _, at = name.partition("@")
-                if kind not in ("stall", "kill", "rolling"):
+                if kind not in ("stall", "kill", "rolling", "scale"):
                     raise ValueError(f"unknown timed fault {kind!r} in {clause!r}")
+                if kind == "scale":
+                    # scale@T:N@TIER — a topology set-point, not a
+                    # fault: the tier selector is MANDATORY (there is
+                    # no default tier to scale) and N must be a whole
+                    # replica count >= 1 (scale-to-zero is a kill, and
+                    # kills already exist).
+                    n_s, _, tier = arg.partition("@")
+                    if not tier or ":" in tier:
+                        raise ValueError(
+                            f"scale needs scale@T:N@broker|server|actor, got {clause!r}"
+                        )
+                    if tier not in ("broker", "server", "actor"):
+                        raise ValueError(f"unknown scale tier {tier!r} in {clause!r}")
+                    n = float(n_s)
+                    if n != int(n) or int(n) < 1:
+                        raise ValueError(
+                            f"scale replica count must be an integer >= 1 in {clause!r}"
+                        )
+                    sched.events.append(TimedEvent("scale", float(at), n, target=tier))
+                    continue
                 # kill@T:D@TGT[:SIG] / rolling@T:P@server — the target
                 # selector rides the ARG side of the clause, so existing
                 # bare specs parse byte-identically (target defaults to
@@ -201,6 +233,13 @@ class FaultSchedule:
         kills AND rolling restarts (a rolling event is a kill sequence
         fanned across replicas)."""
         return [e for e in self.events if e.kind in ("kill", "rolling")]
+
+    def scales(self) -> List[TimedEvent]:
+        """Scale set-points (scale@T:N@tier) in schedule order — the
+        control tier's deterministic topology script. `duration_s`
+        carries the target replica count; kept OUT of kills() so every
+        existing ScheduleRunner routes exactly what it did before."""
+        return [e for e in self.events if e.kind == "scale"]
 
     def stall_remaining(self, elapsed_s: float) -> float:
         """Seconds an op starting at `elapsed_s` (since epoch) must block

@@ -254,6 +254,11 @@ class ServeConfig:
     # no store connection, no extra bytes, replica death abandons
     # in-flight episodes exactly like PR-10. Requires fleet-unique
     # client keys (the actor_id scheme already guarantees this).
+    # A COMMA list ("s0:13390,s1:13390") shards the store by rendezvous
+    # hash of client_key (ShardedCarryStore): puts go to the key's
+    # primary, failover reads walk the key's full preference order so
+    # boundaries written before a shard ADD stay restorable. One
+    # endpoint (no comma) is byte-for-byte the PR-13 single-store path.
     handoff_endpoint: str = ""
     # Per-RPC budget against the carry store. A store outage never
     # stops serving: the write is skipped (counted in
@@ -735,7 +740,71 @@ class HandoffConfig:
     # chunk-fill ACK was lost in a kill (store written, reply dead) can
     # still resume from the boundary it actually observed.
     keep: int = 2
+    # The full store shard ring this pod belongs to, as the SAME comma
+    # list the serve replicas get in --serve.handoff_endpoint ("" = a
+    # single unsharded store). The store itself never routes — placement
+    # is client-side rendezvous — but declaring the ring here makes the
+    # pod's ready line name its topology, so a mis-rolled ring (pods
+    # and replicas disagreeing about the shard list) is visible at boot
+    # instead of surfacing as resume misses.
+    stores: str = ""
     # /metrics + /healthz scrape surface (serve_handoff_store_* gauges).
+    obs: ObsConfig = field(default_factory=ObsConfig)
+
+
+@dataclass
+class ControlLoopConfig:
+    """The --control.* surface of the control-plane binary
+    (dotaclient_tpu/control/server.py). All topology lists are comma
+    `host:port` endpoint lists naming each tier's METRICS surfaces —
+    the controller scrapes /metrics + /healthz there, decides against
+    the policy, and actuates through the configured driver."""
+
+    # Port of the controller's own HTTP surface: GET /topology (the
+    # discovery endpoint actors and serve clients poll at (re)connect),
+    # plus the standard /metrics + /healthz (control_* gauges). The k8s
+    # Service pins 13400; 0 = pick a free port (test use).
+    port: int = 13400
+    # Scrape-decide-actuate cadence, seconds. Size against the policy
+    # cooldowns (a poll period much longer than a cooldown makes the
+    # cooldown a no-op; much shorter just re-reads unchanged gauges).
+    poll_s: float = 2.0
+    # Declarative scaling policy: ";"-separated clauses, each
+    # "tier:meter,high=H,low=L,min=M,max=X,cooldown=C,step=S" — scale
+    # `tier` up by `step` when `meter` > H (down when < L), clamped to
+    # [M, X], at most one move per C seconds (control/policy.py). The
+    # high/low gap is the hysteresis band (the --shed_high/--shed_low
+    # watermark discipline applied to topology); "" = observe-only.
+    policy: str = ""
+    # Actuation driver: "static" observes and ledgers decisions without
+    # actuating (the safe default — rollback is a driver flip, not a
+    # rollout); "k8s" speaks `kubectl scale statefulset` against the
+    # committed manifests. The in-process driver (soaks/tests) is
+    # injected programmatically, never flag-selected.
+    driver: str = "static"
+    # Per-tier metrics endpoints the scraper polls (comma host:port
+    # lists; "" = tier unmanaged). These are OBS ports, not data ports.
+    brokers: str = ""
+    servers: str = ""
+    actors: str = ""
+    stores: str = ""
+    learner: str = ""
+    # k8s driver scope: the namespace the StatefulSets live in, and the
+    # kubectl binary to exec (tests point this at a recorder script).
+    namespace: str = "dotaclient"
+    kubectl: str = "kubectl"
+
+
+@dataclass
+class ControlConfig:
+    """Control-plane binary (python -m dotaclient_tpu.control.server):
+    the closed-loop autoscaler/router. Scrapes the fleet's existing
+    Prometheus-text /metrics + /healthz surfaces, computes target
+    replica counts per tier from the declarative policy, actuates via
+    the pluggable driver, and serves /topology for discovery. Stdlib
+    only — never imports jax or the wire stack."""
+
+    control: ControlLoopConfig = field(default_factory=ControlLoopConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
 
 

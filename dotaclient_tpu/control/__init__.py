@@ -1,0 +1,62 @@
+"""Control plane: the closed-loop autoscaler + discovery service.
+
+The fleet already exposes everything a controller needs — every binary
+serves Prometheus-text /metrics + structured /healthz (obs/http.py),
+the broker fabric ledgers per-shard depth/starvation, the serve tier
+exports its S_INFO load dict as serve_load_* gauges — but acting on
+those meters was a human: watch a dashboard, edit `replicas:`, re-roll
+endpoint lists. This package closes the loop:
+
+- control/scrape.py   stdlib scraper over the EXISTING /metrics +
+                      /healthz surfaces, with per-tier aggregation
+                      (`<scalar>.mean/.max/.sum` + up counts);
+- control/policy.py   declarative threshold policy (--control.policy):
+                      hysteresis bands + per-tier cooldowns — the
+                      --shed_high/--shed_low watermark discipline
+                      applied to topology;
+- control/drivers.py  pluggable actuation: StaticDriver (observe-only,
+                      the rollback position), K8sDriver (kubectl scale
+                      against the committed StatefulSet contracts),
+                      and duck-typed in-process routers so the whole
+                      loop soaks without a cluster;
+- control/server.py   the standing binary: scrape → decide → actuate
+                      on a poll loop, every decision ledgered with the
+                      meter values that justified it, plus GET
+                      /topology — the discovery endpoint actors and
+                      serve clients poll at (re)connect
+                      (`--serve.endpoint control:<host:port>`).
+
+Inertness: nothing imports this package unless a --control.* flag or a
+`control:` endpoint scheme is used; the discovery client in
+serve/client.py speaks plain HTTP and never imports it either.
+"""
+
+from dotaclient_tpu.control.drivers import (
+    InProcessDriver,
+    K8sDriver,
+    StaticDriver,
+    TierSpec,
+)
+from dotaclient_tpu.control.policy import PolicyClause, PolicyEngine, parse_policy
+from dotaclient_tpu.control.scrape import (
+    aggregate_tier,
+    parse_prometheus_text,
+    scrape_endpoint,
+    scrape_health,
+)
+from dotaclient_tpu.control.server import ControlPlane
+
+__all__ = [
+    "ControlPlane",
+    "InProcessDriver",
+    "K8sDriver",
+    "PolicyClause",
+    "PolicyEngine",
+    "StaticDriver",
+    "TierSpec",
+    "aggregate_tier",
+    "parse_policy",
+    "parse_prometheus_text",
+    "scrape_endpoint",
+    "scrape_health",
+]

@@ -95,6 +95,7 @@ class MetricsHTTPServer:
         sources: Optional[List[Callable[[], Dict[str, float]]]] = None,
         health_provider: Optional[Callable[[], Dict]] = None,
         profile_handler: Optional[Callable[[float], str]] = None,
+        json_routes: Optional[Dict[str, Callable[[], Dict]]] = None,
     ):
         self._sources: List[Callable[[], Dict[str, float]]] = list(sources or [])
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -102,6 +103,10 @@ class MetricsHTTPServer:
         self._requested_port = port
         self.health_provider = health_provider
         self.profile_handler = profile_handler
+        # Extra GET routes ("/topology" on the control plane): path →
+        # zero-arg callable returning a JSON-able dict, served 200; a
+        # throwing provider is a 500, never a crashed serving thread.
+        self.json_routes: Dict[str, Callable[[], Dict]] = dict(json_routes or {})
 
     def add_source(self, source: Callable[[], Dict[str, float]]) -> None:
         self._sources.append(source)
@@ -163,6 +168,14 @@ class MetricsHTTPServer:
                 elif route == "/healthz":
                     body = server.health()
                     self._reply_json(200 if body.get("ok", True) else 503, body)
+                elif route in server.json_routes:
+                    try:
+                        body = dict(server.json_routes[route]())
+                    except Exception as e:
+                        _log.exception("json route %s failed", route)
+                        self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    self._reply_json(200, body)
                 else:
                     self.send_error(404)
 

@@ -137,6 +137,13 @@ SCALARS: Dict[str, str] = {
     "serve_version": "model version of the currently-serving param tree",
     "serve_clients_connected": "live client connections",
     "serve_carries_resident": "LSTM carries held server-side across all connections",
+    # --- serve placement load (serve/server.py load(), the S_INFO
+    #     "load" dict as scrape gauges — what the control plane's
+    #     policy loop and load-aware routing read) ----------------------
+    "serve_load_clients": "live client connections (the S_INFO load report's clients field)",
+    "serve_load_occupancy": "mean real-rows / capacity over the tick-occupancy histogram",
+    "serve_load_pending": "step requests queued for the next inference tick",
+    "serve_load_capacity": "batched-tick capacity (--serve.max_batch)",
     # --- session continuity, SERVER side (serve/server.py +
     #     serve/handoff.py; zero with --serve.handoff_endpoint unset) --
     "serve_handoff_store_writes_total": (
@@ -186,6 +193,14 @@ SCALARS: Dict[str, str] = {
         "across the in-rotation candidates)"
     ),
     "serve_route_picks_total": "connects whose endpoint order came from a load probe pass",
+    "serve_topology_refreshes_total": (
+        "endpoint lists adopted from the control plane's GET /topology "
+        "(--serve.endpoint control:<host:port>; 0 with literal lists)"
+    ),
+    "serve_topology_errors_total": (
+        "failed /topology fetches — the client keeps its current list "
+        "(rollback semantics: discovery can only improve on the static list)"
+    ),
     "serve_fallback_engaged": "1 while the local-policy fallback is stepping episodes",
     "serve_fallback_engagements_total": (
         "distinct fallback engagements — counted per outage, not per "
@@ -308,6 +323,16 @@ PREFIXES: Dict[str, str] = {
     # chaos_resets, chaos_sheds, chaos_stall_s, chaos_latency_s —
     # emitted only when --chaos.enabled (never in production)
     "chaos_": "fault-injection layer meters (dotaclient_tpu/chaos/)",
+    # control-plane loop health (dotaclient_tpu/control/server.py
+    # ControlPlane.stats, served on the controller's own surface):
+    # control_polls_total, control_scrapes_total,
+    # control_scrape_errors_total, control_scale_ups_total,
+    # control_scale_downs_total, control_holds_total,
+    # control_actuation_failures_total, control_topology_epoch,
+    # control_managed_tiers, control_decisions_ledgered,
+    # control_policy_clauses, control_replicas_<tier>. A family because
+    # the per-tier tail is data-dependent (the managed-tier set).
+    "control_": "control-plane autoscaler loop health (dotaclient_tpu/control/)",
 }
 
 

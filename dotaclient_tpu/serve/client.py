@@ -84,6 +84,22 @@ class SessionResumeRefused(RemoteInferenceError):
     the episode abandons immediately, the PR-10 path."""
 
 
+def split_control_scheme(spec: str) -> Optional[str]:
+    """`control:<host:port>` → the controller's "host:port", else None.
+    Validation is loud (the parse_endpoints discipline): the scheme
+    with a malformed address is a boot error, not a silent literal."""
+    spec = str(spec).strip()
+    if not spec.startswith("control:"):
+        return None
+    addr = spec[len("control:"):]
+    host, sep, port = addr.partition(":")
+    if not sep or not port.isdigit() or not 0 < int(port) < 65536:
+        raise ValueError(
+            f"control endpoint must be control:host:port, got {spec!r}"
+        )
+    return f"{host or '127.0.0.1'}:{int(port)}"
+
+
 def parse_endpoints(spec: str):
     """`host:port` or a comma-separated list of them → [(host, port)].
 
@@ -92,7 +108,17 @@ def parse_endpoints(spec: str):
     silently-shorter failover rotation. Empty segments (``a:1,,b:2`` or
     a trailing comma) are malformed for the same reason — they are
     almost always a typo'd replica. An empty host defaults to 127.0.0.1
-    (the single-endpoint behavior since PR 9)."""
+    (the single-endpoint behavior since PR 9).
+
+    `control:<host:port>` selects DISCOVERY instead of a literal list:
+    the client fetches its endpoints from the control plane's GET
+    /topology at (re)connect (RemotePolicyClient handles the fetch over
+    plain HTTP — the control package is never imported). Here the
+    scheme validates and yields an EMPTY list — discovery fills it.
+    Rollback is the spec itself: a literal list never consults the
+    controller."""
+    if split_control_scheme(spec) is not None:
+        return []
     parts = str(spec).split(",")
     out = []
     for part in (p.strip() for p in parts):
@@ -134,6 +160,15 @@ class RemotePolicyClient:
         retry: Optional[RetryPolicy] = None,
         route: str = "order",
     ):
+        # Discovery mode (--serve.endpoint control:<host:port>): the
+        # endpoint list starts empty and is fetched/refreshed from the
+        # controller's GET /topology at every (re)connect. Literal
+        # lists (None here) never touch the controller — byte-identical
+        # PR-10 behavior, and the rollback path.
+        self._control = split_control_scheme(endpoint)
+        self.topology_refreshes = 0
+        self.topology_errors = 0
+        self.topology_epoch = -1
         self.endpoints = parse_endpoints(endpoint)
         if route not in ("order", "load"):
             raise ValueError(f"serve route must be order|load, got {route!r}")
@@ -208,6 +243,8 @@ class RemotePolicyClient:
     @property
     def addr(self):
         """(host, port) the client currently prefers (sticky)."""
+        if not self.endpoints:
+            return ("", 0)  # discovery mode before the first /topology
         return self.endpoints[self._ep]
 
     def has_healthy_endpoint(self) -> bool:
@@ -226,6 +263,57 @@ class RemotePolicyClient:
         self._down_until[idx] = now + self.cooldown_s
         if self.all_down_since is None and not any(t <= now for t in self._down_until):
             self.all_down_since = now
+
+    # -------------------------------------------------------- discovery
+
+    def _fetch_topology(self) -> list:
+        """Blocking GET http://<controller>/topology → the "server"
+        tier's [(host, port)]. Plain stdlib HTTP on purpose: the actor
+        must never import dotaclient_tpu.control (inertness — discovery
+        is a wire contract, not a code dependency)."""
+        import json as _json
+        from urllib.request import urlopen
+
+        with urlopen(
+            f"http://{self._control}/topology", timeout=self.connect_timeout_s
+        ) as resp:
+            body = _json.loads(resp.read().decode("utf-8", "replace"))
+        self.topology_epoch = int(body.get("epoch", -1))
+        eps = []
+        for entry in body.get("tiers", {}).get("server", []):
+            host, sep, port = str(entry).partition(":")
+            if not sep or not port.isdigit():
+                raise ValueError(f"malformed /topology endpoint {entry!r}")
+            eps.append((host or "127.0.0.1", int(port)))
+        return eps
+
+    async def _refresh_topology(self) -> None:
+        """Adopt the controller's current server list, preserving the
+        sticky endpoint and cooldown clocks by endpoint IDENTITY (a
+        rescale must not reset a surviving replica's health state, and
+        affinity must not jump replicas just because the list reordered).
+        Fetch failure keeps the current list and counts the error."""
+        loop = asyncio.get_running_loop()
+        try:
+            eps = await asyncio.wait_for(
+                loop.run_in_executor(None, self._fetch_topology),
+                self.connect_timeout_s + 1.0,
+            )
+        except (Exception, asyncio.TimeoutError):
+            self.topology_errors += 1
+            return
+        if not eps or eps == self.endpoints:
+            return
+        sticky = self.endpoints[self._ep] if self.endpoints else None
+        down = dict(zip(self.endpoints, self._down_until))
+        self.endpoints = eps
+        self._down_until = [down.get(e, 0.0) for e in eps]
+        self._ep = eps.index(sticky) if sticky in eps else 0
+        self.topology_refreshes += 1
+        _log.info(
+            "serve client: adopted topology epoch %d (%d endpoints)",
+            self.topology_epoch, len(eps),
+        )
 
     # ------------------------------------------------------- connection
 
@@ -246,6 +334,17 @@ class RemotePolicyClient:
                 return  # a sibling env connected while we waited
             if self._closed:
                 raise RemoteInferenceError("client is closed")
+            if self._control is not None:
+                # Discovery refresh at (re)connect, under the connect
+                # lock (one fetch per failover pass, not per env). A
+                # failed fetch KEEPS the current list — the controller
+                # being down must never shrink a working rotation.
+                await self._refresh_topology()
+                if not self.endpoints:
+                    raise RemoteInferenceError(
+                        f"no serve endpoints: control plane {self._control} "
+                        f"unreachable or serving an empty server tier"
+                    )
             # One failover pass: candidates in sticky-first rotation
             # order, restricted to endpoints whose cooldown expired. No
             # inner retry loop — the episode retry loop above this client
@@ -1120,6 +1219,10 @@ class RemoteFleet:
             "serve_route_load_mode": 1.0 if c._route == "load" else 0.0,
             "serve_route_probes_total": float(c.route_probes),
             "serve_route_picks_total": float(c.route_picks),
+            # Discovery (serve_topology_* — zero with literal endpoint
+            # lists; the control: scheme counts adoptions + fetch fails).
+            "serve_topology_refreshes_total": float(c.topology_refreshes),
+            "serve_topology_errors_total": float(c.topology_errors),
         }
         # Per-endpoint health gauges (serve_endpoint_* registry family):
         # PR 10 tracked health internally but operators could not see

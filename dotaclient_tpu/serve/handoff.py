@@ -426,6 +426,108 @@ class CarryStoreClient:
         self._drop()
 
 
+def parse_store_endpoints(spec: str) -> list:
+    """Parse a comma-separated store endpoint list (`host:port,...`).
+    Loud on malformation (the parse_endpoints discipline): a typo'd
+    store list must fail at boot, not at first failover."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        host, sep, port = part.rpartition(":")
+        if not part or not sep or not port.isdigit():
+            raise ValueError(
+                f"malformed store endpoint {part!r} in {spec!r} (want host:port[,host:port...])"
+            )
+        out.append((host or "127.0.0.1", int(port), part))
+    return out
+
+
+def rendezvous_store_order(key: int, endpoints) -> list:
+    """The key's shard preference order: endpoint indices by descending
+    rendezvous weight. The EXACT formula of transport/fabric.py's
+    rendezvous_order, so store placement inherits the same proven
+    property: removing an endpoint never re-routes keys between
+    survivors, and a key moves only TO an added shard."""
+    import zlib
+
+    return sorted(
+        range(len(endpoints)),
+        key=lambda i: zlib.crc32(f"{key}|{endpoints[i]}".encode()),
+        reverse=True,
+    )
+
+
+class ShardedCarryStore:
+    """The CarryStoreClient API over N store shards, placed by
+    rendezvous hash of client_key (`--serve.handoff_endpoint` grows a
+    comma list; one endpoint = the plain single-store path, untouched).
+
+    - **put** goes to the key's rendezvous PRIMARY only. Write-ahead
+      and keep-two are per-shard properties and hold unchanged there;
+      a failed primary put raises StoreUnavailableError and the server
+      degrades exactly as with one store.
+    - **get** walks the key's FULL preference order until an exact
+      match. After a shard ADD, a pre-reshard boundary still lives on
+      its old primary — which stays in the walk, so the resume finds
+      it. Reading only the new primary is the schedcheck HandoffModel
+      `reshard_primary_only` mutant: exploration shows it abandoning
+      episodes the walk saves.
+    - A shard RPC error during the walk skips to the next shard; if no
+      exact match surfaced AND any shard errored, the whole get raises
+      (the erroring shard may hold the match — a silent MISS here would
+      turn a store outage into a wrong abandon verdict).
+    """
+
+    def __init__(self, endpoints, timeout_s: float = 2.0, clients=None):
+        if isinstance(endpoints, str):
+            endpoints = [p[2] for p in parse_store_endpoints(endpoints)]
+        self.endpoints = [str(e).strip() for e in endpoints]
+        if not self.endpoints:
+            raise ValueError("sharded carry store needs at least one endpoint")
+        if clients is not None:
+            if len(clients) != len(self.endpoints):
+                raise ValueError("clients/endpoints length mismatch")
+            self.clients = list(clients)
+        else:
+            self.clients = []
+            for ep in self.endpoints:
+                host, _, port = ep.rpartition(":")
+                self.clients.append(
+                    CarryStoreClient(host or "127.0.0.1", int(port), timeout_s=timeout_s)
+                )
+
+    def order(self, key: int) -> list:
+        return rendezvous_store_order(int(key), self.endpoints)
+
+    async def put(self, key, episode_step, version, c, h) -> None:
+        primary = self.order(key)[0]
+        await self.clients[primary].put(key, episode_step, version, c, h)
+
+    async def get(self, key, boundary_step):
+        last_status = ST_MISS
+        errors = 0
+        for i in self.order(key):
+            try:
+                status, entry = await self.clients[i].get(key, boundary_step)
+            except StoreUnavailableError:
+                errors += 1
+                continue
+            if status == ST_OK:
+                return ST_OK, entry
+            if status == ST_STALE:
+                last_status = ST_STALE
+        if errors:
+            raise StoreUnavailableError(
+                f"carry get: {errors} of {len(self.clients)} shards unavailable "
+                f"and no surviving shard holds boundary {boundary_step}"
+            )
+        return last_status, None
+
+    async def close(self) -> None:
+        for c in self.clients:
+            await c.close()
+
+
 class LocalCarryStore:
     """The CarryStoreClient API over an in-process CarryStore — tests,
     soaks, and co-located single-host deployments skip the wire."""
@@ -453,7 +555,12 @@ def main(argv=None):
     obs = ObsRuntime.create(cfg.obs, role="carry-store")
     if obs is not None:
         obs.serve_metrics([server.stats])
-    print(json.dumps({"serving": True, "port": server.port}), flush=True)
+    ready = {"serving": True, "port": server.port}
+    if cfg.stores:
+        # validate + surface the declared shard ring at boot: a ring the
+        # serve replicas disagree with shows up here, not as misses
+        ready["stores"] = [p[2] for p in parse_store_endpoints(cfg.stores)]
+    print(json.dumps(ready), flush=True)
     try:
         while True:
             time.sleep(3600)

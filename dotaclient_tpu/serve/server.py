@@ -165,17 +165,27 @@ class InferenceServer:
         # BOTH are unset the handoff module is never imported (the
         # serve tier's own inertness rule).
         if carry_store is None and cfg.serve.handoff_endpoint:
-            from dotaclient_tpu.serve.handoff import CarryStoreClient
+            if "," in str(cfg.serve.handoff_endpoint):
+                # comma list = sharded ring: rendezvous placement by
+                # client_key, full-preference-order failover reads
+                from dotaclient_tpu.serve.handoff import ShardedCarryStore
 
-            host, sep, port = str(cfg.serve.handoff_endpoint).rpartition(":")
-            if not sep or not port.isdigit():
-                raise ValueError(
-                    f"--serve.handoff_endpoint must be host:port, got "
-                    f"{cfg.serve.handoff_endpoint!r}"
+                carry_store = ShardedCarryStore(
+                    str(cfg.serve.handoff_endpoint),
+                    timeout_s=cfg.serve.handoff_timeout_s,
                 )
-            carry_store = CarryStoreClient(
-                host or "127.0.0.1", int(port), timeout_s=cfg.serve.handoff_timeout_s
-            )
+            else:
+                from dotaclient_tpu.serve.handoff import CarryStoreClient
+
+                host, sep, port = str(cfg.serve.handoff_endpoint).rpartition(":")
+                if not sep or not port.isdigit():
+                    raise ValueError(
+                        f"--serve.handoff_endpoint must be host:port, got "
+                        f"{cfg.serve.handoff_endpoint!r}"
+                    )
+                carry_store = CarryStoreClient(
+                    host or "127.0.0.1", int(port), timeout_s=cfg.serve.handoff_timeout_s
+                )
         self._store = carry_store
         self.handoff_writes_total = 0
         self.handoff_write_errors_total = 0
@@ -596,6 +606,18 @@ class InferenceServer:
                 "serve_handoff_resumes_total": float(self.resumes_total),
                 "serve_handoff_resume_misses_total": float(self.resume_misses_total),
                 "serve_handoff_replayed_steps_total": float(self.replayed_steps_total),
+            }
+        )
+        # The S_INFO load dict as registry-pinned gauges: the control
+        # plane (and operators) scrape placement load off /metrics
+        # instead of dialing S_INFO per probe.
+        load = self.load()
+        out.update(
+            {
+                "serve_load_clients": float(load["clients"]),
+                "serve_load_occupancy": float(load["occupancy"]),
+                "serve_load_pending": float(load["pending"]),
+                "serve_load_capacity": float(load["capacity"]),
             }
         )
         return out

@@ -133,6 +133,12 @@ def test_golden_decision_sequence_pinned():
     # and the broker-fabric rolling target (PR 14): still ARG-side only
     assert seq(_GOLDEN_SPEC + ",rolling@4:1@broker") == _GOLDEN_SEQ
     assert seq(_GOLDEN_SPEC + ",rolling@2:0.5@broker,kill@9:2@broker,rolling@15:1@server") == _GOLDEN_SEQ
+    # scale set-points (PR 16) are ARG-side topology events: zero rate
+    # draws for every tier the grammar knows, alone or mixed with the
+    # kill-class clauses they script alongside
+    assert seq(_GOLDEN_SPEC + ",scale@5:4@server") == _GOLDEN_SEQ
+    assert seq(_GOLDEN_SPEC + ",scale@2:3@broker,scale@8:2@actor,scale@11:2@server") == _GOLDEN_SEQ
+    assert seq(_GOLDEN_SPEC + ",scale@3:4@server,rolling@6:1@server,kill@9:2@broker") == _GOLDEN_SEQ
     # latency draw position pinned too (it follows the five rate draws)
     s = FaultSchedule.parse(_GOLDEN_SPEC + ",kill@9:1@learner", seed=3)
     assert round(s.decide(0).latency_s, 9) == 0.00253577
@@ -159,6 +165,31 @@ def test_rolling_grammar_parses_and_rejects():
         "rolling@1:2@server:term",
         "rolling@1:2@broker:term",
         "stall@1:2@server",
+    ):
+        with pytest.raises(ValueError):
+            FaultSchedule.parse(bad)
+
+
+def test_scale_grammar_parses_and_rejects():
+    """scale@T:N@broker|server|actor — deterministic topology
+    set-points for the control tier. N rides the duration slot (whole
+    replica counts >= 1 only), the tier selector is MANDATORY, and the
+    events surface through scales() — NOT kills(), so every existing
+    ScheduleRunner routes exactly what it did before."""
+    s = FaultSchedule.parse(
+        "scale@5:4@server,kill@10:2,scale@20:2@broker,scale@30:8@actor", seed=0
+    )
+    rows = [(e.at_s, int(e.duration_s), e.target) for e in s.scales()]
+    assert rows == [(5.0, 4, "server"), (20.0, 2, "broker"), (30.0, 8, "actor")]
+    assert all(e.kind == "scale" for e in s.scales())
+    # kills() is untouched by scale clauses
+    assert [(e.kind, e.at_s) for e in s.kills()] == [("kill", 10.0)]
+    for bad in (
+        "scale@5:4",  # tier is mandatory
+        "scale@5:4@learner",  # singleton tier — not scalable
+        "scale@5:4@server:term",  # no signal selector
+        "scale@5:0@server",  # scale-to-zero is a kill
+        "scale@5:1.5@server",  # fractional replicas
     ):
         with pytest.raises(ValueError):
             FaultSchedule.parse(bad)

@@ -1,0 +1,586 @@
+"""Control plane (dotaclient_tpu/control/, PR 16): the closed-loop
+autoscaler + discovery service.
+
+The load-bearing contracts: the --control.policy grammar fails LOUDLY
+on malformation (a typo'd policy must crash the controller at boot,
+never silently observe-only); the hysteresis band + cooldown discipline
+means one move per tier per cooldown and a scraper outage FREEZES
+topology (missing meter = hold, never a default number); the k8s driver
+commits its replica view only on kubectl rc==0; the whole
+scrape→decide→actuate loop closes over REAL MetricsHTTPServer surfaces
+(what the controller decides on is exactly what `curl /metrics` shows);
+and discovery (`control:<host:port>`) is a wire contract — the serve
+client speaks plain HTTP and a literal-endpoint fleet NEVER imports
+dotaclient_tpu.control (subprocess proof, the PR-7/10 inertness
+pattern)."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dotaclient_tpu.config import ControlConfig, ControlLoopConfig, ObsConfig
+from dotaclient_tpu.control.drivers import InProcessDriver, K8sDriver, StaticDriver, TierSpec
+from dotaclient_tpu.control.policy import PolicyClause, PolicyEngine, parse_policy
+from dotaclient_tpu.control.scrape import (
+    aggregate_tier,
+    parse_prometheus_text,
+    scrape_endpoint,
+    scrape_health,
+)
+from dotaclient_tpu.control.server import ControlPlane, build_driver
+from dotaclient_tpu.obs.http import MetricsHTTPServer, render_prometheus
+
+REPO_ROOT = __import__("pathlib").Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------- policy grammar
+
+
+def test_parse_policy_full_clause_and_defaults():
+    (cl,) = parse_policy(
+        "server:serve_load_occupancy.mean,high=0.8,low=0.2,min=2,max=8,cooldown=30,step=2"
+    )
+    assert cl == PolicyClause(
+        tier="server", meter="serve_load_occupancy.mean",
+        high=0.8, low=0.2, min=2, max=8, cooldown_s=30.0, step=2,
+    )
+    (d,) = parse_policy("broker:up,high=5,low=1")
+    assert (d.min, d.max, d.cooldown_s, d.step) == (1, 8, 30.0, 1)
+    assert parse_policy("") == [] and parse_policy("   ") == []
+    two = parse_policy("server:up,high=5,low=1; broker:up,high=9,low=2")
+    assert [c.tier for c in two] == ["server", "broker"]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "server:up,high=5,low=1;",  # trailing empty clause
+        "serve_load.mean,high=5,low=1",  # missing tier:
+        "gateway:up,high=5,low=1",  # unknown tier
+        "server:up,high5,low=1",  # non-k=v item
+        "server:up,high=5,low=1,hi=3",  # unknown key
+        "server:up,high=5",  # missing low
+        "server:up,low=1",  # missing high
+        "server:up,high=1,low=5",  # inverted band
+        "server:up,high=5,low=1,min=0",  # min < 1
+        "server:up,high=5,low=1,min=4,max=2",  # max < min
+        "server:up,high=5,low=1,step=0",  # step < 1
+        "server:up,high=x,low=1",  # non-number
+    ],
+)
+def test_parse_policy_rejects_malformation_loudly(bad):
+    with pytest.raises(ValueError):
+        parse_policy(bad)
+
+
+def test_policy_engine_hysteresis_cooldown_and_clamps():
+    clock = [1000.0]
+    eng = PolicyEngine(
+        parse_policy("server:load.mean,high=0.8,low=0.2,min=2,max=4,cooldown=30"),
+        now_fn=lambda: clock[0],
+    )
+
+    def ev(value, cur):
+        (r,) = eng.evaluate({"server": {"load.mean": value}}, {"server": cur})
+        return r
+
+    r = ev(0.9, 2)
+    assert (r["action"], r["target"]) == ("up", 3) and "0.9" in r["reason"]
+    # cooldown: the same trigger holds until the clock advances
+    r = ev(0.9, 3)
+    assert r["action"] == "hold" and r["reason"].startswith("cooldown")
+    clock[0] += 31
+    assert ev(0.9, 3)["target"] == 4
+    clock[0] += 31
+    r = ev(0.99, 4)  # clamp at max: no move, no cooldown burn
+    assert r["action"] == "hold" and r["reason"] == "at max bound"
+    r = ev(0.5, 4)
+    assert r["action"] == "hold" and r["reason"] == "in hysteresis band"
+    r = ev(0.1, 4)
+    assert (r["action"], r["target"]) == ("down", 3)
+    clock[0] += 31
+    assert ev(0.05, 3)["target"] == 2
+    clock[0] += 31
+    r = ev(0.05, 2)  # clamp at min
+    assert r["action"] == "hold" and r["reason"] == "at min bound"
+
+
+def test_policy_engine_missing_meter_freezes_and_one_move_per_tier():
+    clock = [0.0]
+    eng = PolicyEngine(
+        parse_policy("server:a.mean,high=5,low=1;server:b.max,high=5,low=1"),
+        now_fn=lambda: clock[0],
+    )
+    # scraper outage: meter absent → hold loudly, never a default number
+    recs = eng.evaluate({"server": {"b.max": 9.0}}, {"server": 2})
+    assert recs[0]["action"] == "hold" and recs[0]["reason"] == "meter missing"
+    # the second clause still moves the tier (first was a non-move)
+    assert recs[1]["action"] == "up"
+    clock[0] += 31
+    # both clauses trigger: clause order wins, the later one is superseded
+    recs = eng.evaluate({"server": {"a.mean": 9.0, "b.max": 9.0}}, {"server": 3})
+    assert recs[0]["action"] == "up"
+    assert recs[1]["action"] == "hold" and recs[1]["reason"] == "superseded"
+
+
+# ------------------------------------------------------- scrape + aggregate
+
+
+def test_parse_prometheus_text_roundtrips_render():
+    scalars = {"serve_load_occupancy": 0.75, "fabric_queue_depth": 6144.0,
+               "big_counter": 1234567890.0}
+    text = render_prometheus(scalars)
+    assert parse_prometheus_text(text) == scalars
+    # comments skipped, junk dropped, prefix stripped
+    assert parse_prometheus_text("# HELP x\ndotaclient_a 1\nnot a number line\nb nan_oops\n") == {"a": 1.0}
+
+
+def test_aggregate_tier_mean_max_sum_and_up():
+    agg = aggregate_tier([{"q": 2.0}, None, {"q": 6.0, "r": 1.0}])
+    assert agg["up"] == 2.0 and agg["scraped"] == 3.0
+    assert agg["q.mean"] == 4.0 and agg["q.max"] == 6.0 and agg["q.sum"] == 8.0
+    assert agg["r.mean"] == 1.0  # over replicas that REPORTED it
+    assert aggregate_tier([]) == {"up": 0.0, "scraped": 0.0}
+
+
+def test_scrape_endpoint_and_health_against_real_surface():
+    gauges = {"serve_load_occupancy": 0.5}
+    health = {"ok": True, "note": "fine"}
+    srv = MetricsHTTPServer(0, sources=[lambda: gauges],
+                            health_provider=lambda: dict(health)).start()
+    try:
+        ep = f"127.0.0.1:{srv.port}"
+        assert scrape_endpoint(ep) == {"serve_load_occupancy": 0.5}
+        gauges["serve_load_occupancy"] = 0.9  # live: sampled per scrape
+        assert scrape_endpoint(ep)["serve_load_occupancy"] == 0.9
+        ok, body = scrape_health(ep)
+        assert ok and body["note"] == "fine"
+        health["ok"] = False  # 503 still carries the verdict body
+        ok, body = scrape_health(ep)
+        assert not ok and body["note"] == "fine"
+    finally:
+        srv.stop()
+    assert scrape_endpoint(f"127.0.0.1:{srv.port}", timeout_s=0.3) is None
+
+
+# ----------------------------------------------------------------- drivers
+
+
+def test_static_driver_observes_and_never_actuates():
+    d = StaticDriver({"server": ["a:1", "b:1"], "broker": ["c:2"]})
+    assert d.tiers() == ["broker", "server"]
+    assert d.replicas("server") == 2
+    rec = d.scale("server", 5)
+    assert rec["actuated"] is False and d.noop_scales == 1
+    assert d.replicas("server") == 2, "static scale must not change the view"
+    assert d.topology() == {"server": ["a:1", "b:1"], "broker": ["c:2"]}
+    # a separate data-port topology map overrides the metrics lists
+    d2 = StaticDriver({"server": ["a:9100"]}, topology_map={"server": ["a:13380"]})
+    assert d2.topology() == {"server": ["a:13380"]}
+    assert d2.metrics_endpoints("server") == ["a:9100"]
+
+
+def test_k8s_driver_argv_pod_dns_and_failure_keeps_view():
+    calls = []
+    rc = [0]
+    specs = {
+        "server": TierSpec(tier="server", workload="statefulset/inference",
+                           service="inference", data_port=13380, replicas=2),
+        "learner": TierSpec(tier="learner", workload="statefulset/learner",
+                            data_port=0, replicas=1),
+    }
+    d = K8sDriver(specs, kubectl="kubectl", runner=lambda argv: (calls.append(argv), rc[0])[1])
+    assert d.metrics_endpoints("server") == [
+        "inference-0.inference.dotaclient.svc:9100",
+        "inference-1.inference.dotaclient.svc:9100",
+    ]
+    # topology lists DATA ports, and only tiers that have one
+    assert d.topology() == {
+        "server": ["inference-0.inference.dotaclient.svc:13380",
+                   "inference-1.inference.dotaclient.svc:13380"],
+    }
+    rec = d.scale("server", 3)
+    assert calls[-1] == ["kubectl", "scale", "statefulset/inference",
+                         "--replicas=3", "-n", "dotaclient"]
+    assert rec["actuated"] and d.replicas("server") == 3
+    assert len(d.metrics_endpoints("server")) == 3, "endpoint list tracks the view"
+    rc[0] = 1  # kubectl fails: the view must NOT assume success
+    rec = d.scale("server", 4)
+    assert rec["actuated"] is False and d.replicas("server") == 3
+    assert d.kubectl_calls == 2 and d.kubectl_failures == 1
+
+
+def test_build_driver_static_k8s_and_reject():
+    cfg = ControlConfig(control=ControlLoopConfig(
+        policy="server:up,high=5,low=1", driver="static",
+        servers="a:9100, b:9100", brokers="c:9100",
+    ))
+    driver, overrides = build_driver(cfg)
+    assert isinstance(driver, StaticDriver) and overrides == {}
+    assert driver.metrics_endpoints("server") == ["a:9100", "b:9100"]
+    # k8s: managed tiers = policy clauses ∪ flag lists; lists pin scraping
+    cfg.control.driver = "k8s"
+    cfg.control.namespace = "other"
+    driver, overrides = build_driver(cfg)
+    assert isinstance(driver, K8sDriver)
+    assert driver.tiers() == ["broker", "server"]
+    assert overrides == {"server": ["a:9100", "b:9100"], "broker": ["c:9100"]}
+    assert driver.metrics_endpoints("server")[0].endswith(".other.svc:9100")
+    cfg.control.driver = "nomad"
+    with pytest.raises(ValueError):
+        build_driver(cfg)
+
+
+# ------------------------------------------------------------- closed loop
+
+
+class _ElasticRouter:
+    """The soak's elastic-shim shape: replica_count()/scale_to(n) over a
+    list of live obs surfaces (one MetricsHTTPServer per 'replica')."""
+
+    def __init__(self, make_replica, n):
+        self._make = make_replica
+        self.replicas = [make_replica(i) for i in range(n)]
+
+    def replica_count(self):
+        return len(self.replicas)
+
+    def scale_to(self, n):
+        while len(self.replicas) < n:
+            self.replicas.append(self._make(len(self.replicas)))
+        while len(self.replicas) > n:
+            self.replicas.pop().stop()  # highest index first (the STS order)
+
+    def endpoints(self):
+        return [f"127.0.0.1:{r.port}" for r in self.replicas]
+
+    def close(self):
+        for r in self.replicas:
+            r.stop()
+
+
+def test_control_plane_closed_loop_over_real_surfaces():
+    """Scrape→decide→actuate→re-scrape with REAL HTTP surfaces: load
+    high scales 2→3 (epoch bump, ledger entry carrying the triggering
+    meters), cooldown holds, load low scales back, and /topology +
+    /metrics serve the loop's state over the wire."""
+    load = [0.9]  # shared gauge every replica reports
+    router = _ElasticRouter(
+        lambda i: MetricsHTTPServer(0, sources=[lambda: {"serve_load_occupancy": load[0]}]).start(),
+        2,
+    )
+    clock = [5000.0]
+    driver = InProcessDriver(
+        {"server": router},
+        metrics={"server": router.endpoints},
+        topology_fn=lambda: {"server": router.endpoints()},
+    )
+    cfg = ControlConfig(control=ControlLoopConfig(
+        port=0, poll_s=0.05,
+        policy="server:serve_load_occupancy.mean,high=0.8,low=0.2,min=2,max=4,cooldown=30",
+    ))
+    plane = ControlPlane(cfg, driver, now_fn=lambda: clock[0])
+    try:
+        round1 = plane.poll_once()
+        assert round1["evals"][0]["action"] == "up"
+        assert router.replica_count() == 3 and plane.topology_epoch == 1
+        # the ledger proves the decision against its triggering meters
+        entry = plane.ledger()[-1]
+        assert entry["action"] == "up" and entry["target"] == 3
+        assert entry["meters"]["serve_load_occupancy.mean"] == pytest.approx(0.9)
+        assert entry["meters"]["up"] == 2.0 and entry["actuation"]["actuated"]
+        # cooldown freezes the tier even though load is still high
+        plane.poll_once()
+        assert router.replica_count() == 3 and plane.ledger()[-1]["action"] == "hold"
+        # the new replica's surface joins the NEXT poll's scrape
+        clock[0] += 31
+        load[0] = 0.1
+        round3 = plane.poll_once()
+        assert round3["meters"]["server"]["up"] == 3.0
+        assert round3["evals"][0]["action"] == "down" and router.replica_count() == 2
+        assert plane.topology_epoch == 2
+
+        # the serving surface: /topology + /metrics over the wire
+        plane.start()
+        base = f"http://127.0.0.1:{plane.port}"
+        with urllib.request.urlopen(f"{base}/topology", timeout=5) as resp:
+            topo = json.loads(resp.read())
+        assert topo["ok"] and topo["epoch"] == 2
+        assert topo["tiers"]["server"] == router.endpoints()
+        scraped = scrape_endpoint(f"127.0.0.1:{plane.port}")
+        assert scraped["control_scale_ups_total"] == 1.0
+        assert scraped["control_scale_downs_total"] == 1.0
+        assert scraped["control_replicas_server"] == 2.0
+        assert scraped["control_topology_epoch"] == 2.0
+    finally:
+        plane.stop()
+        router.close()
+
+
+def test_control_plane_scrape_outage_freezes_topology():
+    """Every surface down: up=0, the policy meter is missing, the tier
+    HOLDS at its current shape — an outage must never shrink topology."""
+    router = _ElasticRouter(lambda i: MetricsHTTPServer(0).start(), 2)
+    eps = router.endpoints()
+    router.close()  # surfaces dead, router still reports 2 replicas
+    router.replicas = [type("R", (), {"port": int(e.rpartition(":")[2]), "stop": lambda self: None})() for e in eps]
+    driver = InProcessDriver({"server": router}, metrics={"server": router.endpoints})
+    cfg = ControlConfig(control=ControlLoopConfig(
+        port=0, poll_s=0.05,
+        policy="server:serve_load_occupancy.mean,high=0.8,low=0.2,min=1,max=4",
+    ))
+    plane = ControlPlane(cfg, driver)
+    plane._scrape_timeout = 0.3
+    round1 = plane.poll_once()
+    assert round1["meters"]["server"]["up"] == 0.0
+    (ev,) = round1["evals"]
+    assert ev["action"] == "hold" and ev["reason"] == "meter missing"
+    assert router.replica_count() == 2 and plane.scrape_errors_total == 2
+
+
+def test_json_route_error_is_500_not_a_dead_thread():
+    srv = MetricsHTTPServer(0, json_routes={"/topology": lambda: 1 / 0}).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/topology", timeout=5)
+        assert exc.value.code == 500
+        # the serving thread survived the throw
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as resp:
+            assert json.loads(resp.read())["ok"] is True
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------- discovery
+
+
+def test_split_control_scheme_and_parse_endpoints():
+    from dotaclient_tpu.serve.client import parse_endpoints, split_control_scheme
+
+    assert split_control_scheme("control:ctrl-host:13400") == "ctrl-host:13400"
+    assert split_control_scheme("control::13400") == "127.0.0.1:13400"
+    assert split_control_scheme("a:1,b:2") is None
+    for bad in ("control:", "control:host", "control:host:0", "control:host:x",
+                "control:host:70000"):
+        with pytest.raises(ValueError):
+            split_control_scheme(bad)
+    # discovery yields an EMPTY list (filled at connect); literals unchanged
+    assert parse_endpoints("control:h:13400") == []
+    assert parse_endpoints("a:1,b:2") == [("a", 1), ("b", 2)]
+
+
+def test_discovery_client_steps_through_control_plane():
+    """End to end over the wire: a RemotePolicyClient whose endpoint is
+    `control:<controller>` fetches /topology at connect, adopts the
+    server list, and steps against the discovered replica — the client
+    side never imports dotaclient_tpu.control (proven separately by the
+    inertness subprocess test)."""
+    import asyncio
+
+    import numpy as np
+
+    from dotaclient_tpu.config import InferenceConfig, PolicyConfig, ServeConfig
+    from dotaclient_tpu.env import featurizer as F
+    from dotaclient_tpu.serve.client import RemoteInferenceError, RemotePolicyClient
+    from dotaclient_tpu.serve.server import InferenceServer
+
+    policy = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+    srv = InferenceServer(InferenceConfig(
+        serve=ServeConfig(port=0, max_batch=4, gather_window_s=0.005, weight_poll_s=0.05),
+        policy=policy, seed=1,
+    )).start()
+    driver = StaticDriver(
+        {"server": ["unused:9100"]},
+        topology_map={"server": [f"127.0.0.1:{srv.port}"]},
+    )
+    cfg = ControlConfig(control=ControlLoopConfig(port=0, poll_s=60.0, policy=""))
+    plane = ControlPlane(cfg, driver).start()
+    try:
+        client = RemotePolicyClient(f"control:127.0.0.1:{plane.port}", policy)
+        assert client.endpoints == [] and client.addr == ("", 0)
+
+        async def go():
+            try:
+                return await client.step(
+                    7, F.zeros_observation(), np.zeros(2, np.uint32),
+                    episode_start=True,
+                )
+            finally:
+                await client.close()
+
+        resp = asyncio.new_event_loop().run_until_complete(go())
+        assert resp.action is not None
+        assert client.endpoints == [("127.0.0.1", srv.port)]
+        assert client.topology_refreshes == 1 and client.topology_epoch == 0
+
+        # controller unreachable + no cached list = loud connect error
+        dead = RemotePolicyClient(f"control:127.0.0.1:{plane.port}", policy,
+                                  connect_timeout_s=0.5)
+        plane.stop()
+
+        async def dead_step():
+            try:
+                await dead.step(1, F.zeros_observation(), np.zeros(2, np.uint32),
+                                episode_start=True)
+            finally:
+                await dead.close()
+
+        with pytest.raises(RemoteInferenceError, match="no serve endpoints"):
+            asyncio.new_event_loop().run_until_complete(dead_step())
+        assert dead.topology_errors >= 1
+    finally:
+        plane.stop()
+        srv.stop()
+
+
+def test_literal_endpoint_fleet_never_imports_control():
+    """Subprocess inertness proof (the PR 7/10 pattern): building the
+    serve client AND server with literal endpoint lists — the default
+    fleet shape — never imports dotaclient_tpu.control. Discovery is a
+    client-side opt-in wire contract, not a code dependency."""
+    script = r"""
+import sys
+from dotaclient_tpu.config import InferenceConfig, PolicyConfig, ServeConfig
+from dotaclient_tpu.serve.client import RemotePolicyClient
+from dotaclient_tpu.serve.server import InferenceServer
+
+policy = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+client = RemotePolicyClient("a:1,b:2", policy)
+assert client._control is None and len(client.endpoints) == 2
+srv = InferenceServer(InferenceConfig(
+    serve=ServeConfig(port=0, max_batch=2, gather_window_s=0.005, weight_poll_s=0.05),
+    policy=policy, seed=1,
+)).start()
+srv.stop()
+offenders = [m for m in sys.modules if m.startswith("dotaclient_tpu.control")]
+assert not offenders, f"control imported on the literal path: {offenders}"
+print("CONTROL_INERT_OK")
+"""
+    from tests.conftest import clean_subprocess_env
+
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+        cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0 and "CONTROL_INERT_OK" in proc.stdout, proc.stderr[-2000:]
+
+
+def test_control_binary_boots_and_serves_topology():
+    """`python -m dotaclient_tpu.control.server` with a static driver:
+    ready line on stdout, /topology + /metrics + /healthz served on
+    --control.port. The boot proof for k8s/control.yaml's probes."""
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    from tests.conftest import clean_subprocess_env
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dotaclient_tpu.control.server",
+         "--control.port", str(port), "--control.poll_s", "0.2",
+         "--control.policy", "server:serve_load_occupancy.mean,high=0.8,low=0.2,min=2",
+         "--control.driver", "static",
+         "--control.servers", "127.0.0.1:1,127.0.0.1:2"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+        cwd=str(REPO_ROOT),
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["serving"] and ready["driver"] == "static"
+        assert ready["tiers"] == ["server"]
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/topology", timeout=5) as resp:
+            topo = json.loads(resp.read())
+        assert topo["tiers"]["server"] == ["127.0.0.1:1", "127.0.0.1:2"]
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+            assert json.loads(resp.read())["ok"] is True
+        scraped = scrape_endpoint(f"127.0.0.1:{port}")
+        assert scraped["control_managed_tiers"] == 1.0
+        assert scraped["control_policy_clauses"] == 1.0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
+# --------------------------------------------------------- soak artifact
+
+
+def test_autoscale_soak_committed_artifact_verdict():
+    """Committed-artifact guard (the SERVE_HANDOFF_SOAK pattern):
+    AUTOSCALE_SOAK.json must exist with an all-green verdict — the
+    controller (not the operator) scaled serve replicas 2→4→2, broker
+    shards 2→4→2, and the actor pool through a demand burst with
+    rolling restarts + a hard kill on the serve tier, every actuated
+    move ledgered WITH the meter values that justified it, zero
+    abandoned episodes, and the PR-13/14 conservation ledgers exact."""
+    path = os.path.join(REPO_ROOT, "AUTOSCALE_SOAK.json")
+    assert os.path.exists(path), "AUTOSCALE_SOAK.json not committed"
+    artifact = json.load(open(path))
+    v = artifact["verdict"]
+    bad = [k for k, val in v.items() if isinstance(val, bool) and not val]
+    assert not bad, f"committed AUTOSCALE_SOAK.json has red verdicts: {bad}"
+    paths = artifact["replica_paths"]
+    assert paths["server"][0] == 2 and max(paths["server"]) == 4
+    assert paths["server"][-1] == 2
+    assert max(paths["broker"]) == 4 and paths["broker"][-1] == 2
+    assert artifact["producer_totals"]["episodes_abandoned"] == 0
+    assert artifact["producer_totals"]["episodes_resumed"] >= 1
+    assert artifact["serve_kills"] >= 3
+    # every ledgered move carries its justification: the triggering
+    # meter's value, consistent with the snapshot and the band edge
+    for mv in artifact["decisions"]["moves"]:
+        assert mv["meters"].get(mv["meter"]) == mv["value"]
+        if mv["action"] == "up":
+            assert mv["value"] > mv["high"]
+        else:
+            assert mv["value"] < mv["low"]
+    shards = artifact["broker_shards"]
+    assert len(shards) >= 4  # the fabric really rescaled
+    for led in shards:
+        assert led["conserves"] and led["unaccounted"] == 0, led
+    assert artifact["tokens"]["unserved"] == 0
+
+
+@pytest.mark.nightly
+@pytest.mark.slow  # tier-1 runs -m 'not slow', which would override the
+# nightly exclusion and pull this multi-minute closed loop into the gate
+def test_autoscale_soak_quick_rerun(tmp_path):
+    """Nightly: scripts/soak_autoscale.py --quick must reproduce the
+    committed artifact's invariants end-to-end on this host."""
+    from tests.conftest import clean_subprocess_env
+
+    out = tmp_path / "AUTOSCALE_SOAK.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "soak_autoscale.py"),
+            "--quick",
+            "--out",
+            str(out),
+        ],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+        timeout=580,
+        env=clean_subprocess_env(extra={"JAX_PLATFORMS": "cpu"}),
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    artifact = json.loads(out.read_text())
+    v = artifact["verdict"]
+    bad = [k for k, val in v.items() if isinstance(val, bool) and not val]
+    assert not bad, bad
+    assert artifact["producer_totals"]["episodes_abandoned"] == 0
+    assert artifact["replica_paths"]["server"][-1] == 2

@@ -44,6 +44,7 @@ def test_manifests_exist():
         "evaluator.yaml",
         "rabbitmq.yaml",
         "inference.yaml",
+        "control.yaml",
     } <= names
     assert (K8S / "Dockerfile").exists()
 
@@ -97,13 +98,14 @@ def test_flags_are_real_config_fields():
     from dotaclient_tpu.config import ActorConfig, EvalConfig, LearnerConfig, add_flags
     import argparse
 
-    from dotaclient_tpu.config import InferenceConfig
+    from dotaclient_tpu.config import ControlConfig, InferenceConfig
 
     known = {
         "dotaclient_tpu.runtime.learner": LearnerConfig(),
         "dotaclient_tpu.runtime.actor": ActorConfig(),
         "dotaclient_tpu.eval.evaluator": EvalConfig(),
         "dotaclient_tpu.serve.server": InferenceConfig(),
+        "dotaclient_tpu.control.server": ControlConfig(),
     }
     for fname, c in _our_containers():
         cmd = c.get("command")
@@ -298,6 +300,7 @@ def test_chaos_pinned_off_in_all_prod_manifests():
             "dotaclient_tpu.transport.fabric",  # fabric shard: no chaos surface
             "dotaclient_tpu.env.fake_dotaservice",  # env stub: no flags at all
             "dotaclient_tpu.serve.handoff",  # carry store: no chaos surface
+            "dotaclient_tpu.control.server",  # control plane: no chaos surface
         ):
             continue
         args = c.get("args", [])
@@ -488,6 +491,72 @@ def test_session_continuity_manifests():
                 "resume window must sit under the fallback budget, or the "
                 "fallback decision starves behind resume retries"
             )
+
+
+def test_control_plane_manifest():
+    """Control plane (PR 16): a single-replica Deployment + Service; the
+    committed --control.policy must PARSE (a typo'd clause would crash
+    the pod loop on boot), the driver ships "static" (observe-only until
+    the ledger earns the k8s flip), every port agrees (control.port ==
+    containerPort == probe port == Service port — clients dial
+    control:control-plane:<that port>), and the scrape flag lists name
+    one per-pod DNS endpoint per broker/inference replica (list drift =
+    a blind or phantom scrape, exactly the serve endpoint-list rule)."""
+    from dotaclient_tpu.control.policy import parse_policy
+
+    (_, dep), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "control-plane" and d["kind"] == "Deployment"
+    ]
+    assert dep["spec"]["replicas"] == 1, "the controller is a decision loop, not a data path"
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][2] == "dotaclient_tpu.control.server"
+    args = c["args"]
+
+    clauses = parse_policy(args[args.index("--control.policy") + 1])
+    assert clauses, "shipped policy must have at least one clause"
+    for cl in clauses:
+        assert cl.min >= 1 and cl.low < cl.high and cl.cooldown_s > 0
+    assert {cl.tier for cl in clauses} >= {"server", "broker"}
+
+    assert args[args.index("--control.driver") + 1] == "static", (
+        "ship observe-only first; the k8s flip is a flag change with a "
+        "ledger behind it, not part of this rollout"
+    )
+
+    cport = int(args[args.index("--control.port") + 1])
+    assert {p["containerPort"] for p in c["ports"]} == {cport}
+    assert c["readinessProbe"]["httpGet"]["port"] == cport
+    assert c["livenessProbe"]["httpGet"]["port"] == cport
+    (_, svc), = [
+        (f, d) for f, d in DOCS
+        if d["kind"] == "Service" and d["metadata"]["name"] == "control-plane"
+    ]
+    assert {p["port"] for p in svc["spec"]["ports"]} == {cport}
+
+    poll_s = float(args[args.index("--control.poll_s") + 1])
+    assert all(poll_s < cl.cooldown_s for cl in clauses), (
+        "poll cadence must sit well under every cooldown: the poll "
+        "samples meters, the cooldown waits for the fleet to respond"
+    )
+
+    # scrape lists cross-checked against the committed replica counts
+    (_, inf), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "inference" and d["kind"] == "StatefulSet"
+    ]
+    servers = args[args.index("--control.servers") + 1].split(",")
+    assert servers == [
+        f"inference-{i}.inference:9100" for i in range(inf["spec"]["replicas"])
+    ], "server scrape list must name every inference replica exactly"
+    (_, brk), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "broker" and d["kind"] == "StatefulSet"
+    ]
+    brokers = args[args.index("--control.brokers") + 1].split(",")
+    assert brokers == [
+        f"broker-{i}.broker:9100" for i in range(brk["spec"]["replicas"])
+    ], "broker scrape list must name every broker shard exactly"
 
 
 def test_actor_fleet_scale_and_kill_switch():

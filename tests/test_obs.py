@@ -514,11 +514,22 @@ def test_serve_scalars_are_registered():
         "serve_handoff_resumes_total",
         "serve_handoff_resume_misses_total",
         "serve_handoff_replayed_steps_total",
+        # placement load (the S_INFO load dict as scrape gauges — the
+        # control plane's policy input)
+        "serve_load_clients",
+        "serve_load_occupancy",
+        "serve_load_pending",
+        "serve_load_capacity",
         "actor_batch_occupancy",  # the shared batcher family rides along
         "actor_tick_rows_1",
     } <= set(stats)
     # default-off surface: handoff meters read zero with no store
     assert stats["serve_handoff_store_writes_total"] == 0.0
+    # idle load reads zero except capacity (= --serve.max_batch)
+    assert stats["serve_load_clients"] == 0.0
+    assert stats["serve_load_occupancy"] == 0.0
+    assert stats["serve_load_pending"] == 0.0
+    assert stats["serve_load_capacity"] == 2.0
 
 
 def test_serve_failover_fallback_scalars_are_registered():

@@ -224,6 +224,27 @@ def test_handoff_mutants_found_then_fixed(mutant, needle):
     assert fixed.exhausted and fixed.violations == []
 
 
+def test_handoff_resharding_walk_clean_and_primary_only_found():
+    """The sharded-store extension: with a reshard thread that adds a
+    shard mid-episode (adversarially becoming the key's new rendezvous
+    primary), the full-preference-order walk read exhausts clean —
+    kills before/after the topology change included. The
+    reshard_primary_only mutant (read consults only the NEW primary)
+    loses exactly the schedule sharding introduces: boundary durable on
+    the old primary, reshard, kill, resume finds nothing → abandon.
+    ShardedCarryStore.get walks the full order because of this."""
+    fixed = explore(HandoffModel(steps=5, chunk=2, kills=2, shards=2))
+    assert fixed.exhausted and fixed.violations == []
+    broken = explore(
+        HandoffModel(steps=5, chunk=2, kills=2, shards=2, mutant="reshard_primary_only")
+    )
+    assert any("abandoned" in v for v in broken.violations), broken.violations
+    # the mutant is meaningless without a possible reshard — the model
+    # refuses the degenerate configuration rather than passing vacuously
+    with pytest.raises(AssertionError):
+        HandoffModel(shards=1, mutant="reshard_primary_only")
+
+
 def test_handoff_model_matches_real_carry_store():
     """Cross-validation against the REAL CarryStore (serve/handoff.py):
     the four semantics the model's store component encodes — exact-match
@@ -515,6 +536,7 @@ def test_schedule_soak_deeper_bounds():
         "coalesce": CoalesceModel(versions=5),
         "hot_swap": HotSwapModel(swaps=3, ticks=3, rows=3),
         "carry_handoff": HandoffModel(steps=9, chunk=3, kills=4),
+        "carry_handoff_sharded": HandoffModel(steps=7, chunk=2, kills=3, shards=3),
     }
     for name, model in deep.items():
         result = explore(model, max_states=2_000_000)

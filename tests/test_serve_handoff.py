@@ -52,7 +52,10 @@ from dotaclient_tpu.serve.handoff import (
     CarryStoreClient,
     CarryStoreServer,
     LocalCarryStore,
+    ShardedCarryStore,
     carry_fingerprint,
+    parse_store_endpoints,
+    rendezvous_store_order,
 )
 from dotaclient_tpu.serve.server import InferenceServer
 from dotaclient_tpu.transport import memory as mem
@@ -366,6 +369,151 @@ def test_resume_failover_bitwise_mid_chunk(obs_bf16):
     assert s_b.resumes_total >= 1 and s_b.replayed_steps_total >= 1
     assert store2.gets >= 1 and store2.hits >= 1 and store2.stale == 0
     s_b.stop()
+
+
+# --------------------------------------------------------- sharded store
+
+
+def test_sharded_store_rendezvous_placement_stability():
+    """Placement inherits fabric's rendezvous guarantees: dropping a
+    shard never re-routes a key between survivors, and adding one moves
+    keys only TO it — the property that makes the full-preference-order
+    failover walk sufficient after a reshard."""
+    eps = ["store-0:13390", "store-1:13390", "store-2:13390"]
+    for key in range(200):
+        order = rendezvous_store_order(key, eps)
+        assert order == rendezvous_store_order(key, eps)  # deterministic
+        # removal: survivors keep their relative preference order
+        survivors = [e for i, e in enumerate(eps) if i != order[0]]
+        sub = rendezvous_store_order(key, survivors)
+        assert [survivors[i] for i in sub] == [eps[j] for j in order[1:]], key
+    # add: a key either keeps its primary or moves TO the added shard
+    grown = eps + ["store-3:13390"]
+    moved = 0
+    for key in range(200):
+        old_primary = eps[rendezvous_store_order(key, eps)[0]]
+        new_primary = grown[rendezvous_store_order(key, grown)[0]]
+        if new_primary != old_primary:
+            assert new_primary == "store-3:13390", key
+            moved += 1
+    assert 0 < moved < 200  # ~1/4 of keys move, none between survivors
+
+
+def test_sharded_store_walk_finds_pre_reshard_boundary():
+    """The reshard read protocol on the REAL classes over real TCP (the
+    schedcheck reshard_primary_only mutant's fix): a boundary written
+    under the old topology stays restorable after a shard ADD makes the
+    new shard the key's primary — get walks the full preference order;
+    new writes land on the new primary only."""
+    a = CarryStoreServer(port=0).start()
+    b = CarryStoreServer(port=0).start()
+    ep_a, ep_b = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+    one = ShardedCarryStore([ep_a])
+    two = ShardedCarryStore(f"{ep_a},{ep_b}")
+    # a key whose post-reshard primary IS the added shard
+    key = next(k for k in range(1000) if two.order(k)[0] == 1)
+    z = np.arange(16, dtype=np.float32)
+
+    async def go():
+        await one.put(key, 4, 2, z, z + 1)  # old topology: lands on A
+        st, e = await two.get(key, 4)  # new topology: primary is B
+        assert st == ST_OK and e.episode_step == 4 and e.version == 2
+        assert e.c.tobytes() == z.tobytes() and e.h.tobytes() == (z + 1).tobytes()
+        await two.put(key, 6, 3, z + 2, z + 3)
+        st2, e2 = await two.get(key, 6)
+        assert st2 == ST_OK and e2.episode_step == 6
+        # a never-written boundary walks every shard and stays a refusal
+        st3, e3 = await two.get(key, 8)
+        assert st3 == ST_STALE and e3 is None
+        await one.close()
+        await two.close()
+
+    run(go())
+    assert a.store.puts == 1 and b.store.puts == 1  # primary-only placement
+    a.stop()
+    b.stop()
+
+
+def test_sharded_resume_failover_bitwise_vs_single_store():
+    """Cross-shard resume parity: the wire-level failover/resume run,
+    with the replicas pointed at a TWO-shard ShardedCarryStore instead
+    of one store — outputs stay bitwise the single-store run's, puts
+    land on the key's primary shard only, and the resume read hits
+    through the preference-order walk."""
+    rs = np.random.RandomState(7)
+    obs_seq = [_rand_obs(rs) for _ in range(7)]
+    rng0 = np.asarray(jax.random.PRNGKey(21))
+
+    s_base = _server(store=LocalCarryStore(CarryStore()))
+    base_client = RemotePolicyClient(f"127.0.0.1:{s_base.port}", SMALL, cooldown_s=0.2)
+    base = _drive_steps(base_client, 5, obs_seq, rng0, boundary_every=3)
+    s_base.stop()
+
+    shard_a, shard_b = CarryStore(), CarryStore()
+
+    def sharded():
+        return ShardedCarryStore(
+            ["shard-a:1", "shard-b:2"],
+            clients=[LocalCarryStore(shard_a), LocalCarryStore(shard_b)],
+        )
+
+    s_a = _server(store=sharded())
+    s_b = _server(store=sharded())
+    client = RemotePolicyClient(
+        f"127.0.0.1:{s_a.port},127.0.0.1:{s_b.port}",
+        SMALL,
+        cooldown_s=0.3,
+        connect_timeout_s=1.0,
+    )
+
+    async def on_fail(i, o, rng, buffered, boundary, want, boundary_carry):
+        while True:
+            await asyncio.sleep(0.05)
+            try:
+                if boundary > 0:
+                    fp = carry_fingerprint(boundary_carry[0], boundary_carry[1])
+                    rr = await client.resume(5, boundary, fp)
+                    assert rr.episode_step == boundary
+                for j, bo in enumerate(buffered):
+                    await client.step(5, bo, rng, episode_start=(boundary == 0 and j == 0), replay=True)
+                return await client.step(5, o, rng, episode_start=(i == 0), want_carry=want)
+            except SessionResumeRefused:
+                raise
+            except RemoteInferenceError:
+                continue
+
+    chaos = _drive_steps(
+        client, 5, obs_seq, rng0, boundary_every=3,
+        kill_after=(4, s_a.stop), on_fail=on_fail,
+    )
+    assert base == chaos, "sharded-store resume diverged from the single-store run"
+    assert s_b.resumes_total >= 1 and s_b.replayed_steps_total >= 1
+    primary = sharded().order(5)[0]
+    pri, other = (shard_a, shard_b) if primary == 0 else (shard_b, shard_a)
+    assert pri.puts >= 1 and pri.hits >= 1
+    assert other.puts == 0, "puts leaked off the key's primary shard"
+    s_b.stop()
+
+
+def test_sharded_config_n1_is_plain_client_and_comma_builds_sharded():
+    """Config wiring: no comma in --serve.handoff_endpoint builds the
+    PR-13 CarryStoreClient exactly (N=1 = the single-store path,
+    byte-for-byte); a comma list builds ShardedCarryStore over the
+    same endpoints; malformation stays loud at boot."""
+    s1 = _server(handoff_endpoint="127.0.0.1:13390")
+    assert type(s1._store) is CarryStoreClient
+    assert (s1._store.host, s1._store.port) == ("127.0.0.1", 13390)
+    s1.stop()
+    s2 = _server(handoff_endpoint="127.0.0.1:13390, 127.0.0.1:13391")
+    assert type(s2._store) is ShardedCarryStore
+    assert s2._store.endpoints == ["127.0.0.1:13390", "127.0.0.1:13391"]
+    assert [type(c) for c in s2._store.clients] == [CarryStoreClient, CarryStoreClient]
+    s2.stop()
+    with pytest.raises(ValueError):
+        _server(handoff_endpoint="127.0.0.1:13390,nope")
+    for bad in ("a:b,c:1", "x,", ",", "h:1,,h:2"):
+        with pytest.raises(ValueError):
+            parse_store_endpoints(bad)
 
 
 def test_write_ahead_boundary_durable_before_reply():
