@@ -218,7 +218,23 @@ class ServeIncarnations:
             "resume_misses": int(getattr(server, "resume_misses_total", 0)),
             "replayed_steps": int(getattr(server, "replayed_steps_total", 0)),
             "killed_at": time.monotonic() if chaos_kill else None,
+            # Per-model-slot ledgers (multi-model tier; {} on a
+            # single-model server): FLAT int keys "model<m>_<what>" —
+            # final_ledger's sum() folds them like any other counter.
+            **ServeIncarnations._model_ledgers(server),
         }
+
+    @staticmethod
+    def _model_ledgers(server) -> dict:
+        models = int(getattr(server, "models", 1))
+        if models <= 1:
+            return {}
+        out = {}
+        for m in range(models):
+            out[f"model{m}_requests"] = int(server.model_requests[m])
+            out[f"model{m}_evictions"] = int(server.model_evictions[m])
+            out[f"model{m}_swaps"] = int(server.model_swaps[m])
+        return out
 
     def kill(self) -> dict:
         """Stop the live incarnation and harvest its exact ledger."""
@@ -267,13 +283,19 @@ class ServeIncarnations:
             if self.server is not None:
                 self.ledgers.append(self._harvest(self.server, chaos_kill=False))
                 self.server = None
-            keys = (
+            keys = [
                 "requests", "bad_requests", "episode_resets", "unknown_client",
                 "evictions", "weight_swaps", "carries_resident_at_kill",
                 "handoff_writes", "handoff_write_errors", "resumes",
                 "resume_misses", "replayed_steps",
+            ]
+            # per-model keys appear only on multi-model lives; sum each
+            # across the lives that carried it (a rolling schedule can
+            # mix single- and multi-model incarnations mid-migration).
+            keys += sorted(
+                {k for l in self.ledgers for k in l if k.startswith("model")}
             )
-            total = {k: sum(l[k] for l in self.ledgers) for k in keys}
+            total = {k: sum(l.get(k, 0) for l in self.ledgers) for k in keys}
             total["incarnations"] = len(self.ledgers)
             return total
 

@@ -265,6 +265,22 @@ class ServeConfig:
     # serve_handoff_store_errors_total) and the affected sessions
     # degrade to the PR-10 abandon semantics on the next failover.
     handoff_timeout_s: float = 2.0
+    # Resident model slots. 1 (default) is byte-identical to the
+    # single-model server: one live tree, no per-model anything. N > 1
+    # adds N-1 FROZEN slots (league opponents) behind the same wire
+    # port: slot 0 stays the live hot-swapped tree, slots 1..N-1 are
+    # installed via swap_model() or synced from a league service
+    # (--serve.league_endpoint). Each slot gets its own continuous
+    # batcher (per-model tick bundles) sharing ONE compiled jit
+    # signature — extra slots cost memory, not compiles.
+    models: int = 1
+    # League service "host:port" to sync frozen slots from (GET
+    # /assignments → slot map, GET /snapshot → params). "" (default) =
+    # no sync: slots hold their boot init until swap_model() is called
+    # in-process. Ignored with --serve.models 1.
+    league_endpoint: str = ""
+    # Cadence of the league assignment poll, seconds.
+    league_sync_s: float = 5.0
 
 
 @dataclass
@@ -331,6 +347,20 @@ class ServeClientConfig:
     # Affinity is untouched: the pick happens only when a connection is
     # (re)established, never mid-episode.
     route: str = "order"
+    # Model id this client's sessions step against (multi-model serve).
+    # 0 (default) = the live hot-swapped tree, and the S_INFO handshake
+    # payload stays EMPTY — byte-identical to the single-model client
+    # on every frame (the inertness rule; rollback = this flag). N > 0
+    # binds the connection to frozen serve slot N (a league opponent);
+    # a server without that slot resident refuses at handshake, loudly.
+    model: int = 0
+    # League service "host:port" (dotaclient_tpu/league/server.py).
+    # League-opponent fleets (--opponent league + --serve.endpoint) ask
+    # it GET /match at each episode for an opponent model id and POST
+    # /result with the outcome — the matchmaking/rating loop. "" with
+    # --serve.model 0 keeps the league fleet refusal (no served
+    # opponents to play).
+    league: str = ""
 
 
 @dataclass
@@ -805,6 +835,78 @@ class ControlConfig:
     only — never imports jax or the wire stack."""
 
     control: ControlLoopConfig = field(default_factory=ControlLoopConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
+
+
+@dataclass
+class LeagueServiceConfig:
+    """The --league.* surface of the standing league service
+    (dotaclient_tpu/league/server.py): a disk-backed snapshot registry
+    with checkpoint-lineage records, a matchmaking endpoint over the
+    declarative policy grammar, and a TrueSkill rating service — the
+    eval/league.py per-actor pool promoted to ONE queryable population
+    shared by the whole fleet."""
+
+    # Port of the service's HTTP surface: GET /match, /leaderboard,
+    # /lineage, /assignments, /snapshot plus the standard /metrics +
+    # /healthz (league_* gauges) and POST /result, /snapshot. The k8s
+    # Service pins 13410; 0 = pick a free port (test use).
+    port: int = 13410
+    # Registry root: snapshots persist as <dir>/<name>.npz beside
+    # lineage.json (the checkpoint-lineage ledger) and matches.jsonl
+    # (the append-only match log the leaderboard is reproducible from).
+    # "" = in-memory only (tests); a restart then loses the population.
+    dir: str = ""
+    # Opponent-pool capacity — also the number of frozen serve slots a
+    # multi-model server needs (--serve.models = capacity + 1: slot 0
+    # stays the live tree). Eviction past capacity is the eval/league.py
+    # rule: weakest by mu, never the newest.
+    capacity: int = 8
+    # Serve model slots the service publishes assignments for (GET
+    # /assignments maps slot 1..slots onto the most recent population
+    # members; slot 0 is always the live tree and never assigned). Size
+    # to the serve tier's --serve.models - 1.
+    slots: int = 3
+    # Admission cadence for fan-out-fed snapshots, learner versions
+    # (the eval/league.py maybe_snapshot gating, version-regression
+    # reset included).
+    snapshot_every: int = 20
+    # Matchmaking policy: ";"-separated weighted clauses
+    # "kind[@weight]", kind ∈ uniform | prioritized | exploiter
+    # (league/policy.py). Each GET /match draws a clause by weight:
+    # uniform samples the pool flat, prioritized weights opponents by
+    # observed loss rate (the PFSP-hard analog over ingested results),
+    # exploiter assigns the caller the exploiter role vs the MAIN live
+    # tree (model 0). E.g. "prioritized@0.7;exploiter@0.3".
+    policy: str = "uniform"
+    # The serve endpoint handed to /match callers ("host:port" of the
+    # multi-model inference tier). The service never dials it — it is
+    # matchmaking metadata, so fleets learn the serving address and the
+    # opponent model id from ONE response.
+    serve_endpoint: str = ""
+    # Weight-fanout source feeding the registry (the WeightPublisher
+    # broadcasts actors already receive). "" = no subscription: the
+    # population grows only via POST /snapshot registrations.
+    broker_url: str = ""
+    # Fanout poll cadence, seconds.
+    poll_s: float = 1.0
+    # Exploiter promotion gate: an exploiter candidate whose ingested
+    # results vs main reach gate_games matches AND gate_winrate wins
+    # is promoted into the opponent pool (lineage event "promote").
+    gate_games: int = 5
+    gate_winrate: float = 0.55
+    # Matchmaking draw seed (deterministic soaks/tests).
+    seed: int = 0
+
+
+@dataclass
+class LeagueConfig:
+    """League-service binary (python -m dotaclient_tpu.league.server).
+    Like the control plane it is a standing HTTP service outside the
+    data path — numpy for snapshot trees, stdlib for everything else;
+    it never imports jax or the serve wire stack."""
+
+    league: LeagueServiceConfig = field(default_factory=LeagueServiceConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
 
 

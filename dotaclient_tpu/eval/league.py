@@ -65,6 +65,13 @@ class League:
         self._snapshots: Dict[str, Snapshot] = {}
         self._last_snap_version: Optional[int] = None
         self._rng = np.random.RandomState(seed)
+        # league_* scalar counters (obs/registry.py): the pool's life
+        # story — admissions, evictions, draws, results — was
+        # metrics-silent before; these export via stats().
+        self.snapshots_total = 0
+        self.evictions_total = 0
+        self.opponent_samples_total = 0
+        self.results_total = 0
 
     # ------------------------------------------------------------ snapshots
 
@@ -98,6 +105,7 @@ class League:
         self._snapshots[name] = Snapshot(name, version, frozen)
         self.table.add(name, rating=self.table.get(AGENT))
         self._last_snap_version = version
+        self.snapshots_total += 1
         if len(self._snapshots) > self.capacity:
             self._evict()
         return True
@@ -111,6 +119,7 @@ class League:
         # barely-played snapshots for their uncertainty, not their skill
         weakest = min(candidates, key=lambda n: self.table.get(n).mu)
         del self._snapshots[weakest]
+        self.evictions_total += 1
 
     # ------------------------------------------------------------- sampling
 
@@ -124,6 +133,7 @@ class League:
         p = np.asarray([win_probability(agent, self.table.get(n)) for n in names])
         w = _PFSP_CURVES[self.mode](p) + 1e-6  # floor: nobody is ever unpickable
         w = w / w.sum()
+        self.opponent_samples_total += 1
         return self._snapshots[names[int(self._rng.choice(len(names), p=w))]]
 
     # -------------------------------------------------------------- results
@@ -139,9 +149,24 @@ class League:
         teams, per-hero ratings), which this league never forms."""
         if opponent not in self._snapshots:
             return  # opponent already evicted — rating signal is stale
+        self.results_total += 1
         if win > 0:
             self.table.record(AGENT, opponent)
         elif win < 0:
             self.table.record(opponent, AGENT)
         else:
             self.table.record(AGENT, opponent, draw=True)
+
+    # ------------------------------------------------------------- metrics
+
+    def stats(self) -> Dict[str, float]:
+        """The league_* scalar family (obs/registry.py): pool occupancy
+        plus the cumulative admission/eviction/sampling/result counters
+        — pinned in tests/test_obs.py."""
+        return {
+            "league_pool_size": float(len(self._snapshots)),
+            "league_snapshots_total": float(self.snapshots_total),
+            "league_evictions_total": float(self.evictions_total),
+            "league_opponent_samples_total": float(self.opponent_samples_total),
+            "league_results_total": float(self.results_total),
+        }

@@ -1,0 +1,31 @@
+"""Standing league service (ROADMAP item 2): the eval/league.py
+per-actor opponent pool promoted to ONE queryable population.
+
+Three pieces, one HTTP surface (`python -m dotaclient_tpu.league.server`):
+
+- **registry** (league/registry.py): disk-backed snapshot store with
+  checkpoint-lineage records — params persist as `<dir>/<name>.npz`
+  beside `lineage.json`, so the population survives restarts and every
+  member's ancestry (parent version, kind, promote/evict events) is a
+  query, not archaeology.
+- **matchmaking** (league/policy.py + GET /match): declarative weighted
+  clauses (`uniform | prioritized | exploiter`) pick an opponent and
+  hand back the serve-tier model slot it is resident on — fleets learn
+  WHO to play and WHERE to step it in one response.
+- **ratings** (eval/rating.py TrueSkill behind POST /result + GET
+  /leaderboard): every ingested match appends to `matches.jsonl`, and
+  the leaderboard is reproducible bit-for-bit by replaying that log
+  through a fresh table.
+
+Like the control plane (PR 16) this tier sits OUTSIDE the data path:
+numpy for snapshot trees, stdlib for everything else — it never imports
+jax or the serve wire stack. The serve tier pulls assignments from it
+over plain HTTP (serve/server.py league sync), and self-play actors
+reach it the same way (runtime/selfplay.py remote league mode) — wire
+contracts, not code dependencies.
+"""
+
+from dotaclient_tpu.league.policy import MatchClause, parse_match_policy
+from dotaclient_tpu.league.registry import SnapshotRegistry
+
+__all__ = ["MatchClause", "parse_match_policy", "SnapshotRegistry"]

@@ -96,6 +96,8 @@ class MetricsHTTPServer:
         health_provider: Optional[Callable[[], Dict]] = None,
         profile_handler: Optional[Callable[[float], str]] = None,
         json_routes: Optional[Dict[str, Callable[[], Dict]]] = None,
+        query_routes: Optional[Dict[str, Callable[[Dict], Dict]]] = None,
+        post_routes: Optional[Dict[str, Callable[[bytes], Dict]]] = None,
     ):
         self._sources: List[Callable[[], Dict[str, float]]] = list(sources or [])
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -107,6 +109,14 @@ class MetricsHTTPServer:
         # zero-arg callable returning a JSON-able dict, served 200; a
         # throwing provider is a 500, never a crashed serving thread.
         self.json_routes: Dict[str, Callable[[], Dict]] = dict(json_routes or {})
+        # Parameterized GET routes ("/match", "/snapshot?name=x" on the
+        # league service): path → callable({param: [values]}) → dict. A
+        # provider raising KeyError/ValueError is the caller's fault →
+        # 400; anything else is a 500.
+        self.query_routes: Dict[str, Callable[[Dict], Dict]] = dict(query_routes or {})
+        # POST routes ("/result" ingestion): path → callable(body bytes)
+        # → dict, same 400/500 error split as query_routes.
+        self.post_routes: Dict[str, Callable[[bytes], Dict]] = dict(post_routes or {})
 
     def add_source(self, source: Callable[[], Dict[str, float]]) -> None:
         self._sources.append(source)
@@ -176,11 +186,40 @@ class MetricsHTTPServer:
                         self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
                         return
                     self._reply_json(200, body)
+                elif route in server.query_routes:
+                    params = parse_qs(urlparse(self.path).query)
+                    try:
+                        body = dict(server.query_routes[route](params))
+                    except (KeyError, ValueError) as e:
+                        self._reply_json(400, {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    except Exception as e:
+                        _log.exception("query route %s failed", route)
+                        self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    self._reply_json(200, body)
                 else:
                     self.send_error(404)
 
             def do_POST(self):
                 parsed = urlparse(self.path)
+                if parsed.path in server.post_routes:
+                    try:
+                        length = int(self.headers.get("Content-Length") or 0)
+                    except ValueError:
+                        length = 0
+                    data = self.rfile.read(length) if length > 0 else b""
+                    try:
+                        body = dict(server.post_routes[parsed.path](data))
+                    except (KeyError, ValueError) as e:
+                        self._reply_json(400, {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    except Exception as e:
+                        _log.exception("post route %s failed", parsed.path)
+                        self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    self._reply_json(200, body)
+                    return
                 if parsed.path != "/profile":
                     self.send_error(404)
                     return

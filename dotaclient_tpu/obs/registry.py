@@ -208,6 +208,15 @@ SCALARS: Dict[str, str] = {
     ),
     "serve_fallback_steps_total": "policy steps served by the warm local tree (cumulative)",
     "serve_fallback_version": "model version of the broker-fanout-refreshed local tree",
+    # --- multi-model serve tier (serve/server.py, --serve.models > 1) --
+    "serve_models_resident": "param-tree slots resident on this server (--serve.models)",
+    "serve_league_syncs_total": (
+        "league-assignment slot installs applied by the sync loop "
+        "(--serve.league_endpoint; cumulative)"
+    ),
+    "serve_league_sync_errors_total": (
+        "failed league assignment/snapshot polls — current slots keep serving"
+    ),
     # --- full-state checkpointing (runtime/checkpoint.py aux manifests,
     #     runtime/learner.py CheckpointWorker) — emitted only when
     #     --ckpt.full_state / --ckpt.async_save are on -----------------
@@ -333,6 +342,21 @@ PREFIXES: Dict[str, str] = {
     # control_policy_clauses, control_replicas_<tier>. A family because
     # the per-tier tail is data-dependent (the managed-tier set).
     "control_": "control-plane autoscaler loop health (dotaclient_tpu/control/)",
+    # per-model-slot serve ledgers (serve/server.py InferenceServer.stats,
+    # emitted only at --serve.models > 1): serve_model_requests_total_<m>,
+    # serve_model_swaps_total_<m>, serve_model_evictions_total_<m>,
+    # serve_model_version_<m>, m = model slot index. A family because the
+    # tail is the slot index.
+    "serve_model_": "per-model-slot serve tier ledgers (serve/server.py)",
+    # league population health (eval/league.py League.stats per-actor
+    # pools AND dotaclient_tpu/league/ LeagueService.stats, the standing
+    # service): league_pool_size, league_snapshots_total,
+    # league_evictions_total, league_opponent_samples_total,
+    # league_results_total, league_candidates, league_slots_assigned,
+    # league_promotions_total, league_matches_total,
+    # league_match_empty_total, league_bad_results_total,
+    # league_fanout_snapshots_total, league_fanout_errors_total.
+    "league_": "league population health (eval/league.py + dotaclient_tpu/league/)",
 }
 
 

@@ -1202,7 +1202,12 @@ def main(argv=None):
 
         broker = wrap_broker(broker, cfg.chaos)
     M = max(int(cfg.envs_per_process), 1)
-    if cfg.serve.endpoint:
+    # League-through-serve mode: opponent sessions step the serve tier's
+    # resident model slots (one --serve.models N server), matched by the
+    # standing league service — the SelfPlayActor branch below handles it
+    # (live side steps locally off the broker weight fan-out).
+    remote_league = cfg.opponent == "league" and bool(cfg.serve.league)
+    if cfg.serve.endpoint and not remote_league:
         # Centralized inference service mode (dotaclient_tpu/serve/):
         # featurized obs ship to the batching server, no local policy
         # step. Gated IMPORT (the chaos/ckpt precedent): with the
@@ -1210,9 +1215,14 @@ def main(argv=None):
         # path is byte-identical to the local build.
         if cfg.opponent in ("self", "league"):
             raise ValueError(
-                "--serve.endpoint does not support self/league actors: their "
-                "sessions step per-session param sets (league snapshots) the "
-                "shared-tree inference service cannot serve"
+                "--serve.endpoint does not serve mirror/league sessions "
+                "directly: live self-play sides step the training params. "
+                "League actors ARE supported through the multi-model serve "
+                "tier — run the server with --serve.models N, point this "
+                "actor at the league service with --serve.league "
+                "<host:port> (opponents then step serve-resident slots "
+                "via their matched --serve.model id); plain evaluation "
+                "fleets pin one slot with --serve.model <id>"
             )
         from dotaclient_tpu.serve.client import RemoteFleet
 

@@ -90,6 +90,16 @@ class _Side:
         self.handles: Optional[np.ndarray] = None
         self.last_hero: Optional[ws.Unit] = None
         self.episode_return = 0.0
+        # Remote-opponent session continuity (--serve.resume; the
+        # RemoteActor protocol, per opponent side): completed remote
+        # steps, the last OBSERVED chunk boundary (durably restorable —
+        # the server's write-ahead lands before the reply that vouches
+        # for it), the [1, H] boundary carry the resume handshake
+        # fingerprints, and the obs replay set since that boundary.
+        self.remote_steps = 0
+        self.remote_boundary = 0
+        self.remote_boundary_carry = None
+        self.remote_chunk_obs: list = []
 
 
 class SelfPlayActor:
@@ -137,8 +147,29 @@ class SelfPlayActor:
         from dotaclient_tpu.obs import ObsRuntime
 
         self.obs = ObsRuntime.create(cfg.obs, role=f"selfplay{actor_id}")
+        # Remote league mode (--serve.league <host:port> + --serve.endpoint):
+        # the standing league service owns the opponent pool — matches come
+        # from GET /match, opponent sessions step the serve tier's resident
+        # model slots, and results post back to the rating service. The
+        # LOCAL League pool is only built when this mode is off (the two
+        # pools must never compete for the same episodes).
+        serve_cfg = getattr(cfg, "serve", None)
+        self._league_endpoint = ""
+        if serve_cfg is not None and getattr(serve_cfg, "endpoint", ""):
+            self._league_endpoint = str(getattr(serve_cfg, "league", "") or "")
+        self._remote_clients: Dict[tuple, object] = {}
+        self._opp_remote = None  # this episode's RemotePolicyClient
+        self._opp_model = 0
+        self._opp_role = "main"
+        self.remote_matches = 0
+        self.remote_match_errors = 0
+        self.remote_results_posted = 0
+        self.remote_result_errors = 0
+        self.remote_fallbacks = 0  # episodes degraded to mirror mid-flight
+        self.remote_resumes = 0  # opponent sessions restored via the store
+        self.remote_replay_steps = 0  # FLAG_REPLAY steps issued on resume
         self.league: Optional[League] = None
-        if cfg.opponent == "league":
+        if cfg.opponent == "league" and not self._league_endpoint:
             self.league = League(
                 capacity=cfg.league_capacity,
                 snapshot_every=cfg.league_snapshot_every,
@@ -172,15 +203,244 @@ class SelfPlayActor:
 
     def _pick_opponent(self) -> None:
         """League: sample a frozen snapshot (falls back to mirror while the
-        pool is empty). Mirror: live weights both sides."""
+        pool is empty). Remote league: ask the standing service for a match
+        (falls back to mirror when the service is unreachable or the pool
+        empty). Mirror: live weights both sides."""
         self._opp_params = None
         self._opp_name = None
+        self._opp_remote = None
+        self._opp_model = 0
+        self._opp_role = "main"
+        if self._league_endpoint:
+            self._pick_remote_opponent()
+            return
         if self.league is None:
             return
         snap: Optional[Snapshot] = self.league.sample_opponent()
         if snap is not None:
             self._opp_params = unflatten_params(snap.named_params, self.params)
             self._opp_name = snap.name
+
+    def _pick_remote_opponent(self) -> None:
+        """GET /match off the league service → {model, name, serve, role}.
+        Any failure (service down, empty pool) degrades to mirror for this
+        episode — a league outage must never stall the env session. Plain
+        stdlib HTTP (the /topology precedent): matchmaking is a wire
+        contract, not a code dependency."""
+        import json as _json
+        from urllib.request import urlopen
+
+        try:
+            with urlopen(
+                f"http://{self._league_endpoint}/match", timeout=2.0
+            ) as resp:
+                match = _json.loads(resp.read().decode("utf-8", "replace"))
+        except Exception:
+            self.remote_match_errors += 1
+            return
+        name = match.get("name")
+        if not name:
+            return  # empty pool: mirror this episode
+        self.remote_matches += 1
+        self._opp_name = str(name)
+        self._opp_model = int(match.get("model", 0))
+        self._opp_role = str(match.get("role", "main"))
+        endpoint = str(match.get("serve") or self.cfg.serve.endpoint)
+        self._opp_remote = self._remote_client(endpoint, self._opp_model)
+
+    def _remote_client(self, endpoint: str, model: int):
+        """One connection per (endpoint, model slot), cached for the
+        process lifetime: the model id binds at the S_INFO handshake, so
+        different opponents on the same server still need distinct
+        sockets. Gated import (the chaos/ckpt precedent)."""
+        key = (endpoint, model)
+        cli = self._remote_clients.get(key)
+        if cli is None:
+            from dotaclient_tpu.serve.client import RemotePolicyClient
+            from dotaclient_tpu.transport.base import RetryPolicy
+
+            cfg = self.cfg
+            cli = RemotePolicyClient(
+                endpoint,
+                cfg.policy,
+                wire_obs_dtype=getattr(getattr(cfg, "wire", None), "obs_dtype", "f32"),
+                timeout_s=cfg.serve.timeout_s,
+                connect_timeout_s=cfg.serve.connect_timeout_s,
+                cooldown_s=cfg.serve.cooldown_s,
+                retry=RetryPolicy.from_config(cfg.retry),
+                route=cfg.serve.route,
+                model=model,
+            )
+            self._remote_clients[key] = cli
+        return cli
+
+    async def _remote_opp_step(self, group: list, episode_start: bool) -> bool:
+        """One serve-tier step per opponent hero (concurrent, one socket —
+        the server gathers them into its per-model tick batch). With
+        `--serve.resume` armed, a replica loss mid-episode re-establishes
+        each side's session on the reborn server — store-backed boundary
+        restore keyed by (client_key, model_id) plus FLAG_REPLAY of the
+        partial chunk, the RemoteActor choreography — before this method
+        reports failure. Returns False only on unrecoverable remote
+        failure (resume disarmed, refused, or window exhausted): the
+        episode then degrades to mirror (a zero-carry mirror finish
+        beats abandoning the env session)."""
+        from dotaclient_tpu.serve.client import RemoteInferenceError
+
+        cli = self._opp_remote
+        resume_armed = bool(getattr(self.cfg.serve, "resume", False))
+        rollout_len = max(1, int(self.cfg.rollout_len))
+
+        async def one(s: _Side) -> None:
+            # Boundary cadence mirrors the chunk protocol: the carry
+            # rides the reply on chunk-fill steps, and the server's
+            # write-ahead makes exactly those boundaries restorable.
+            want_carry = resume_armed and (s.remote_steps + 1) % rollout_len == 0
+            try:
+                res = await cli.step(
+                    s.remote_key,
+                    s.obs,
+                    s.remote_rng,
+                    episode_start=episode_start,
+                    want_carry=want_carry,
+                )
+            except RemoteInferenceError as e:
+                if not resume_armed:
+                    raise
+                res = await self._resume_opp_side(
+                    cli, s, episode_start, want_carry, e
+                )
+                self.remote_resumes += 1
+            if resume_armed:
+                s.remote_steps += 1
+                if want_carry and res.carry is not None:
+                    c, h = res.carry
+                    s.remote_boundary = s.remote_steps
+                    s.remote_boundary_carry = (
+                        np.ascontiguousarray(c, np.float32)[None],
+                        np.ascontiguousarray(h, np.float32)[None],
+                    )
+                    s.remote_chunk_obs = []
+                else:
+                    s.remote_chunk_obs.append(s.obs)
+            s.remote_rng = res.rng
+            a = res.action
+            action = ad.Action(
+                type=np.asarray([a[0]], np.int32),
+                move_x=np.asarray([a[1]], np.int32),
+                move_y=np.asarray([a[2]], np.int32),
+                target=np.asarray([a[3]], np.int32),
+            )
+            s._step_record = (action, float(res.logp), float(res.value))
+            s._action_h, s._batch_index = action, 0
+
+        try:
+            await asyncio.gather(*(one(s) for s in group))
+            return True
+        except (RemoteInferenceError, RuntimeError) as e:
+            _log.warning(
+                "selfplay actor %d: remote opponent %s lost (%s); finishing "
+                "episode as mirror",
+                self.actor_id,
+                self._opp_name,
+                type(e).__name__,
+            )
+            return False
+
+    async def _resume_opp_side(
+        self, cli, s: _Side, episode_start: bool, want_carry: bool, first_err
+    ):
+        """One opponent side's resume-and-retry (the RemoteActor
+        _resume_and_retry choreography, per side): reconnect, S_RESUME
+        the boundary carry — the store key composes (client_key,
+        model_id) server-side, so sibling slots on the same server never
+        cross — replay the buffered partial-chunk obs (outputs
+        discarded; the carry update is rng-independent), then re-issue
+        the failed step for real. A SessionResumeRefused is
+        authoritative (store miss/stale) and propagates — the caller's
+        mirror-degrade path takes over; transport failures retry with
+        backoff until `--serve.resume_window_s` runs out."""
+        from dotaclient_tpu.serve.client import (
+            RemoteInferenceError,
+            SessionResumeRefused,
+        )
+
+        deadline = time.monotonic() + float(self.cfg.serve.resume_window_s)
+        backoff = 0.05
+        err = first_err
+        while True:
+            if getattr(cli, "_closed", False):
+                raise err  # teardown, not an outage: fail fast
+            try:
+                if s.remote_boundary > 0:
+                    from dotaclient_tpu.serve.handoff import carry_fingerprint
+
+                    fp = carry_fingerprint(
+                        s.remote_boundary_carry[0], s.remote_boundary_carry[1]
+                    )
+                    await cli.resume(s.remote_key, s.remote_boundary, fp)
+                for i, o in enumerate(s.remote_chunk_obs):
+                    await cli.step(
+                        s.remote_key,
+                        o,
+                        s.remote_rng,
+                        episode_start=(s.remote_boundary == 0 and i == 0),
+                        replay=True,
+                    )
+                    self.remote_replay_steps += 1
+                res = await cli.step(
+                    s.remote_key,
+                    s.obs,
+                    s.remote_rng,
+                    episode_start=episode_start,
+                    want_carry=want_carry,
+                )
+            except SessionResumeRefused:
+                raise
+            except RemoteInferenceError as e:
+                err = e
+                now = time.monotonic()
+                if now >= deadline:
+                    raise err
+                await asyncio.sleep(min(backoff, max(0.0, deadline - now)))
+                backoff = min(backoff * 2.0, 1.0)
+                continue
+            _log.info(
+                "selfplay actor %d: opponent %s session %d RESUMED at "
+                "boundary %d (+%d replayed steps)",
+                self.actor_id,
+                self._opp_name,
+                s.remote_key,
+                s.remote_boundary,
+                len(s.remote_chunk_obs),
+            )
+            return res
+
+    def _post_result(self) -> None:
+        """POST the finished match to the league rating service. The live
+        side is the canonical AGENT name (eval/league.py); failure only
+        counts — ratings tolerate a lost game, the env session must not."""
+        import json as _json
+        from urllib.request import Request, urlopen
+
+        win = self.last_win
+        if win is None or self._opp_name is None:
+            return
+        body = {"winner": "agent", "loser": self._opp_name, "draw": win == 0.0}
+        if win < 0:
+            body["winner"], body["loser"] = body["loser"], body["winner"]
+        try:
+            req = Request(
+                f"http://{self._league_endpoint}/result",
+                data=_json.dumps(body).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urlopen(req, timeout=2.0) as resp:
+                resp.read()
+            self.remote_results_posted += 1
+        except Exception:
+            self.remote_result_errors += 1
 
     def _publish(self, side: _Side, win: float, done: bool) -> None:
         rollout = side.chunk.to_rollout(
@@ -243,7 +503,8 @@ class SelfPlayActor:
         cfg = self.cfg
         self.last_win = None
         self._pick_opponent()
-        mirror = self._opp_params is None  # also league-mode fallback
+        # also league-mode fallback (empty pool / service unreachable)
+        mirror = self._opp_params is None and self._opp_remote is None
         pool = heroes.parse_pool(cfg.hero)
         n = max(1, min(int(getattr(cfg, "team_size", 1)), 5))
         rad_pids = [RADIANT_PLAYER + i for i in range(n)]
@@ -282,15 +543,40 @@ class SelfPlayActor:
         for s in sides.values():
             s.world = rad_world if s.team_id == TEAM_RADIANT else dire_world
             s.obs, s.handles = F.featurize_with_handles(s.world, s.player_id)
+        if self._opp_remote is not None:
+            # Serve-tier sessions for the opponent heroes: client_key is
+            # (actor, player) — stable across the fleet — and the model id
+            # composes in server-side (compose_store_key), so per-opponent
+            # resume state never collides across slots.
+            for s in opp_team:
+                s.remote_key = self.actor_id * 100 + s.player_id
+                s.remote_rng = np.asarray(
+                    self.np_rng.randint(0, 1 << 31, size=2), np.uint32
+                )
 
         done = False
+        first_tick = True
         while not done:
             if mirror:
                 # every controlled hero, both teams, one compiled call
                 self._batched_step(self.params, live_team + opp_team)
+            elif self._opp_remote is not None:
+                self._batched_step(self.params, live_team)
+                ok = await self._remote_opp_step(opp_team, episode_start=first_tick)
+                if not ok:
+                    # Degrade: the rest of the episode is a mirror for the
+                    # opponent team (zero-ish carry restart from whatever
+                    # local state the sides hold — a quality dip, not an
+                    # abandon). Result will NOT post (_opp_name cleared):
+                    # a half-remote game must not move ratings.
+                    self.remote_fallbacks += 1
+                    self._opp_remote = None
+                    self._opp_name = None
+                    self._batched_step(self.params, opp_team)
             else:
                 self._batched_step(self.params, live_team)
                 self._batched_step(self._opp_params, opp_team)
+            first_tick = False
 
             actions: Dict[int, ds.Action] = {}
             for s in sides.values():
@@ -368,6 +654,8 @@ class SelfPlayActor:
 
         if self.league is not None and self._opp_name is not None and self.last_win is not None:
             self.league.record_result(self._opp_name, self.last_win)
+        elif self._league_endpoint and self._opp_name is not None:
+            self._post_result()
         self.episodes_done += 1
         return live.episode_return
 

@@ -159,6 +159,7 @@ class RemotePolicyClient:
         cooldown_s: float = 5.0,
         retry: Optional[RetryPolicy] = None,
         route: str = "order",
+        model: int = 0,
     ):
         # Discovery mode (--serve.endpoint control:<host:port>): the
         # endpoint list starts empty and is fetched/refreshed from the
@@ -180,6 +181,13 @@ class RemotePolicyClient:
         self._route = route
         self.route_probes = 0
         self.route_picks = 0
+        # Model binding (--serve.model): which resident param slot this
+        # connection's sessions step against. 0 sends an EMPTY S_INFO
+        # payload — byte-identical to the single-model wire — so legacy
+        # servers never see the field at all (DTR1/DTR2 inertness).
+        self.model = int(model)
+        if not (0 <= self.model <= W.MAX_MODEL_ID):
+            raise ValueError(f"serve model id {model} out of range")
         self.lstm_hidden = int(policy_cfg.lstm_hidden)
         if wire_obs_dtype in ("f32", "float32"):
             self._obs_bf16 = False
@@ -386,8 +394,11 @@ class RemotePolicyClient:
                 try:
                     # Handshake BEFORE the demux loop starts (sequential
                     # read): the server must agree on the carry width or
-                    # every response would deframe wrong.
-                    writer.write(W.frame(W.S_INFO, b""))
+                    # every response would deframe wrong. The model id
+                    # rides this handshake (empty payload ≡ model 0) and
+                    # binds the CONNECTION — step frames stay
+                    # byte-identical at every model id.
+                    writer.write(W.frame(W.S_INFO, W.encode_info_request(self.model)))
                     await writer.drain()
                     mtype, payload = await asyncio.wait_for(
                         W.read_frame(reader), self.connect_timeout_s
@@ -499,6 +510,19 @@ class RemotePolicyClient:
             raise ValueError(
                 f"inference server policy mismatch: server {info}, client "
                 f"expects lstm_hidden={self.lstm_hidden}"
+            )
+        # Model binding refusal: an out-of-range --serve.model is a
+        # CONFIG error (wrong server sizing), not an outage — same
+        # fail-loudly-don't-rotate contract as a policy mismatch.
+        if info.get("model_error"):
+            raise ValueError(
+                f"inference server refused model {self.model}: "
+                f"{info['model_error']}"
+            )
+        if self.model and info.get("model") != self.model:
+            raise ValueError(
+                f"inference server bound model {info.get('model')}, client "
+                f"requested {self.model} (pre-multi-model server?)"
             )
         self.server_info = info
 
@@ -720,6 +744,7 @@ def _client_from_cfg(cfg: ActorConfig) -> RemotePolicyClient:
         cooldown_s=cfg.serve.cooldown_s,
         retry=RetryPolicy.from_config(cfg.retry),
         route=cfg.serve.route,
+        model=cfg.serve.model,
     )
 
 

@@ -86,13 +86,17 @@ class _ClientConn:
     owns and the write lock serializing interleaved responses. `steps`
     tracks each resident carry's episode position (completed steps;
     reset by EPISODE_START, installed by a session resume) — the
-    episode_step the handoff store entries are stamped with."""
+    episode_step the handoff store entries are stamped with. `model` is
+    the serve slot the S_INFO handshake bound this connection to (0 =
+    the live tree — the only value a legacy client can produce, since
+    it sends the empty payload)."""
 
     def __init__(self, writer: asyncio.StreamWriter):
         self.writer = writer
         self.lock = asyncio.Lock()
         self.carries: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         self.steps: Dict[int, int] = {}
+        self.model = 0
 
     async def send(self, mtype: int, payload: bytes) -> None:
         try:
@@ -139,11 +143,46 @@ class InferenceServer:
         # (racecheck surfaced the write-write race on params/version/
         # _bundle/weight_swaps_total; graftcheck PR).
         self._swap_lock = threading.Lock()
-        self._bundle: Tuple[object, int] = (self.params, self.version)
+        # Multi-model serve (--serve.models N): slot 0 is the live
+        # hot-swapped tree (the only slot at N=1 — byte-identical to
+        # the single-model server); slots 1..N-1 hold FROZEN trees
+        # (league opponents) installed via swap_model()/the league
+        # sync loop. Each slot is its own (params, version) hot-swap
+        # cell read once per tick by its own batcher, so the
+        # no-mixed-tick invariant holds PER MODEL.
+        self.models = max(1, int(cfg.serve.models))
+        self._bundles: list = [(self.params, self.version)]
+        for _ in range(1, self.models):
+            # frozen slots boot from the same seed init as slot 0 — the
+            # deterministic boot convention; a sync/swap replaces them
+            self._bundles.append((self.params, 0))
         # Batcher cfg: the serve knobs mapped onto the ActorConfig shape
         # InferenceBatcher speaks (gather window + policy).
         bcfg = ActorConfig(policy=cfg.policy, gather_window_s=cfg.serve.gather_window_s)
-        self.batcher = _ServeBatcher(bcfg, lambda: self._bundle, capacity=cfg.serve.max_batch)
+        self.batchers = [
+            _ServeBatcher(
+                bcfg, (lambda m=m: self._bundles[m]), capacity=cfg.serve.max_batch
+            )
+            for m in range(self.models)
+        ]
+        # ONE jit signature per arch across all models: every batcher
+        # shares slot 0's compiled step (identical shapes/signature —
+        # only the params argument differs per tick), so N models never
+        # multiply compiles or the _warm() wall.
+        for b in self.batchers[1:]:
+            b._step = self.batchers[0]._step
+        # Per-model ledgers (requests served / carries evicted / trees
+        # swapped per slot) — flat int lists so the chaos controller's
+        # getattr harvest and the soak's exactness cross-checks read
+        # them like every other counter.
+        self.model_requests = [0] * self.models
+        self.model_evictions = [0] * self.models
+        self.model_swaps = [0] * self.models
+        self.league_syncs_total = 0
+        self.league_sync_errors_total = 0
+        self._synced: Dict[int, Tuple[str, int]] = {}  # slot → installed (name, version)
+        self._stop_sync = threading.Event()
+        self._sync_thread: Optional[threading.Thread] = None
         # Loop-thread-written counters; stats() takes GIL-atomic single
         # reads (the BrokerServer ledger pattern — exact after stop()).
         # first_request_t is the recovery probe (the broker
@@ -205,6 +244,17 @@ class InferenceServer:
 
     # ------------------------------------------------------------ weights
 
+    @property
+    def _bundle(self) -> Tuple[object, int]:
+        """Slot 0's hot-swap cell — the single-model server's one cell,
+        kept as the canonical read for stats/info/harness code."""
+        return self._bundles[0]
+
+    @property
+    def batcher(self) -> "_ServeBatcher":
+        """Slot 0's batcher (the single-model server's only batcher)."""
+        return self.batchers[0]
+
     def swap_params(self, named_or_params, version: int) -> None:
         """Swap the serving tree directly (in-process publisher hook,
         tests). `named_or_params` is either a (name, array) list (the
@@ -222,7 +272,31 @@ class InferenceServer:
             self.params = params
             self.version = int(version)
             self.weight_swaps_total += 1
-            self._bundle = (params, int(version))
+            self.model_swaps[0] += 1
+            self._bundles[0] = (params, int(version))
+
+    def swap_model(self, model_id: int, named_or_params, version: int) -> None:
+        """Install a FROZEN tree into serve slot `model_id` (league
+        opponents; the league sync loop and in-process harnesses call
+        this). Slot 0 routes through swap_params so the live tree keeps
+        its apply_weight_frame bookkeeping."""
+        m = int(model_id)
+        if m == 0:
+            self.swap_params(named_or_params, version)
+            return
+        if not 0 < m < self.models:
+            raise ValueError(
+                f"model id {m} not resident (--serve.models {self.models})"
+            )
+        if isinstance(named_or_params, list):
+            from dotaclient_tpu.transport.serialize import unflatten_params
+
+            params = unflatten_params(named_or_params, self.params)
+        else:
+            params = named_or_params
+        with self._swap_lock:
+            self.model_swaps[m] += 1
+            self._bundles[m] = (params, int(version))
 
     def poke(self) -> None:
         """Wake the weight-poll thread now (WeightPublisher on_published
@@ -253,7 +327,55 @@ class InferenceServer:
                     # apply_weight_frame mutated params/version; publish
                     # them as one tuple for the tick reader.
                     self.weight_swaps_total += 1
-                    self._bundle = (self.params, self.version)
+                    self.model_swaps[0] += 1
+                    self._bundles[0] = (self.params, self.version)
+
+    def _league_sync_once(self) -> None:
+        """One assignments poll against the league service: fetch the
+        slot map, install any slot whose (name, version) changed. Plain
+        stdlib HTTP (the discovery-client rule: the serve tier never
+        imports dotaclient_tpu.league — the sync is a wire contract)."""
+        import base64
+        import urllib.request
+
+        ep = str(self.cfg.serve.league_endpoint)
+        timeout = max(1.0, float(self.cfg.serve.league_sync_s))
+        with urllib.request.urlopen(
+            f"http://{ep}/assignments", timeout=timeout
+        ) as resp:
+            body = json.loads(resp.read().decode("utf-8", "replace"))
+        for slot_s, rec in (body.get("assignments") or {}).items():
+            m = int(slot_s)
+            if not 0 < m < self.models:
+                continue  # a bigger league than this server holds slots for
+            want = (str(rec.get("name", "")), int(rec.get("version", 0)))
+            if self._synced.get(m) == want:
+                continue
+            with urllib.request.urlopen(
+                f"http://{ep}/snapshot?name={want[0]}", timeout=timeout
+            ) as resp:
+                snap = json.loads(resp.read().decode("utf-8", "replace"))
+            named = [
+                (
+                    str(name),
+                    np.frombuffer(
+                        base64.b64decode(arr["b64"]), dtype=np.dtype(arr["dtype"])
+                    ).reshape(arr["shape"]),
+                )
+                for name, arr in (snap.get("params") or {}).items()
+            ]
+            self.swap_model(m, named, int(snap.get("version", want[1])))
+            self._synced[m] = want
+            self.league_syncs_total += 1
+            _log.info("serve: league sync installed %s v%d into slot %d", want[0], want[1], m)
+
+    def _league_sync_loop(self) -> None:
+        while not self._stop_sync.wait(float(self.cfg.serve.league_sync_s)):
+            try:
+                self._league_sync_once()
+            except Exception as e:  # league outage: keep serving current slots
+                self.league_sync_errors_total += 1
+                _log.warning("serve: league sync failed (%s); retrying", e)
 
     # ------------------------------------------------------------- serving
 
@@ -292,6 +414,7 @@ class InferenceServer:
             )
             return
         self.requests_total += 1
+        self.model_requests[conn.model] += 1
         if req.replay:
             self.replayed_steps_total += 1
         if req.episode_start:
@@ -308,7 +431,7 @@ class InferenceServer:
                     ),
                 )
                 return
-        row, version, tick = await self.batcher.step(
+        row, version, tick = await self.batchers[conn.model].step(
             state, self._canon_obs(req.obs), req.rng
         )
         if self.first_request_t is None:
@@ -329,8 +452,16 @@ class InferenceServer:
                 # store failure degrades, it never stops serving: the
                 # session falls back to PR-10 abandon-on-failover.
                 try:
+                    # Store keys compose (client_key, model_id): a
+                    # fleet's per-opponent sessions never alias in the
+                    # shared store, and model 0 composes to the bare
+                    # key — PR-13 store contents bit-for-bit.
                     await self._store.put(
-                        req.client_key, ep_step, version, carry[0], carry[1]
+                        W.compose_store_key(req.client_key, conn.model),
+                        ep_step,
+                        version,
+                        carry[0],
+                        carry[1],
                     )
                     self.handoff_writes_total += 1
                 except Exception as e:
@@ -388,7 +519,9 @@ class InferenceServer:
         entry = None
         if self._store is not None:
             try:
-                _, entry = await self._store.get(req.client_key, req.boundary_step)
+                _, entry = await self._store.get(
+                    W.compose_store_key(req.client_key, conn.model), req.boundary_step
+                )
             except Exception as e:
                 self.handoff_write_errors_total += 1
                 _log.warning("serve: carry handoff read failed: %s", e)
@@ -464,7 +597,29 @@ class InferenceServer:
                 elif mtype == W.S_STATS:
                     await conn.send(W.R_STATS, json.dumps(self.stats()).encode())
                 elif mtype == W.S_INFO:
-                    await conn.send(W.R_INFO, json.dumps(self.info()).encode())
+                    # Session establishment: an optional model id binds
+                    # the CONNECTION to a frozen serve slot (empty
+                    # payload = slot 0 = the legacy handshake,
+                    # byte-identical). Handled inline before any step
+                    # task can spawn — the client awaits R_INFO before
+                    # sending steps, so the binding is race-free.
+                    info = self.info()
+                    try:
+                        model = W.decode_info_request(payload)
+                    except ValueError as e:
+                        self.bad_requests_total += 1
+                        info["model_error"] = str(e)
+                        model = None
+                    if model is not None:
+                        if 0 <= model < self.models:
+                            conn.model = model
+                        else:
+                            info["model_error"] = (
+                                f"model {model} not resident "
+                                f"(--serve.models {self.models})"
+                            )
+                    info["model"] = conn.model
+                    await conn.send(W.R_INFO, json.dumps(info).encode())
                 else:
                     raise ValueError(f"unknown message type {mtype:#x}")
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
@@ -473,6 +628,7 @@ class InferenceServer:
             pass
         finally:
             self.evictions_total += len(conn.carries)
+            self.model_evictions[conn.model] += len(conn.carries)
             conn.carries.clear()
             conn.steps.clear()
             self._conns.discard(conn)
@@ -483,7 +639,7 @@ class InferenceServer:
     # ----------------------------------------------------------- lifecycle
 
     async def _main(self):
-        driver = asyncio.ensure_future(self.batcher.run())
+        drivers = [asyncio.ensure_future(b.run()) for b in self.batchers]
         self._stop_ev = asyncio.Event()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         if self.port == 0:
@@ -491,10 +647,11 @@ class InferenceServer:
         self._started.set()
         await self._stop_ev.wait()
         # Teardown order (the BrokerServer shutdown dance): stop
-        # accepting, fail the batcher's pending futures, cancel handler
+        # accepting, fail the batchers' pending futures, cancel handler
         # tasks, abort transports so close is immediate.
         self._server.close()
-        self.batcher.stop()
+        for b in self.batchers:
+            b.stop()
         me = asyncio.current_task()
         handlers = [t for t in asyncio.all_tasks() if t is not me]
         for t in handlers:
@@ -504,8 +661,9 @@ class InferenceServer:
         if handlers:
             await asyncio.gather(*handlers, return_exceptions=True)
         await self._server.wait_closed()
-        driver.cancel()
-        await asyncio.gather(driver, return_exceptions=True)
+        for d in drivers:
+            d.cancel()
+        await asyncio.gather(*drivers, return_exceptions=True)
         if self._store is not None:
             try:
                 await self._store.close()
@@ -562,12 +720,18 @@ class InferenceServer:
                 target=self._poll_weights_loop, daemon=True, name="serve-weights"
             )
             self._poll_thread.start()
+        if self.models > 1 and str(self.cfg.serve.league_endpoint):
+            self._sync_thread = threading.Thread(
+                target=self._league_sync_loop, daemon=True, name="serve-league-sync"
+            )
+            self._sync_thread.start()
         if self.obs is not None:
             self.obs.serve_metrics([self.stats], health_provider=self._health)
         return self
 
     def stop(self) -> None:
         self._stop_poll.set()
+        self._stop_sync.set()
         self._poke.set()
         loop = self._loop
         if loop is not None and not loop.is_closed():
@@ -579,13 +743,23 @@ class InferenceServer:
             self._thread.join(timeout=10)
         if self._poll_thread:
             self._poll_thread.join(timeout=5)
+        if self._sync_thread:
+            self._sync_thread.join(timeout=5)
         if self.obs is not None:
             self.obs.close()
 
     # ------------------------------------------------------------- surface
 
     def stats(self) -> dict:
+        # The actor_* batcher family aggregates across model slots (one
+        # scrape surface, N tick streams); slot 0 alone at models=1 is
+        # exactly the single-model stats.
         out = dict(self.batcher.stats())
+        if self.models > 1:
+            for b in self.batchers[1:]:
+                for k, v in b.stats().items():
+                    if isinstance(v, (int, float)):
+                        out[k] = out.get(k, 0.0) + v
         out.update(
             {
                 "serve_requests_total": float(self.requests_total),
@@ -620,6 +794,24 @@ class InferenceServer:
                 "serve_load_capacity": float(load["capacity"]),
             }
         )
+        # Multi-model tier (serve_model_* prefix family): per-slot
+        # request/swap/eviction ledgers and the resident version, plus
+        # league-sync counters. At --serve.models 1 only the resident
+        # gauge and the two sync counters appear (all zero) — the
+        # single-model scrape surface is otherwise unchanged.
+        out["serve_models_resident"] = float(self.models)
+        out["serve_league_syncs_total"] = float(self.league_syncs_total)
+        out["serve_league_sync_errors_total"] = float(self.league_sync_errors_total)
+        if self.models > 1:
+            # Under the swap lock: the league sync thread mutates the
+            # per-slot ledgers and bundle cells in place — a torn read
+            # here would pair a slot's new version with its old counters.
+            with self._swap_lock:
+                for m in range(self.models):
+                    out[f"serve_model_requests_total_{m}"] = float(self.model_requests[m])
+                    out[f"serve_model_swaps_total_{m}"] = float(self.model_swaps[m])
+                    out[f"serve_model_evictions_total_{m}"] = float(self.model_evictions[m])
+                    out[f"serve_model_version_{m}"] = float(self._bundles[m][1])
         return out
 
     def load(self) -> dict:
@@ -647,6 +839,7 @@ class InferenceServer:
             "max_batch": self.cfg.serve.max_batch,
             "gather_window_s": self.cfg.serve.gather_window_s,
             "version": self._bundle[1],
+            "models": self.models,
             "load": self.load(),
         }
 

@@ -5,8 +5,23 @@ Framing is the tcp broker's (transport/tcp.py): every message is
 
   0x01 S_STEP   one policy-step request            → 0x81 R_STEP
   0x02 S_STATS  no payload                         → 0x82 R_STATS (JSON)
-  0x03 S_INFO   no payload                         → 0x83 R_INFO  (JSON)
+  0x03 S_INFO   session establishment (see below)  → 0x83 R_INFO  (JSON)
   0x04 S_RESUME session-continuity handshake       → 0x84 R_RESUME
+
+S_INFO payload (session establishment / model selection):
+  EMPTY (the PR-9..PR-13 handshake)  — the connection serves MODEL 0,
+         the live hot-swapped tree. Byte-identical to every frame the
+         protocol ever sent: absent field = legacy behavior, the
+         DTR1/DTR2 inertness discipline.
+  u32    model_id (optional)         — binds ALL of this connection's
+         sessions to the frozen param tree resident in serve slot
+         `model_id` (a league opponent; slot 0 stays the live tree).
+         Out-of-range ids are answered with a "model_error" key in the
+         R_INFO JSON — a config error the client raises on, never a
+         retryable outage. The S_STEP/R_STEP frames themselves never
+         carry the model id: the connection is the binding (server-side
+         carry residency already demands connection affinity), so step
+         traffic stays byte-identical at every model id.
 
 S_STEP payload:
   u64    client_key  — names this client's server-resident LSTM carry.
@@ -138,6 +153,16 @@ MAX_FRAME = 16 * 1024 * 1024  # a step request/reply is a few KB; 16M is "insane
 _REQ_HEAD = struct.Struct("<QBB8s")
 _RESP_HEAD = struct.Struct("<QB")
 _RESP_BODY = struct.Struct("<IQ8s4iffB")
+_INFO_REQ = struct.Struct("<I")
+
+# (client_key, model_id) composition for the handoff store's u64 key
+# space: client keys (the actor_id scheme) live in the low 48 bits,
+# the model id in the high 16 — so per-model sessions never alias in
+# the shared store and model 0 composes to the BARE client key, keeping
+# single-model store contents bit-identical to the PR-13 layout.
+MODEL_KEY_SHIFT = 48
+MAX_CLIENT_KEY = (1 << MODEL_KEY_SHIFT) - 1
+MAX_MODEL_ID = (1 << (64 - MODEL_KEY_SHIFT)) - 1
 
 
 class StepRequest(NamedTuple):
@@ -177,6 +202,40 @@ class StepResponse(NamedTuple):
 
 def frame(mtype: int, payload: bytes) -> bytes:
     return _LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload
+
+
+def encode_info_request(model_id: int = 0) -> bytes:
+    """S_INFO payload. Model 0 encodes to the EMPTY payload — the exact
+    bytes every pre-multi-model client ever sent (absent field = model
+    0, the inertness rule); any other id is one u32."""
+    if model_id == 0:
+        return b""
+    if not 0 <= model_id <= MAX_MODEL_ID:
+        raise ValueError(f"model id {model_id} out of range [0, {MAX_MODEL_ID}]")
+    return _INFO_REQ.pack(model_id)
+
+
+def decode_info_request(payload: bytes) -> int:
+    """Model id from an S_INFO payload (empty = 0)."""
+    if not payload:
+        return 0
+    if len(payload) != _INFO_REQ.size:
+        raise ValueError(f"info request size {len(payload)} != {_INFO_REQ.size}")
+    return _INFO_REQ.unpack(payload)[0]
+
+
+def compose_store_key(client_key: int, model_id: int) -> int:
+    """(client_key, model_id) → the handoff store's u64 key. Model 0 is
+    the identity (store contents bit-identical to PR-13); loud refusal
+    on keys that would collide across the bit split."""
+    if not 0 <= client_key <= MAX_CLIENT_KEY:
+        raise ValueError(
+            f"client_key {client_key} exceeds {MODEL_KEY_SHIFT} bits — cannot "
+            f"compose a per-model store key"
+        )
+    if not 0 <= model_id <= MAX_MODEL_ID:
+        raise ValueError(f"model id {model_id} out of range [0, {MAX_MODEL_ID}]")
+    return (model_id << MODEL_KEY_SHIFT) | client_key
 
 
 def encode_step_request(

@@ -1,0 +1,430 @@
+"""League-through-serve soak: the standing-population closed loop →
+LEAGUE_SOAK.json.
+
+The ISSUE-17 acceptance run: a 3-opponent league (two frozen mains + one
+gated exploiter) served from ONE multi-model inference server
+(`--serve.models 4`; slot 0 stays the live tree and is never stepped
+remotely), matched to a 3-actor self-play fleet by the standing league
+service, while a `rolling@T:P@server` schedule kills the serve tier
+mid-stream. The bars:
+
+- ZERO abandoned episodes: every interrupted opponent session resumes on
+  the reborn server from the shared carry store — entries keyed by
+  compose_store_key(client_key, model_id), so sibling slots on one
+  server never cross — with FLAG_REPLAY rebuilding the partial chunk
+  (runtime/selfplay.py `_resume_opp_side`). `remote_fallbacks` (episode
+  degraded to mirror) must be ZERO, not merely "no crash".
+- EXACT per-model ledgers across server lives: slot 0 requests == 0
+  (the live side steps locally — league-through-serve keeps the planes
+  apart), per-slot request counts partition the aggregate in EVERY
+  life, evictions partition, and every life's league sync installed all
+  three assigned slots (model swaps).
+- ≥1 exploiter PROMOTED through the matchmaking policy: the exploiter
+  clause seeds the candidate's gate games (its [wins, games] ledger
+  moves only via matchmade /result posts), and the gate promotes it
+  into the pool mid-soak. The gate is tuned to promote on games, not
+  winrate (gate_winrate=0) — the toy env's win distribution is
+  arbitrary, and the claim under test is the matchmaking→gate→promote
+  loop, not hero balance.
+- Leaderboard BIT-FOR-BIT from the match log: a fresh LeagueService
+  booted on the registry dir must reproduce every rating (mu, sigma,
+  games), every exploiter gate, and results_total EXACTLY — float
+  equality, no tolerance — by replaying matches.jsonl (admissions ride
+  the same log with their inherited ratings frozen in).
+
+Run: python scripts/soak_league.py                      # committed artifact
+     python scripts/soak_league.py --quick --out /tmp/x # nightly wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _tiny_policy():
+    from dotaclient_tpu.config import PolicyConfig
+
+    return PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+class _PacedStub:
+    """Env stub wrapper adding a fixed wall delay per observe() — it
+    stretches episodes over wall time so the rolling restart lands
+    MID-EPISODE (the resume-interesting case) on any host speed."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self._delay = delay_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def observe(self, req):
+        await asyncio.sleep(self._delay)
+        return await self._inner.observe(req)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="LEAGUE_SOAK.json")
+    p.add_argument("--actors", type=int, default=3)
+    p.add_argument("--episodes-per-actor", type=int, default=6)
+    # Offsets land the kills MID-EPISODE past a chunk boundary (episodes
+    # run ~0.4-0.6s wall under the paced stub; boundaries every 2 steps):
+    # the interesting resume is the store-backed one, and a kill in the
+    # first chunk would only ever exercise the episode-start replay path.
+    p.add_argument("--rolling", default="rolling@0.35:0.7@server,rolling@3.17:0.7@server")
+    p.add_argument("--quick", action="store_true",
+                   help="nightly-wrapper scale: fewer episodes, one rolling event, same invariants")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.episodes_per_actor = 3
+        args.rolling = "rolling@0.35:0.7@server"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dotaclient_tpu.chaos import FaultSchedule, ScheduleRunner, ServeIncarnations
+    from dotaclient_tpu.config import (
+        ActorConfig,
+        InferenceConfig,
+        LeagueConfig,
+        LeagueServiceConfig,
+        RetryConfig,
+        ServeClientConfig,
+        ServeConfig,
+    )
+    from dotaclient_tpu.env.fake_dotaservice import FakeDotaService
+    from dotaclient_tpu.env.service import serve as env_serve
+    from dotaclient_tpu.league.client import LeagueClient
+    from dotaclient_tpu.league.server import LeagueService
+    from dotaclient_tpu.models.policy import init_params
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+    from dotaclient_tpu.runtime.selfplay import SelfPlayActor
+    from dotaclient_tpu.serve.handoff import CarryStoreServer
+    from dotaclient_tpu.serve.server import InferenceServer
+    from dotaclient_tpu.transport import memory as mem
+    from dotaclient_tpu.transport.base import connect
+    from dotaclient_tpu.transport.serialize import flatten_params
+
+    policy = _tiny_policy()
+    reg_dir = tempfile.mkdtemp(prefix="league_soak_registry_")
+    MODELS, SLOTS, GATE_GAMES = 4, 3, 2
+
+    artifact = {
+        "host": (
+            "single host: one in-process multi-model serve tier (4 slots) under "
+            "rolling restart, real-TCP carry store, standing league service "
+            "(HTTP), 3 self-play actors with remote league opponents"
+        ),
+        "host_preflight": preflight_check("soak_league"),
+        "actors": args.actors,
+        "episodes_per_actor": args.episodes_per_actor,
+        "rolling_spec": args.rolling,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "gate_disclosure": (
+            "gate_winrate=0 on purpose: promotion fires on gate GAMES (each one "
+            "a matchmade exploiter-vs-main result), so the verdict tests the "
+            "matchmaking->gate->promote loop, not the toy env's win distribution"
+        ),
+    }
+
+    # ---------------- the standing population --------------------------
+    svc = LeagueService(
+        LeagueConfig(
+            league=LeagueServiceConfig(
+                port=0,
+                dir=reg_dir,
+                capacity=8,
+                slots=SLOTS,
+                policy="prioritized@0.4;uniform@0.2;exploiter@0.4",
+                gate_games=GATE_GAMES,
+                gate_winrate=0.0,
+                seed=0,
+            )
+        )
+    ).start()
+    league_ep = f"127.0.0.1:{svc.port}"
+    lc = LeagueClient(league_ep)
+    lc.register("main-v100", 100, flatten_params(init_params(policy, jax.random.PRNGKey(101))))
+    lc.register("main-v200", 200, flatten_params(init_params(policy, jax.random.PRNGKey(202))))
+    lc.register(
+        "exp-1", 250,
+        flatten_params(init_params(policy, jax.random.PRNGKey(303))),
+        kind="exploiter", parent="main-v200",
+    )
+    assert svc.registry.candidates() == ["exp-1"]
+    assignments_at_start = lc.assignments()
+
+    # ------------- ONE multi-model server under the fault schedule ------
+    store_srv = CarryStoreServer(port=0).start()
+
+    def make_server(port):
+        return InferenceServer(
+            InferenceConfig(
+                serve=ServeConfig(
+                    port=port,
+                    max_batch=8,
+                    gather_window_s=0.002,
+                    models=MODELS,
+                    league_endpoint=league_ep,
+                    league_sync_s=0.25,
+                    handoff_endpoint=f"127.0.0.1:{store_srv.port}",
+                    handoff_timeout_s=2.0,
+                ),
+                policy=policy,
+                seed=7,
+            )
+        ).start()
+
+    inc = ServeIncarnations(make_server, port=0)
+    deadline = time.monotonic() + 60.0
+    while sum(inc.server.model_swaps[1:]) < SLOTS:  # initial league sync
+        if time.monotonic() > deadline:
+            raise RuntimeError("initial league sync never installed the slots")
+        time.sleep(0.05)
+
+    # -------------------------- the fleet -------------------------------
+    env_servers = []
+    actors = []
+    mem.reset("league_soak")
+    for j in range(args.actors):
+        es, eport = env_serve(FakeDotaService())
+        env_servers.append(es)
+        cfg = ActorConfig(
+            env_addr=f"127.0.0.1:{eport}",
+            rollout_len=2,  # short chunks: every episode crosses several
+            # carry boundaries, so a mid-episode kill usually finds a
+            # store-backed session to resume (boundary > 0)
+            max_dota_time=12.0,
+            policy=policy,
+            seed=100 + j,
+            opponent="league",
+            max_weight_age_s=0.0,  # no learner in the loop: no kill switch
+            serve=ServeClientConfig(
+                endpoint=f"127.0.0.1:{inc.port}",
+                league=league_ep,
+                timeout_s=6.0,
+                connect_timeout_s=1.5,
+                cooldown_s=0.3,
+                resume=True,
+                resume_window_s=15.0,
+            ),
+            retry=RetryConfig(window_s=5.0, backoff_base_s=0.05, backoff_cap_s=0.5),
+        )
+        actor = SelfPlayActor(cfg, connect("mem://league_soak"), actor_id=j)
+        assert actor.league is None, "remote mode must not build a local pool"
+        actors.append(actor)
+
+    soak_deadline = time.monotonic() + 240.0
+    runner_box = {}
+    exploiter_matches = 0
+
+    async def drive():
+        nonlocal exploiter_matches
+
+        async def one(actor):
+            nonlocal exploiter_matches
+            while (
+                actor.episodes_done < args.episodes_per_actor
+                and time.monotonic() < soak_deadline
+            ):
+                # paced env: ~0.02s/observe stretches episodes across the
+                # kill windows (injected before the lazy gRPC connect)
+                if actor._stub is None:
+                    from dotaclient_tpu.runtime.actor import connect_env_async
+
+                    actor._stub = _PacedStub(connect_env_async(actor.cfg), 0.02)
+                await actor.run_episode()
+                if actor._opp_role == "exploiter":
+                    exploiter_matches += 1
+                await asyncio.sleep(0.02)
+
+        async def arm_runner():
+            # Progress-gated epoch (the handoff-soak rule): t0 starts
+            # once episodes are flowing, so the roll hits a mid-stream
+            # fleet on any host speed.
+            while sum(a.episodes_done for a in actors) < 1:
+                if time.monotonic() > soak_deadline:
+                    return
+                await asyncio.sleep(0.02)
+            runner_box["r"] = ScheduleRunner(
+                FaultSchedule.parse(args.rolling, seed=0),
+                broker=None, t0=time.monotonic(), server=inc,
+            ).start()
+
+        await asyncio.gather(*(one(a) for a in actors), arm_runner())
+        # deliberate teardown: park every remote client's read loop so
+        # the loop close below is silent
+        for a in actors:
+            for cli in a._remote_clients.values():
+                await cli.close()
+
+    asyncio.new_event_loop().run_until_complete(drive())
+    runner = runner_box.get("r")
+    if runner is not None:
+        runner.stop()
+    for es in env_servers:
+        es.stop(0)
+
+    # ------------------------- harvest ----------------------------------
+    lives = list(inc.ledgers)
+    total = inc.final_ledger()
+    if len(lives) < total["incarnations"]:
+        lives = list(inc.ledgers)  # final_ledger appended the last life
+    store_stats = store_srv.stats()
+    store_srv.stop()
+
+    fleet = {
+        "episodes_done": sum(a.episodes_done for a in actors),
+        "remote_matches": sum(a.remote_matches for a in actors),
+        "remote_match_errors": sum(a.remote_match_errors for a in actors),
+        "remote_results_posted": sum(a.remote_results_posted for a in actors),
+        "remote_result_errors": sum(a.remote_result_errors for a in actors),
+        "remote_fallbacks": sum(a.remote_fallbacks for a in actors),
+        "remote_resumes": sum(a.remote_resumes for a in actors),
+        "remote_replay_steps": sum(a.remote_replay_steps for a in actors),
+        "exploiter_matches": exploiter_matches,
+        "finished_all": all(
+            a.episodes_done >= args.episodes_per_actor for a in actors
+        ),
+    }
+
+    # per-model exactness, EVERY life (model0 == live tree, never remote)
+    per_life = []
+    for led in lives:
+        per_life.append(
+            {
+                "requests": led["requests"],
+                "model_requests": [led[f"model{m}_requests"] for m in range(MODELS)],
+                "model_evictions": [led[f"model{m}_evictions"] for m in range(MODELS)],
+                "model_swaps": [led[f"model{m}_swaps"] for m in range(MODELS)],
+                "resumes": led["resumes"],
+                "resume_misses": led["resume_misses"],
+                "handoff_writes": led["handoff_writes"],
+                "handoff_write_errors": led["handoff_write_errors"],
+                "replayed_steps": led["replayed_steps"],
+                "evictions": led["evictions"],
+            }
+        )
+    requests_partition_ok = all(
+        sum(l["model_requests"]) == l["requests"] for l in per_life
+    )
+    evictions_partition_ok = all(
+        sum(l["model_evictions"]) == l["evictions"] for l in per_life
+    )
+    slot0_never_remote = all(l["model_requests"][0] == 0 for l in per_life)
+    league_synced_every_life = all(
+        sum(l["model_swaps"][1:]) == SLOTS and l["model_swaps"][0] == 0
+        for l in per_life
+    )
+    agg_model_requests = [
+        sum(l["model_requests"][m] for l in per_life) for m in range(MODELS)
+    ]
+    serve_totals = {
+        "incarnations": total["incarnations"],
+        "requests": total["requests"],
+        "model_requests": agg_model_requests,
+        "resumes": total["resumes"],
+        "resume_misses": total["resume_misses"],
+        "handoff_writes": total["handoff_writes"],
+        "handoff_write_errors": total["handoff_write_errors"],
+        "replayed_steps": total["replayed_steps"],
+    }
+
+    # ----------------- league state + bit-for-bit replay ----------------
+    live_board = svc.leaderboard()
+    live_gate = {k: list(v) for k, v in svc._gate.items()}
+    league_live = {
+        "pool": svc.registry.pool(),
+        "candidates": svc.registry.candidates(),
+        "promotions_total": svc.promotions_total,
+        "results_total": svc.results_total,
+        "bad_results_total": svc.bad_results_total,
+        "matches_total": svc.matches_total,
+        "gate": live_gate,
+        "exploiter_lineage_events": [
+            e["event"] for e in svc.registry.record("exp-1")["events"]
+        ],
+        "assignments_at_start": assignments_at_start,
+        "leaderboard": live_board["leaderboard"],
+    }
+    svc.stop()
+
+    replay = LeagueService(
+        LeagueConfig(
+            league=LeagueServiceConfig(
+                port=0, dir=reg_dir, capacity=8, slots=SLOTS,
+                policy="prioritized@0.4;uniform@0.2;exploiter@0.4",
+                gate_games=GATE_GAMES, gate_winrate=0.0, seed=0,
+            )
+        )
+    )
+    replay_board = replay.leaderboard()
+    replay_cmp = {
+        "leaderboard_bitwise": replay_board == live_board,
+        "gates_bitwise": {k: list(v) for k, v in replay._gate.items()} == live_gate,
+        "results_total_match": replay.results_total == league_live["results_total"],
+        "pool_match": replay.registry.pool() == league_live["pool"],
+    }
+    artifact["fleet"] = fleet
+    artifact["serve"] = {"per_life": per_life, "totals": serve_totals}
+    artifact["store"] = store_stats
+    artifact["league"] = league_live
+    artifact["replay"] = replay_cmp
+    artifact["rolling_recovery"] = None if runner is None else runner.recovery
+    artifact["kills_executed"] = len(inc.kill_times)
+
+    min_kills = 1 if args.quick else 2
+    verdict = {
+        # the headline: a serve-tier rolling restart is an episode
+        # non-event for the league fleet
+        "zero_abandoned_episodes": fleet["remote_fallbacks"] == 0
+        and fleet["finished_all"],
+        "store_backed_resumes": fleet["remote_resumes"] >= 1
+        and serve_totals["resumes"] >= 1
+        and serve_totals["resume_misses"] == 0
+        and serve_totals["handoff_writes"] >= 1
+        and serve_totals["handoff_write_errors"] == 0,
+        "rolling_killed_server": len(inc.kill_times) >= min_kills,
+        # per-model ledgers exact, every life
+        "model_requests_partition_aggregate": requests_partition_ok,
+        "model_evictions_partition_aggregate": evictions_partition_ok,
+        "live_tree_never_stepped_remotely": slot0_never_remote,
+        "league_sync_installed_all_slots_every_life": league_synced_every_life,
+        "every_league_slot_served": all(
+            agg_model_requests[m] > 0 for m in range(1, MODELS)
+        ),
+        # matchmaking + ratings closed the loop
+        "matchmaking_no_errors": fleet["remote_match_errors"] == 0
+        and fleet["remote_result_errors"] == 0
+        and league_live["bad_results_total"] == 0,
+        "results_ledger_exact": fleet["remote_results_posted"]
+        == league_live["results_total"],
+        "exploiter_promoted_via_matchmaking": league_live["promotions_total"] >= 1
+        and "exp-1" in league_live["pool"]
+        and league_live["gate"].get("exp-1", [0, 0])[1] >= GATE_GAMES
+        and fleet["exploiter_matches"] >= GATE_GAMES
+        and league_live["exploiter_lineage_events"] == ["admit", "promote"],
+        # the registry dir IS the service: bit-for-bit on reboot
+        "leaderboard_replay_bitwise": all(replay_cmp.values()),
+    }
+    artifact["verdict"] = verdict
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(artifact, indent=2))
+    return 0 if all(v for v in verdict.values() if isinstance(v, bool)) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -45,6 +45,7 @@ def test_manifests_exist():
         "rabbitmq.yaml",
         "inference.yaml",
         "control.yaml",
+        "league.yaml",
     } <= names
     assert (K8S / "Dockerfile").exists()
 
@@ -98,7 +99,7 @@ def test_flags_are_real_config_fields():
     from dotaclient_tpu.config import ActorConfig, EvalConfig, LearnerConfig, add_flags
     import argparse
 
-    from dotaclient_tpu.config import ControlConfig, InferenceConfig
+    from dotaclient_tpu.config import ControlConfig, InferenceConfig, LeagueConfig
 
     known = {
         "dotaclient_tpu.runtime.learner": LearnerConfig(),
@@ -106,6 +107,7 @@ def test_flags_are_real_config_fields():
         "dotaclient_tpu.eval.evaluator": EvalConfig(),
         "dotaclient_tpu.serve.server": InferenceConfig(),
         "dotaclient_tpu.control.server": ControlConfig(),
+        "dotaclient_tpu.league.server": LeagueConfig(),
     }
     for fname, c in _our_containers():
         cmd = c.get("command")
@@ -301,6 +303,7 @@ def test_chaos_pinned_off_in_all_prod_manifests():
             "dotaclient_tpu.env.fake_dotaservice",  # env stub: no flags at all
             "dotaclient_tpu.serve.handoff",  # carry store: no chaos surface
             "dotaclient_tpu.control.server",  # control plane: no chaos surface
+            "dotaclient_tpu.league.server",  # league service: no chaos surface
         ):
             continue
         args = c.get("args", [])
@@ -390,15 +393,17 @@ def test_inference_service_manifest():
     assert {sport, mport} <= ports
 
 
-def test_serve_endpoint_lists_match_replicas_and_league_stays_local():
-    """Actor-side serve wiring (PR 10), gated on a green
-    SERVE_CHAOS_SOAK verdict (the WIRE_SOAK flip pattern): the scripted
-    experience fleet lists EXACTLY one per-pod DNS endpoint per
+def test_serve_endpoint_lists_match_replicas_and_league_rides_serve():
+    """Actor-side serve wiring (PR 10 + ISSUE 17), gated on a green
+    SERVE_CHAOS_SOAK verdict (the WIRE_SOAK flip pattern): every fleet
+    on the serve tier lists EXACTLY one per-pod DNS endpoint per
     inference replica (list drift = stranded capacity or a phantom
-    endpoint) plus the failover/fallback knobs; the league fleet stays
-    pinned EMPTY — its sessions step per-session snapshot params the
-    shared-tree service cannot serve, and the binary refuses the
-    combination loudly."""
+    endpoint); the scripted fleet adds the failover/fallback knobs; the
+    league fleet — which used to be pinned EMPTY by the single-model
+    refusal — now rides the multi-model tier and MUST pair the endpoint
+    list with --serve.league naming the league Service (the pair is the
+    contract: endpoint without league would trip the actor binary's
+    refusal on boot)."""
     import json
 
     verdict = json.loads((K8S.parent / "SERVE_CHAOS_SOAK.json").read_text())["verdict"]
@@ -420,10 +425,22 @@ def test_serve_endpoint_lists_match_replicas_and_league_stays_local():
             assert "--serve.endpoint" in a, f"{fname}: serve.endpoint not pinned"
             opp = a[a.index("--opponent") + 1]
             by_deploy[opp] = a
+
     league = by_deploy["league"]
-    assert league[league.index("--serve.endpoint") + 1] == "", (
-        "league actors must stay on local inference (per-session params)"
+    eps = league[league.index("--serve.endpoint") + 1].split(",")
+    assert eps == expected, (
+        f"league fleet endpoint list {eps} must name every inference "
+        f"replica exactly: {expected}"
     )
+    league_ep = league[league.index("--serve.league") + 1]
+    assert league_ep, (
+        "league fleet must name the league service: serve.endpoint "
+        "without serve.league is the refused single-model combination"
+    )
+    svc = league_ep.split(":")[0]
+    services = {d["metadata"]["name"] for _, d in DOCS if d["kind"] == "Service"}
+    assert svc in services, f"--serve.league host {svc!r} has no Service"
+
     scripted = by_deploy["scripted_hard"]
     eps = scripted[scripted.index("--serve.endpoint") + 1].split(",")
     assert eps == expected, (
@@ -434,6 +451,77 @@ def test_serve_endpoint_lists_match_replicas_and_league_stays_local():
         "the serve-tier fleet arms the local fallback (experience never stops)"
     )
     assert float(scripted[scripted.index("--serve.fallback_after_s") + 1]) > 0
+
+
+def test_league_service_manifest():
+    """League service (ISSUE 17): a single-replica Deployment + Service
+    (the registry dir is the state; restart = matches.jsonl replay, not
+    loss); the committed --league.policy must PARSE (a typo'd clause
+    would crash matchmaking on boot); port agreement end to end
+    (league.port == containerPort == probe port == Service port ==
+    every client's --serve.league / --serve.league_endpoint); the slot
+    count must equal the inference tier's --serve.models minus one
+    (slot 0 is the live tree — drift strands assignments or leaves
+    slots the sync can never fill); and the serve tier must actually
+    run multi-model with the sync pointed back at this Service."""
+    from dotaclient_tpu.league.policy import parse_match_policy
+
+    (_, dep), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "league" and d["kind"] == "Deployment"
+    ]
+    assert dep["spec"]["replicas"] == 1, "one pod owns the population"
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][2] == "dotaclient_tpu.league.server"
+    args = c["args"]
+
+    clauses = parse_match_policy(args[args.index("--league.policy") + 1])
+    assert clauses, "shipped matchmaking policy must have at least one clause"
+
+    lport = int(args[args.index("--league.port") + 1])
+    assert {p["containerPort"] for p in c["ports"]} == {lport}
+    assert c["readinessProbe"]["httpGet"]["port"] == lport
+    assert c["livenessProbe"]["httpGet"]["port"] == lport
+    (_, svc), = [
+        (f, d) for f, d in DOCS
+        if d["kind"] == "Service" and d["metadata"]["name"] == "league"
+    ]
+    assert {p["port"] for p in svc["spec"]["ports"]} == {lport}
+
+    assert args[args.index("--league.dir") + 1], (
+        "a standing league without a registry dir forgets its population "
+        "on every restart"
+    )
+
+    # cross-tier wiring: slots == serve models - 1, sync closed-loop
+    (_, sts), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "inference" and d["kind"] == "StatefulSet"
+    ]
+    sargs = sts["spec"]["template"]["spec"]["containers"][0]["args"]
+    models = int(sargs[sargs.index("--serve.models") + 1])
+    assert models > 1, "the league tier needs a multi-model serve tier"
+    slots = int(args[args.index("--league.slots") + 1])
+    assert slots == models - 1, (
+        f"league slots {slots} must equal serve models {models} - 1 "
+        "(slot 0 stays the live fan-out tree)"
+    )
+    assert sargs[sargs.index("--serve.league_endpoint") + 1] == f"league:{lport}", (
+        "the serve tier's assignment sync must dial this league Service"
+    )
+    serve_ep = args[args.index("--league.serve_endpoint") + 1]
+    sport = sargs[sargs.index("--serve.port") + 1]
+    assert serve_ep.endswith(f":{sport}"), (
+        "/match hands fleets the serve tier's port"
+    )
+    # the league fleet's --serve.league must dial this same Service:port
+    for fname, ac in _our_containers():
+        if ac.get("command") and ac["command"][2] == "dotaclient_tpu.runtime.actor":
+            a = ac.get("args", [])
+            if a[a.index("--opponent") + 1] == "league" and "--serve.league" in a:
+                assert a[a.index("--serve.league") + 1] == f"league:{lport}", (
+                    f"{fname}: league fleet dials a different league port"
+                )
 
 
 def test_session_continuity_manifests():

@@ -732,3 +732,81 @@ def test_learner_obs_end_to_end_scrape(tmp_path):
         assert "dotaclient_obs_broker_experience_depth" in body
     finally:
         learner.close()
+
+
+def test_league_scalars_are_registered():
+    """The league_* family rides two surfaces — the per-actor League
+    pool (eval/league.py, scraped through actor stats) and the standing
+    LeagueService (league/server.py /metrics) — pin BOTH stats() name
+    sets against the registry so a rename must touch obs/registry.py."""
+    import numpy as np
+
+    from dotaclient_tpu.config import LeagueConfig, LeagueServiceConfig
+    from dotaclient_tpu.eval.league import League
+    from dotaclient_tpu.league.server import LeagueService
+    from dotaclient_tpu.obs import registry
+
+    lg = League(capacity=2, snapshot_every=1)
+    lg.maybe_snapshot(1, [("w", np.zeros(2, np.float32))])
+    missing = registry.unregistered(lg.stats().keys())
+    assert not missing, f"actor league scalars not in obs/registry.py: {missing}"
+    assert {
+        "league_pool_size",
+        "league_snapshots_total",
+        "league_evictions_total",
+        "league_opponent_samples_total",
+        "league_results_total",
+    } == set(lg.stats())
+
+    svc = LeagueService(LeagueConfig(league=LeagueServiceConfig(port=0, dir="")))
+    stats = svc.stats()  # constructed, never started: names only
+    missing = registry.unregistered(stats.keys())
+    assert not missing, f"league service scalars not in obs/registry.py: {missing}"
+    assert {
+        "league_pool_size",
+        "league_candidates",
+        "league_slots_assigned",
+        "league_snapshots_total",
+        "league_evictions_total",
+        "league_promotions_total",
+        "league_matches_total",
+        "league_match_empty_total",
+        "league_results_total",
+        "league_bad_results_total",
+        "league_fanout_snapshots_total",
+        "league_fanout_errors_total",
+    } == set(stats)
+
+
+def test_serve_multi_model_scalars_are_registered():
+    """The serve_model_* per-slot ledgers appear only at --serve.models
+    > 1 (the single-model scrape surface is otherwise unchanged — the
+    inertness discipline) and register through the serve_model_ prefix
+    family for every slot index a real fleet could run."""
+    from dotaclient_tpu.config import InferenceConfig, PolicyConfig, ServeConfig
+    from dotaclient_tpu.obs import registry
+    from dotaclient_tpu.serve.server import InferenceServer
+
+    SMALL = PolicyConfig(
+        unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32"
+    )
+    single = InferenceServer(
+        InferenceConfig(serve=ServeConfig(port=0, max_batch=2), policy=SMALL)
+    ).stats()
+    assert single["serve_models_resident"] == 1.0
+    assert not any(k.startswith("serve_model_") for k in single), (
+        "per-slot ledgers must not leak into the single-model surface"
+    )
+
+    multi = InferenceServer(
+        InferenceConfig(serve=ServeConfig(port=0, max_batch=2, models=3), policy=SMALL)
+    ).stats()
+    missing = registry.unregistered(multi.keys())
+    assert not missing, f"multi-model serve scalars not in obs/registry.py: {missing}"
+    assert multi["serve_models_resident"] == 3.0
+    for m in range(3):
+        for fam in ("requests", "swaps", "evictions"):
+            assert multi[f"serve_model_{fam}_total_{m}"] == 0.0
+        assert f"serve_model_version_{m}" in multi
+    assert multi["serve_league_syncs_total"] == 0.0
+    assert multi["serve_league_sync_errors_total"] == 0.0

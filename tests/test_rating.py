@@ -169,3 +169,72 @@ def test_record_teams_respects_anchors():
     assert t.get("bot") is before_bot  # anchored: unchanged
     assert t.get("a1").mu > R.MU and t.get("b1").mu < R.MU
     assert t.games["bot"] == 1
+
+
+# ------------------------------------------------------- team draw paths
+
+
+def test_draw_margin_scales_with_total_players():
+    """ε grows with √n: the performance-difference scale of an n-player
+    match is √n·β, so a 10-player margin is √5× the 1v1 margin."""
+    eps2 = draw_margin(0.10, BETA, n_players=2)
+    eps10 = draw_margin(0.10, BETA, n_players=10)
+    assert eps10 == pytest.approx(eps2 * math.sqrt(5.0))
+    assert draw_margin(0.0, BETA, n_players=10) == 0.0
+
+
+def test_rate_teams_draw_1v1_reduces_to_rate_1v1_draw():
+    """The draw branch of the two-team closed form at n=1 per side IS
+    the 1v1 draw rule (same reduction the win branch pins)."""
+    a, b = R.Rating(27.0, 7.0), R.Rating(24.0, 6.0)
+    w1, l1 = R.rate_1v1(a, b, draw=True)
+    (w2,), (l2,) = R.rate_teams([a], [b], draw=True)
+    assert abs(w1.mu - w2.mu) < 1e-12 and abs(w1.sigma - w2.sigma) < 1e-12
+    assert abs(l1.mu - l2.mu) < 1e-12 and abs(l1.sigma - l2.sigma) < 1e-12
+
+
+def test_rate_teams_draw_pulls_teams_together_and_shrinks_sigma():
+    """A team draw against a weaker side is evidence AGAINST the
+    favourite: every favourite drops, every underdog rises, and the
+    shared team evidence still shrinks everyone's sigma."""
+    strong = [R.Rating(30.0, 5.0), R.Rating(28.0, 5.0)]
+    weak = [R.Rating(22.0, 5.0), R.Rating(20.0, 5.0)]
+    new_s, new_w = R.rate_teams(strong, weak, draw=True)
+    assert all(n.mu < o.mu for n, o in zip(new_s, strong))
+    assert all(n.mu > o.mu for n, o in zip(new_w, weak))
+    assert all(n.sigma < o.sigma for n, o in zip(new_s + new_w, strong + weak))
+
+
+def test_rate_teams_draw_evenly_matched_is_a_mu_fixed_point():
+    """Evenly matched teams drawing: no information about WHO is better
+    (mu unchanged), but information that they're CLOSE (sigma shrinks)."""
+    new_a, new_b = R.rate_teams(
+        [R.Rating(), R.Rating()], [R.Rating(), R.Rating()], draw=True
+    )
+    for r in new_a + new_b:
+        assert r.mu == pytest.approx(R.MU, abs=1e-9)
+        assert r.sigma < R.SIGMA
+
+
+def test_record_teams_draw_counts_games_and_auto_adds():
+    """record_teams(draw=True) auto-registers unseen names (the
+    RatingTable.record convention), counts one game for every player on
+    both sides, and applies the draw update — uneven sides pull toward
+    each other."""
+    t = R.RatingTable()
+    t.add("vet", R.Rating(30.0, 4.0))
+    t.record_teams(["vet", "fresh"], ["u1", "u2"], draw=True)
+    for n in ("vet", "fresh", "u1", "u2"):
+        assert t.games[n] == 1
+    assert t.get("vet").mu < 30.0  # favourite drew: dragged down
+    assert t.get("u1").mu > R.MU  # underdogs drew the stronger side: up
+    assert t.get("fresh").sigma < R.SIGMA
+
+
+def test_team_win_probability_symmetry_and_even_draw():
+    strong = [R.Rating(28.0, 3.0)] * 5
+    weak = [R.Rating(22.0, 3.0)] * 5
+    p = R.team_win_probability(strong, weak)
+    assert R.team_win_probability(weak, strong) == pytest.approx(1.0 - p)
+    even = [R.Rating()] * 5
+    assert R.team_win_probability(even, even) == pytest.approx(0.5)

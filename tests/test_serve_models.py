@@ -1,0 +1,405 @@
+"""Multi-model serve tier (ISSUE 17 tentpole a).
+
+The load-bearing contracts:
+
+- **Wire inertness (the DTR1/DTR2 rule).** Model 0 encodes to the EMPTY
+  S_INFO payload — the exact bytes every pre-multi-model client ever
+  sent — and step frames never carry a model field at all, so a
+  single-model deployment is byte-identical on the wire to the PR-13
+  serve path. The bitwise-parity test pins it end to end: a multi-model
+  server's slot-0 responses equal a plain single-model server's.
+
+- **Per-slot isolation.** Each model slot is its own (params, version)
+  hot-swap cell with its own batcher and per-model ledgers; a client's
+  S_INFO handshake binds its CONNECTION to one slot, and every response
+  is bitwise the standalone B=1 local step under that slot's tree.
+
+- **Composed store keys.** Handoff-store entries key by
+  (client_key, model_id) via one u64 compose; model 0 composes to the
+  bare key, so PR-13 store contents are bit-for-bit unchanged.
+"""
+
+import asyncio
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import (
+    ActorConfig,
+    InferenceConfig,
+    PolicyConfig,
+    ServeConfig,
+)
+from dotaclient_tpu.env import featurizer as F
+from dotaclient_tpu.models.policy import init_params, initial_state
+from dotaclient_tpu.runtime.actor import make_actor_step
+from dotaclient_tpu.serve import wire as W
+from dotaclient_tpu.serve.client import RemotePolicyClient
+from dotaclient_tpu.serve.handoff import LocalCarryStore, carry_fingerprint
+from dotaclient_tpu.serve.server import InferenceServer
+from dotaclient_tpu.transport.serialize import flatten_params
+
+SMALL = PolicyConfig(unit_embed_dim=16, lstm_hidden=16, mlp_hidden=16, dtype="float32")
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _server(models=1, seed=1, carry_store=None, max_batch=4):
+    cfg = InferenceConfig(
+        serve=ServeConfig(
+            port=0, max_batch=max_batch, gather_window_s=0.002, models=models
+        ),
+        policy=SMALL,
+        seed=seed,
+    )
+    return InferenceServer(cfg, carry_store=carry_store).start()
+
+
+def _rand_obs(rs: np.random.RandomState) -> F.Observation:
+    o = F.zeros_observation()
+    return o._replace(
+        unit_feats=np.asarray(rs.randn(*o.unit_feats.shape), np.float32),
+        hero_feats=np.asarray(rs.randn(*o.hero_feats.shape), np.float32),
+        global_feats=np.asarray(rs.randn(*o.global_feats.shape), np.float32),
+        unit_mask=np.asarray(rs.rand(*o.unit_mask.shape) > 0.3),
+        action_mask=np.ones_like(o.action_mask),
+        target_mask=np.asarray(rs.rand(*o.target_mask.shape) > 0.3),
+    )
+
+
+def _local_reference(params, obs, rng):
+    single = make_actor_step(ActorConfig(policy=SMALL, seed=1))
+    state = jax.tree.map(np.asarray, initial_state(SMALL, (1,)))
+    obs_b = jax.tree.map(lambda x: np.asarray(x)[None], obs)
+    return single(params, state, obs_b, rng)
+
+
+def _assert_matches_local(resp, want):
+    w_state, w_action, w_logp, w_value, w_rng = want
+    np.testing.assert_array_equal(resp.rng, np.asarray(w_rng))
+    np.testing.assert_array_equal(
+        resp.action,
+        np.asarray(
+            [w_action.type[0], w_action.move_x[0], w_action.move_y[0], w_action.target[0]],
+            np.int32,
+        ),
+    )
+    assert np.float32(resp.logp).tobytes() == np.asarray(w_logp[0], np.float32).tobytes()
+    assert np.float32(resp.value).tobytes() == np.asarray(w_value[0], np.float32).tobytes()
+
+
+async def _one_step(endpoint, model, key, obs, rng, **kw):
+    client = RemotePolicyClient(endpoint, SMALL, model=model)
+    try:
+        return await client.step(key, obs, rng, episode_start=True, **kw)
+    finally:
+        await client.close()
+
+
+# ------------------------------------------------------------------- wire
+
+
+def test_info_request_model_zero_is_the_empty_payload():
+    """The inertness proof at the byte level: model 0 IS the legacy
+    handshake — no field, no bytes, nothing for an old server to choke
+    on; absent payload decodes back to 0."""
+    assert W.encode_info_request(0) == b""
+    assert W.decode_info_request(b"") == 0
+
+
+def test_info_request_roundtrip_and_bounds():
+    for m in (1, 2, 255, W.MAX_MODEL_ID):
+        payload = W.encode_info_request(m)
+        assert len(payload) == 4
+        assert W.decode_info_request(payload) == m
+    with pytest.raises(ValueError):
+        W.encode_info_request(W.MAX_MODEL_ID + 1)
+    with pytest.raises(ValueError):
+        W.encode_info_request(-1)
+    with pytest.raises(ValueError, match="size"):
+        W.decode_info_request(b"\x01\x02")
+
+
+def test_compose_store_key_identity_packing_and_bounds():
+    """Model 0 is the identity (PR-13 store contents bit-for-bit); other
+    models shift into the high 16 bits so (client, model) pairs can
+    never alias; keys that would collide across the split refuse
+    loudly."""
+    for key in (0, 1, 12345, W.MAX_CLIENT_KEY):
+        assert W.compose_store_key(key, 0) == key
+    assert W.compose_store_key(7, 1) == (1 << W.MODEL_KEY_SHIFT) | 7
+    seen = {
+        W.compose_store_key(k, m) for k in (0, 1, 99) for m in (0, 1, 2, 3)
+    }
+    assert len(seen) == 12, "composed keys must be pairwise distinct"
+    with pytest.raises(ValueError, match="client_key"):
+        W.compose_store_key(W.MAX_CLIENT_KEY + 1, 0)
+    with pytest.raises(ValueError, match="model id"):
+        W.compose_store_key(1, W.MAX_MODEL_ID + 1)
+    with pytest.raises(ValueError):
+        W.compose_store_key(-1, 0)
+
+
+# ----------------------------------------------------------- serving slots
+
+
+@pytest.fixture(scope="module")
+def multi():
+    """One models=3 server with distinct trees installed in slots 1/2,
+    plus a plain single-model server from the same seed (the parity
+    yardstick)."""
+    store = LocalCarryStore()
+    server = _server(models=3, carry_store=store)
+    p1 = init_params(SMALL, jax.random.PRNGKey(101))
+    p2 = init_params(SMALL, jax.random.PRNGKey(202))
+    server.swap_model(1, p1, version=101)
+    server.swap_model(2, flatten_params(p2), version=202)  # named-list form
+    single = _server(models=1)
+    yield server, single, {0: server._bundles[0][0], 1: p1, 2: p2}, store
+    server.stop()
+    single.stop()
+
+
+def test_each_slot_serves_its_own_tree_bitwise(multi):
+    """The same (obs, rng) stepped through every model id returns the
+    local B=1 step under THAT slot's params — and stamps that slot's
+    version — so a league opponent resident in slot m is provably the
+    frozen snapshot, not a mislabeled live tree."""
+    server, _, trees, _ = multi
+    rs = np.random.RandomState(0)
+    obs = _rand_obs(rs)
+    rng = np.asarray(jax.random.PRNGKey(7))
+    versions = {0: 0, 1: 101, 2: 202}
+    for m in range(3):
+        resp = run(_one_step(f"127.0.0.1:{server.port}", m, 40 + m, obs, rng))
+        assert resp.status == 0
+        assert resp.version == versions[m]
+        _assert_matches_local(resp, _local_reference(trees[m], obs, rng))
+    # distinct trees must yield distinct logps for the same obs/rng —
+    # otherwise the bitwise checks above were vacuous
+    logps = {
+        m: run(_one_step(f"127.0.0.1:{server.port}", m, 50 + m, obs, rng)).logp
+        for m in range(3)
+    }
+    assert len({np.float32(v).tobytes() for v in logps.values()}) == 3
+
+
+def test_model_requests_ledger_partitions_the_aggregate(multi):
+    server, _, _, _ = multi
+    rs = np.random.RandomState(3)
+    before = list(server.model_requests)
+    before_total = server.requests_total
+    for m, n in ((0, 2), (1, 3), (2, 1)):
+        for i in range(n):
+            run(
+                _one_step(
+                    f"127.0.0.1:{server.port}",
+                    m,
+                    60 + 10 * m + i,
+                    _rand_obs(rs),
+                    np.asarray(jax.random.PRNGKey(m * 100 + i)),
+                )
+            )
+    deltas = [a - b for a, b in zip(server.model_requests, before)]
+    assert deltas == [2, 3, 1]
+    assert server.requests_total - before_total == sum(deltas), (
+        "per-model ledgers must partition the aggregate exactly"
+    )
+
+
+def test_model_zero_bitwise_parity_with_single_model_server(multi):
+    """The acceptance criterion's parity proof: a multi-model server's
+    slot-0 responses are bitwise a plain single-model server's (same
+    seed) for the same requests — model 0 + absent wire field ≡ the
+    PR-13 serve path."""
+    server, single, _, _ = multi
+    rs = np.random.RandomState(9)
+    for i in range(3):
+        obs = _rand_obs(rs)
+        rng = np.asarray(jax.random.PRNGKey(300 + i))
+        a = run(_one_step(f"127.0.0.1:{server.port}", 0, 70 + i, obs, rng))
+        b = run(_one_step(f"127.0.0.1:{single.port}", 0, 70 + i, obs, rng))
+        assert (a.status, a.version) == (b.status, b.version)
+        np.testing.assert_array_equal(a.action, b.action)
+        np.testing.assert_array_equal(a.rng, b.rng)
+        assert np.float32(a.logp).tobytes() == np.float32(b.logp).tobytes()
+        assert np.float32(a.value).tobytes() == np.float32(b.value).tobytes()
+
+
+def test_single_model_stats_surface_unchanged(multi):
+    """At --serve.models 1 the scrape surface grows ONLY the resident
+    gauge + sync counters (all inert); the per-slot serve_model_* family
+    appears exclusively on multi-model servers."""
+    server, single, _, _ = multi
+    s1 = single.stats()
+    assert s1["serve_models_resident"] == 1.0
+    assert s1["serve_league_syncs_total"] == 0.0
+    assert not [k for k in s1 if k.startswith("serve_model_")]
+    sn = server.stats()
+    assert sn["serve_models_resident"] == 3.0
+    for m in range(3):
+        for fam in ("requests_total", "swaps_total", "evictions_total", "version"):
+            assert f"serve_model_{fam}_{m}" in sn
+    assert sn["serve_model_version_1"] == 101.0
+    assert sn["serve_model_version_2"] == 202.0
+    assert sn["serve_model_requests_total_0"] + sn[
+        "serve_model_requests_total_1"
+    ] + sn["serve_model_requests_total_2"] == sn["serve_requests_total"]
+
+
+def test_out_of_range_model_refused_loudly(multi):
+    """A model id the server does not hold is a config error, not a
+    retryable fault: the handshake answers model_error and the client
+    raises ValueError (never silent slot-0 fallback — a league match
+    served by the wrong opponent would poison ratings)."""
+    server, _, _, _ = multi
+    rs = np.random.RandomState(1)
+    with pytest.raises(ValueError, match="refused model 7"):
+        run(
+            _one_step(
+                f"127.0.0.1:{server.port}",
+                7,
+                80,
+                _rand_obs(rs),
+                np.asarray(jax.random.PRNGKey(0)),
+            )
+        )
+    with pytest.raises(ValueError, match="model"):
+        RemotePolicyClient("x:1", SMALL, model=-1)
+
+
+def test_swap_model_validates_slot_and_routes_zero_to_swap_params(multi):
+    server, _, _, _ = multi
+    with pytest.raises(ValueError, match="not resident"):
+        server.swap_model(5, init_params(SMALL, jax.random.PRNGKey(0)), version=1)
+    before = server.weight_swaps_total
+    server.swap_model(0, server._bundles[0][0], version=server._bundles[0][1])
+    assert server.weight_swaps_total == before + 1, (
+        "slot 0 swaps must ride swap_params (live-tree bookkeeping)"
+    )
+
+
+# ------------------------------------------------- composed carries + store
+
+
+def test_store_keys_compose_per_model_and_model_zero_is_bare(multi):
+    """The SAME client_key on two model slots writes two DISTINCT store
+    entries — and the model-0 entry sits under the bare key, exactly
+    where a PR-13 store would have put it."""
+    server, _, _, store = multi
+    rs = np.random.RandomState(21)
+    key = 90
+    for m in (0, 1):
+        run(
+            _one_step(
+                f"127.0.0.1:{server.port}",
+                m,
+                key,
+                _rand_obs(rs),
+                np.asarray(jax.random.PRNGKey(400 + m)),
+                want_carry=True,
+            )
+        )
+    entries = store.store._entries
+    assert key in entries, "model 0 must write the BARE key (PR-13 parity)"
+    assert W.compose_store_key(key, 1) in entries
+    st0, e0 = store.store.get(key, 1)
+    st1, e1 = store.store.get(W.compose_store_key(key, 1), 1)
+    assert st0 == st1 == 0  # ST_OK
+    assert e0.c.tobytes() != e1.c.tobytes(), (
+        "distinct trees must have produced distinct boundary carries"
+    )
+
+
+def test_resume_restores_per_model_carry(multi):
+    """Failover per (client_key, model_id): a reconnecting model-1
+    session resumes ITS boundary carry from the composed key, and the
+    fingerprint guard still rejects a wrong-bytes claim."""
+    server, _, _, store = multi
+    rs = np.random.RandomState(33)
+    key = 95
+    resp = run(
+        _one_step(
+            f"127.0.0.1:{server.port}",
+            1,
+            key,
+            _rand_obs(rs),
+            np.asarray(jax.random.PRNGKey(500)),
+            want_carry=True,
+        )
+    )
+    c, h = resp.carry
+    fp = carry_fingerprint(c, h)
+
+    async def resume_roundtrip(good_hash):
+        client = RemotePolicyClient(f"127.0.0.1:{server.port}", SMALL, model=1)
+        try:
+            return await client.resume(key, 1, good_hash)
+        finally:
+            await client.close()
+
+    before = server.resumes_total
+    rr = run(resume_roundtrip(fp))
+    assert rr.status == 0 and rr.episode_step == 1
+    assert server.resumes_total == before + 1
+
+    from dotaclient_tpu.serve.client import SessionResumeRefused
+
+    with pytest.raises(SessionResumeRefused):
+        run(resume_roundtrip(fp ^ 0xDEAD))
+
+
+# ------------------------------------------------------------ chaos ledgers
+
+
+def test_chaos_model_ledgers_flat_and_exact(multi):
+    """ServeIncarnations harvests per-model ledgers as flat model<m>_*
+    ints (the final_ledger summation shape); single-model servers
+    contribute NO model keys — the ledger schema is unchanged at N=1."""
+    from dotaclient_tpu.chaos.controller import ServeIncarnations
+
+    server, single, _, _ = multi
+    led = ServeIncarnations._model_ledgers(server)
+    assert set(led) == {
+        f"model{m}_{fam}"
+        for m in range(3)
+        for fam in ("requests", "evictions", "swaps")
+    }
+    for m in range(3):
+        assert led[f"model{m}_requests"] == server.model_requests[m]
+        assert led[f"model{m}_evictions"] == server.model_evictions[m]
+        assert led[f"model{m}_swaps"] == server.model_swaps[m]
+    assert ServeIncarnations._model_ledgers(single) == {}
+
+
+def test_per_model_evictions_count_on_disconnect(multi):
+    """A dying connection's resident carries are charged to ITS bound
+    model's eviction ledger."""
+    server, _, _, _ = multi
+    rs = np.random.RandomState(44)
+    before = server.model_evictions[2]
+    run(
+        _one_step(
+            f"127.0.0.1:{server.port}",
+            2,
+            97,
+            _rand_obs(rs),
+            np.asarray(jax.random.PRNGKey(600)),
+        )
+    )
+    deadline = time.time() + 5
+    while server.model_evictions[2] == before and time.time() < deadline:
+        time.sleep(0.02)
+    assert server.model_evictions[2] == before + 1
+
+
+def test_one_jit_signature_shared_across_slots(multi):
+    """N slots must not multiply compiles: every batcher shares slot 0's
+    compiled step callable (the params argument is the only per-tick
+    difference)."""
+    server, _, _, _ = multi
+    assert all(b._step is server.batchers[0]._step for b in server.batchers[1:])
