@@ -97,6 +97,7 @@ _BINARY_CONFIGS = {
     "dotaclient_tpu.serve.server": "InferenceConfig",
     "dotaclient_tpu.serve.handoff": "HandoffConfig",
     "dotaclient_tpu.control.server": "ControlConfig",
+    "dotaclient_tpu.obs.fleetd": "FleetConfig",
     "dotaclient_tpu.league.server": "LeagueConfig",
     "dotaclient_tpu.transport.tcp_server": "argparse:transport/tcp_server.py",
     "dotaclient_tpu.transport.fabric": "argparse:transport/fabric.py",

@@ -839,6 +839,64 @@ class ControlConfig:
 
 
 @dataclass
+class FleetLoopConfig:
+    """The --fleet.* surface of the fleet telemetry aggregator
+    (python -m dotaclient_tpu.obs.fleetd): topology-driven scraping of
+    every tier's /metrics surface, a continuous frame-conservation
+    audit, fleet SLO rollups, and alert-triggered flight-recorder
+    fan-in. Stdlib only — the controller's weight class."""
+
+    # Port of fleetd's own HTTP surface: GET /fleet (the JSON rollup),
+    # /metrics (fleet_* gauges the control plane can consume as policy
+    # meters), /healthz (503 while any ledger is stale/alarming), and
+    # /debug/flight. The k8s Service pins 13420; 0 = free port (tests).
+    port: int = 13420
+    # Scrape-audit-alert cadence, seconds. One poll = one audit window:
+    # the injected-loss detection latency bound is exactly this.
+    poll_s: float = 2.0
+    # Per-target time-series ring length (poll windows retained for the
+    # /fleet history view); bounds fleetd memory per target.
+    window: int = 64
+    # Seconds without a successful scrape before a target is reported
+    # stale in /fleet (the audit freezes immediately either way).
+    stale_s: float = 10.0
+    # Control-plane address (host:port) whose GET /topology "metrics"
+    # map is the discovery source; discovered endpoints MERGE with the
+    # literal lists below. "" = literal lists only (the rollback
+    # position, same semantics as --serve.endpoint).
+    control: str = ""
+    # Literal per-tier scrape lists (comma host:port of OBS surfaces;
+    # "" = tier absent). These are the rollback position AND the way to
+    # aggregate tiers the control plane does not manage.
+    brokers: str = ""
+    servers: str = ""
+    actors: str = ""
+    stores: str = ""
+    learners: str = ""
+    leagues: str = ""
+    # Alert clauses: ";"-separated "meter,op,threshold,for=W" — meter
+    # names fleetd's OWN rollup gauges (fleet_unaccounted_frames,
+    # fleet_targets_up, ...), op in gt|ge|lt|le|eq|ne, W = consecutive
+    # breached poll windows before firing. A firing edge snapshots
+    # every target's GET /debug/flight ring into one incident bundle.
+    # "" = audit-only (no alerting). Parse errors fail boot LOUDLY.
+    alerts: str = ""
+    # Directory incident bundles land in ("" = cwd).
+    bundle_dir: str = ""
+
+
+@dataclass
+class FleetConfig:
+    """Fleet telemetry binary (python -m dotaclient_tpu.obs.fleetd):
+    the standing aggregator. Scrapes the fleet, audits the conservation
+    ledgers live, serves fleet_* rollups. Stdlib only — never imports
+    jax or the wire stack."""
+
+    fleet: FleetLoopConfig = field(default_factory=FleetLoopConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
+
+
+@dataclass
 class LeagueServiceConfig:
     """The --league.* surface of the standing league service
     (dotaclient_tpu/league/server.py): a disk-backed snapshot registry

@@ -16,9 +16,12 @@ that ledger as the audit trail.
 
 The same HTTP surface serves discovery: GET /topology returns
 
-    {"ok": true, "epoch": N, "tiers": {"server": ["h:p", ...], ...}}
+    {"ok": true, "epoch": N, "tiers": {"server": ["h:p", ...], ...},
+     "metrics": {"server": ["h:obs_p", ...], ...}}
 
-— the endpoint lists actors and serve clients poll at (re)connect when
+— `tiers` is the DATA endpoint map actors and serve clients poll at
+(re)connect, `metrics` the scrape-surface map the fleet telemetry
+aggregator (obs/fleetd) discovers its targets from. Clients read when
 their `--serve.endpoint` is `control:<host:port>` (serve/client.py;
 the client speaks plain HTTP and never imports this package). `epoch`
 bumps on every actuated scale, so a client can cheaply detect "shape
@@ -45,6 +48,7 @@ from dotaclient_tpu.config import ControlConfig, parse_config
 from dotaclient_tpu.control.drivers import K8sDriver, StaticDriver, TierSpec
 from dotaclient_tpu.control.policy import PolicyEngine, parse_policy
 from dotaclient_tpu.control.scrape import aggregate_tier, scrape_endpoint, scrape_health
+from dotaclient_tpu.obs.flight_recorder import FlightRecorder
 from dotaclient_tpu.obs.http import MetricsHTTPServer
 
 _log = logging.getLogger(__name__)
@@ -98,6 +102,12 @@ class ControlPlane:
         self.engine = PolicyEngine(parse_policy(self.cfg.policy), now_fn=now_fn)
         self._overrides = {t: list(e) for t, e in (metrics_overrides or {}).items()}
         self._scrape_timeout = max(0.5, min(2.0, float(self.cfg.poll_s)))
+        # The controller's crash ring: every actuated scale lands here,
+        # so a fleetd incident bundle shows WHAT the control plane did
+        # around the alert (served via GET /debug/flight).
+        self.recorder = FlightRecorder(
+            "control", ring_size=self.obs_cfg.ring_size, dump_dir=self.obs_cfg.dump_dir
+        )
         self._lock = threading.Lock()
         self.decisions: collections.deque = collections.deque(maxlen=_LEDGER_CAP)
         self.topology_epoch = 0
@@ -171,6 +181,14 @@ class ControlPlane:
                     ev["tier"], ev["action"], ev["current"], ev["target"],
                     ev["reason"],
                 )
+                self.recorder.record(
+                    "scale",
+                    tier=ev["tier"],
+                    action=ev["action"],
+                    target=ev["target"],
+                    reason=ev["reason"],
+                    actuated=bool(actuation.get("actuated")),
+                )
             else:
                 holds += 1
             entries.append(entry)
@@ -202,6 +220,14 @@ class ControlPlane:
                 "ok": True,
                 "epoch": self.topology_epoch,
                 "tiers": self.driver.topology(),
+                # Scrape-surface map (obs ports, override lists first):
+                # what obs/fleetd discovers its aggregation targets from.
+                # Additive key — /topology consumers that only read
+                # "tiers" (serve/client.py) are unaffected.
+                "metrics": {
+                    tier: self._tier_endpoints(tier)
+                    for tier in self.driver.tiers()
+                },
             }
 
     def health(self) -> dict:
@@ -248,6 +274,7 @@ class ControlPlane:
             sources=[self.stats],
             health_provider=self.health,
             json_routes={"/topology": self.topology},
+            flight_provider=self.recorder.snapshot,
         ).start()
         self._thread = threading.Thread(target=self._run, daemon=True, name="control-loop")
         self._thread.start()

@@ -43,6 +43,7 @@ from dotaclient_tpu.eval.league import AGENT
 from dotaclient_tpu.eval.rating import Rating, RatingTable
 from dotaclient_tpu.league.policy import parse_match_policy
 from dotaclient_tpu.league.registry import CANDIDATE, SnapshotRegistry
+from dotaclient_tpu.obs.flight_recorder import FlightRecorder
 from dotaclient_tpu.obs.http import MetricsHTTPServer
 
 _log = logging.getLogger(__name__)
@@ -109,6 +110,11 @@ class LeagueService:
         self._http: Optional[MetricsHTTPServer] = None
         self._stop = threading.Event()
         self._fanout_thread: Optional[threading.Thread] = None
+        # Crash ring for fleetd's GET /debug/flight fan-in: promotions
+        # and gate verdicts are the league's load-bearing events.
+        self.recorder = FlightRecorder(
+            "league", ring_size=self.obs_cfg.ring_size, dump_dir=self.obs_cfg.dump_dir
+        )
         # Boot replay: the match log is the rating service's WAL.
         for rec in self.registry.iter_matches():
             self._ingest(rec, replay=True)
@@ -320,6 +326,9 @@ class LeagueService:
                             "league: promoted exploiter %s (%d/%d vs %s)",
                             cand, gate[0], gate[1], AGENT,
                         )
+                        self.recorder.record(
+                            "promotion", name=cand, wins=gate[0], games=gate[1]
+                        )
             return {"ok": True, "promoted": promoted}
 
     # ----------------------------------------------------------- queries
@@ -437,6 +446,7 @@ class LeagueService:
             },
             query_routes={"/match": self.match, "/snapshot": self.snapshot_get},
             post_routes={"/result": self.result, "/snapshot": self.snapshot_post},
+            flight_provider=self.recorder.snapshot,
         ).start()
         if str(self.cfg.broker_url):
             self._fanout_thread = threading.Thread(

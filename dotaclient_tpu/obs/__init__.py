@@ -183,6 +183,9 @@ class ObsRuntime:
             # capture() returns (path, clamped-window) atomically — the
             # obs/http.py handler echoes the window actually traced
             profile_handler=self.profiler.capture,
+            # GET /debug/flight: every ObsRuntime-served binary exposes
+            # its crash ring for fleetd's incident fan-in.
+            flight_provider=self.recorder.snapshot,
         ).start()
         return self.server
 

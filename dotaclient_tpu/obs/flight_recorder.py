@@ -68,6 +68,48 @@ class FlightRecorder:
         with self._lock:
             self._sections[name] = provider
 
+    # ---------------------------------------------------------- snapshot
+
+    def snapshot(self, max_events: int = 256, max_bytes: int = 262144) -> dict:
+        """Live, bounded view of the ring for the GET /debug/flight
+        route: the newest `max_events` events plus the dump sections,
+        trimmed (oldest-first) until the JSON encoding fits `max_bytes`.
+        Unlike dump() this never touches disk and never marks a reason —
+        fleetd's incident fan-in may hit every process in the fleet at
+        once and the route must stay O(bounded) per request."""
+        with self._lock:
+            events = list(self._ring)[-max(int(max_events), 0):]
+            recorded = self.events_recorded
+            providers = list(self._sections.items())
+        sections = {}
+        for name, provider in providers:
+            try:
+                sections[name] = provider()
+            except Exception:  # a recorder must never add a second failure
+                sections[name] = "<section provider failed>"
+        payload = {
+            "role": self.role,
+            "pid": os.getpid(),
+            "time": time.time(),
+            "events_recorded": recorded,
+            "truncated": False,
+            "events": events,
+            "sections": sections,
+        }
+        # Enforce the byte cap on the encoded form: drop oldest events
+        # first, then sections (events carry the incident timeline).
+        while len(json.dumps(payload, default=str)) > max_bytes:
+            if payload["events"]:
+                half = len(payload["events"]) // 2
+                payload["events"] = payload["events"][-half:] if half else []
+                payload["truncated"] = True
+            elif payload["sections"]:
+                payload["sections"] = {}
+                payload["truncated"] = True
+            else:
+                break
+        return payload
+
     # -------------------------------------------------------------- dump
 
     def dump(self, reason: str, once: bool = True) -> Optional[str]:

@@ -38,6 +38,7 @@ import logging
 import math
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
@@ -98,6 +99,7 @@ class MetricsHTTPServer:
         json_routes: Optional[Dict[str, Callable[[], Dict]]] = None,
         query_routes: Optional[Dict[str, Callable[[Dict], Dict]]] = None,
         post_routes: Optional[Dict[str, Callable[[bytes], Dict]]] = None,
+        flight_provider: Optional[Callable[..., Dict]] = None,
     ):
         self._sources: List[Callable[[], Dict[str, float]]] = list(sources or [])
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -117,6 +119,16 @@ class MetricsHTTPServer:
         # POST routes ("/result" ingestion): path → callable(body bytes)
         # → dict, same 400/500 error split as query_routes.
         self.post_routes: Dict[str, Callable[[bytes], Dict]] = dict(post_routes or {})
+        # GET /debug/flight: bounded JSON view of the process's
+        # FlightRecorder ring (FlightRecorder.snapshot, or any callable
+        # with the same (max_events=, max_bytes=) keywords). 404 when no
+        # recorder is wired — same contract as POST /profile.
+        self.flight_provider = flight_provider
+        # Boot-epoch fence for aggregators: every surface exports the
+        # wall-clock millisecond it came up, so a scraper can tell a
+        # counter RESET (process restart → epoch changed) from counter
+        # LOSS. Milliseconds because .10g rendering keeps them exact.
+        self._boot_epoch_ms = float(int(time.time() * 1000.0))
 
     def add_source(self, source: Callable[[], Dict[str, float]]) -> None:
         self._sources.append(source)
@@ -145,6 +157,7 @@ class MetricsHTTPServer:
                 out.update(source())
             except Exception:
                 _log.exception("metrics source failed; skipping for this scrape")
+        out["obs_boot_epoch_ms"] = self._boot_epoch_ms
         return out
 
     @property
@@ -178,6 +191,25 @@ class MetricsHTTPServer:
                 elif route == "/healthz":
                     body = server.health()
                     self._reply_json(200 if body.get("ok", True) else 503, body)
+                elif route == "/debug/flight":
+                    provider = server.flight_provider  # one atomic read
+                    if provider is None:
+                        self._reply_json(
+                            404, {"error": "no flight recorder wired on this surface"}
+                        )
+                        return
+                    params = parse_qs(urlparse(self.path).query)
+                    try:
+                        max_events = int(params.get("max_events", ["256"])[0])
+                    except ValueError:
+                        max_events = 256
+                    try:
+                        body = dict(provider(max_events=max_events))
+                    except Exception as e:
+                        _log.exception("flight snapshot failed")
+                        self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    self._reply_json(200, body)
                 elif route in server.json_routes:
                     try:
                         body = dict(server.json_routes[route]())

@@ -123,6 +123,13 @@ SCALARS: Dict[str, str] = {
     "actor_batch_occupancy": "mean real-rows / capacity of the batched inference tick",
     "actor_gather_wait_s": "mean per-tick wait assembling the batch (bounded by --gather_window_s)",
     "actor_jit_step_s": "mean per-tick batched jit inference latency (incl. the one device_get)",
+    # Producer conservation ledger (VectorActor.stats; obs/fleet.py
+    # audits attempted = published + shed + failed live):
+    "actor_publish_attempted_total": (
+        "rollout chunks this process tried to publish (published + shed "
+        "+ failed, derived from the same reads so the identity is exact)"
+    ),
+    "actor_rollouts_published_total": "rollout chunks acked by the broker (cumulative)",
     # --- inference service (dotaclient_tpu/serve/server.py) ------------
     "serve_requests_total": "policy-step requests handled (cumulative, all connections)",
     "serve_unknown_client_total": (
@@ -304,10 +311,16 @@ PREFIXES: Dict[str, str] = {
     # fanin_pop_threads, fanin_keys_tracked,
     # fanin_publish_failovers_total, fanin_publish_failed_total.
     "fanin_": "broker-fabric fan-in consumer ledgers (transport/fabric.py)",
-    # per-shard fabric meters: broker_shard_<i>_popped_total,
+    # per-shard fabric meters, TWO emitters: the learner-side fan-in
+    # consumer exports broker_shard_<i>_popped_total,
     # broker_shard_<i>_starved_s (pop thread idle/backing off against
-    # shard i — a starving shard index is the page), broker_shard_<i>_up.
-    # A family because the tail is the shard index.
+    # shard i — a starving shard index is the page), broker_shard_<i>_up
+    # (tail = the consumer's shard-list index); the shard BINARY's own
+    # --metrics_port surface exports the un-indexed ledger gauges
+    # broker_shard_enqueued_total/_popped_total/_dropped_total/
+    # _shed_total/_reply_lost_total/_evicted_low_total/_resident/_depth
+    # (transport/fabric.py shard_metrics_source — the fleet auditor's
+    # shard-ledger terms).
     "broker_shard_": "per-shard broker-fabric health (transport/fabric.py)",
     # broker admission control + actor publish degradation:
     # broker_shed_observed_total, broker_shed_publish_failed_total,
@@ -357,6 +370,19 @@ PREFIXES: Dict[str, str] = {
     # league_match_empty_total, league_bad_results_total,
     # league_fanout_snapshots_total, league_fanout_errors_total.
     "league_": "league population health (eval/league.py + dotaclient_tpu/league/)",
+    # fleet telemetry plane (dotaclient_tpu/obs/fleet.py FleetAggregator,
+    # served by obs/fleetd): fleet_targets(_up), fleet_polls_total,
+    # fleet_scrape_errors_total, fleet_fences_total,
+    # fleet_unaccounted_frames / fleet_overaccounted_frames /
+    # fleet_fenced_frames (the conservation-audit headline),
+    # fleet_ledger_<name>_* per ledger identity, fleet_tier_up_<tier>,
+    # fleet_e2e_env_steps_per_sec vs fleet_device_only_env_steps_per_sec
+    # and fleet_host_wall_gap (the committed 40x scoreboard, live),
+    # fleet_staleness_e2e_s_*, fleet_trace_<stage>_mean_ms,
+    # fleet_pipeline_*, fleet_serve_*, fleet_league_*, fleet_alerts_*,
+    # fleet_incidents_total, fleet_topology_*. A family: ledger names,
+    # tier names, and trace stages are data-dependent tails.
+    "fleet_": "fleet telemetry rollups + conservation audit (dotaclient_tpu/obs/fleet.py)",
 }
 
 

@@ -1086,16 +1086,23 @@ class VectorActor:
         out = self.batcher.stats()
         # Fleet-wide publish-degradation meters (broker_shed_* family):
         # each env slot throttles itself, the gauges sum the fleet.
-        shed = failed = 0
+        shed = failed = published = 0
         throttle_s = 0.0
         for e in self.envs:
             t = e.publish_throttle
             shed += t.shed
             failed += t.failed
+            published += e.rollouts_published
             throttle_s += t.throttle_s
         out["broker_shed_observed_total"] = float(shed)
         out["broker_shed_publish_failed_total"] = float(failed)
         out["broker_shed_throttle_s"] = throttle_s
+        # Producer conservation ledger (obs/fleet.py "producer"):
+        # attempted = published + shed + failed, derived from the SAME
+        # per-slot reads so the identity holds exactly per scrape — the
+        # fleet auditor's zero-unaccounted baseline for this tier.
+        out["actor_rollouts_published_total"] = float(published)
+        out["actor_publish_attempted_total"] = float(published + shed + failed)
         return out
 
     def maybe_update_weights(self) -> bool:

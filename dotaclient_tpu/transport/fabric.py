@@ -645,6 +645,31 @@ class FabricBroker(Broker):
 # ------------------------------------------------------------------ binary
 
 
+def shard_metrics_source(server):
+    """The shard binary's OWN scrape source: the BrokerServer ledger as
+    broker_shard_* gauges (registry family; exact names — no shard-index
+    tail, each pod is one shard and the scraper knows which). These are
+    the fleet auditor's shard-ledger terms: enqueued = popped + dropped +
+    evicted_low + resident at any quiescent point (transport/tcp.py).
+    Distinct from the LEARNER-side broker_shard_<i>_* fan-in gauges —
+    those index the consumer's shard list; these are the shard's truth."""
+
+    def source():
+        led = server.ledger()
+        return {
+            "broker_shard_enqueued_total": float(led["enqueued"]),
+            "broker_shard_popped_total": float(led["popped"]),
+            "broker_shard_dropped_total": float(led["dropped_oldest"]),
+            "broker_shard_shed_total": float(led["shed"]),
+            "broker_shard_reply_lost_total": float(led["reply_lost"]),
+            "broker_shard_evicted_low_total": float(led["evicted_low"]),
+            "broker_shard_resident": float(led["resident"]),
+            "broker_shard_depth": float(led["resident"]),
+        }
+
+    return source
+
+
 def main(argv=None):
     """One fabric shard: a BrokerServer with the priority-admission
     flags. The k8s/broker.yaml StatefulSet runs one of these per pod."""
@@ -673,6 +698,12 @@ def main(argv=None):
         "--prio_half_life_s", type=float, default=8.0,
         help="age half-life of the eviction priority decay, seconds",
     )
+    p.add_argument(
+        "--metrics_port", type=int, default=0,
+        help="obs scrape surface port: /metrics (broker_shard_* ledger "
+        "gauges), /healthz, /debug/flight (0 = no surface, the pre-"
+        "fleet-telemetry behavior; k8s/broker.yaml pins 9100)",
+    )
     args = p.parse_args(argv)
     server = BrokerServer(
         args.host,
@@ -683,11 +714,30 @@ def main(argv=None):
         priority_shed=args.priority,
         prio_half_life_s=args.prio_half_life_s,
     ).start()
+    obs_http = None
+    if args.metrics_port != 0:
+        # Deliberately lazy: a shard without --metrics_port never
+        # imports the obs package (the pre-fleet-telemetry footprint).
+        from dotaclient_tpu.obs.flight_recorder import FlightRecorder
+        from dotaclient_tpu.obs.http import MetricsHTTPServer
+
+        recorder = FlightRecorder("fabric_shard")
+        # The snapshot's sections carry the full conservation ledger —
+        # an incident bundle then shows this shard's exact accounting
+        # at fan-in time, not a stale scrape.
+        recorder.add_section("ledger", server.ledger)
+        recorder.record("boot", port=server.port, maxlen=args.maxlen)
+        obs_http = MetricsHTTPServer(
+            args.metrics_port,
+            sources=[shard_metrics_source(server)],
+            flight_provider=recorder.snapshot,
+        ).start()
     shed = f", shed {args.shed_high}/{args.shed_low}" if args.shed_high else ""
     prio = ", priority admission" if args.priority else ""
+    obs_note = f", obs :{obs_http.port}" if obs_http is not None else ""
     print(
         f"fabric shard listening on {args.host}:{server.port} "
-        f"(queue bound {args.maxlen}{shed}{prio})",
+        f"(queue bound {args.maxlen}{shed}{prio}{obs_note})",
         flush=True,
     )
     try:
@@ -695,6 +745,8 @@ def main(argv=None):
             time.sleep(60)
     except KeyboardInterrupt:
         server.stop()
+        if obs_http is not None:
+            obs_http.stop()
 
 
 if __name__ == "__main__":

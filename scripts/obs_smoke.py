@@ -1,7 +1,11 @@
 """Nightly obs smoke: drive a short real learner and curl its whole HTTP
-surface — GET /metrics, GET /healthz, POST /profile?seconds=N — then
-stand up an inference server (dotaclient_tpu/serve/), push one remote
-policy step through it, and curl ITS /metrics + /healthz too.
+surface — GET /metrics, GET /healthz, GET /debug/flight, POST
+/profile?seconds=N — stand up the fleet telemetry aggregator
+(obs/fleetd FleetDaemon) against the live learner and curl ITS /fleet +
+/metrics + /debug/flight (the conservation audit must read ZERO
+unaccounted frames), then stand up an inference server
+(dotaclient_tpu/serve/), push one remote policy step through it, and
+curl its /metrics + /healthz + /debug/flight too.
 
 The tier-1 tests cover each endpoint in isolation; this exercises the
 deployed composition: one learner process with --obs.enabled, the
@@ -135,6 +139,13 @@ def main() -> int:
             trace_files = (
                 [f for _, _, fs in os.walk(trace_dir) for f in fs] if trace_dir else []
             )
+            # The learner's crash ring over HTTP: the route every fleetd
+            # incident bundle fans in from.
+            flight = json.loads(
+                urllib.request.urlopen(f"{base}/debug/flight", timeout=10).read()
+            )
+            # ---- fleet telemetry plane against the LIVE learner -------
+            fleet = _fleet_smoke(port)
             out = {
                 "ok": (
                     steps == 20
@@ -142,11 +153,15 @@ def main() -> int:
                     and health.get("ok") is True
                     and health.get("watchdog", {}).get("enabled") is True
                     and bool(trace_files)
+                    and flight.get("role") == "learner"
+                    and bool(fleet.get("ok"))
                 ),
                 "steps": steps,
                 "metrics_scalars": len(scalar_names),
                 "missing_required_scalars": missing,
                 "healthz": health,
+                "flight_events_recorded": flight.get("events_recorded"),
+                "fleet": fleet,
                 "profile_trace_dir": trace_dir,
                 "profile_trace_files": len(trace_files),
                 "profile_error": profile_result.get("error"),
@@ -167,6 +182,75 @@ def main() -> int:
     out["ok"] = bool(out.get("ok")) and bool(serve_out.get("ok"))
     print(json.dumps(out))
     return 0 if out["ok"] else 1
+
+
+def _fleet_smoke(learner_port: int) -> dict:
+    """Stand up the fleet telemetry aggregator against the LIVE learner
+    surface and curl its whole interface: /fleet (the audit must read
+    zero unaccounted frames), /metrics (fleet_* family), /debug/flight.
+    A learner-only fleet has no producer or broker tiers, so those
+    ledgers report "absent" — present-but-nonzero unaccounted would be
+    an auditor bug, which is exactly what this section pins."""
+    from dotaclient_tpu.config import FleetConfig
+    from dotaclient_tpu.obs.fleetd import FleetDaemon
+
+    cfg = FleetConfig()
+    cfg.fleet.port = 0
+    cfg.fleet.poll_s = 0.2
+    cfg.fleet.stale_s = 5.0
+    cfg.fleet.learners = f"127.0.0.1:{learner_port}"
+    cfg.obs.enabled = True
+    cfg.obs.install_handlers = False
+    daemon = FleetDaemon(cfg).start()
+    try:
+        base = f"http://127.0.0.1:{daemon.port}"
+        report: dict = {}
+        deadline = time.time() + 15.0
+        while time.time() < deadline:  # a few audit windows
+            report = json.loads(
+                urllib.request.urlopen(f"{base}/fleet", timeout=10).read()
+            )
+            ups = [t for t in report.get("targets", {}).values() if t.get("up")]
+            if report.get("polls", 0) >= 3 and ups:
+                break
+            time.sleep(0.2)
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+        scalars = {
+            ln.split()[0]: float(ln.split()[1])
+            for ln in body.splitlines()
+            if ln and not ln.startswith("#")
+        }
+        flight = json.loads(
+            urllib.request.urlopen(f"{base}/debug/flight", timeout=10).read()
+        )
+        ledgers = report.get("ledgers") or {}
+        slo = report.get("slo") or {}
+        return {
+            "ok": (
+                report.get("ok") is True
+                and report.get("polls", 0) >= 3
+                and any(t.get("up") for t in report.get("targets", {}).values())
+                and bool(ledgers)
+                and all(
+                    entry.get("status") in ("ok", "absent")
+                    for entry in ledgers.values()
+                )
+                and slo.get("fleet_unaccounted_frames") == 0.0
+                and scalars.get("dotaclient_fleet_unaccounted_frames") == 0.0
+                and scalars.get("dotaclient_fleet_targets_up", 0.0) >= 1.0
+                and flight.get("role") == "fleetd"
+            ),
+            "polls": report.get("polls"),
+            "targets_up": sum(
+                1 for t in report.get("targets", {}).values() if t.get("up")
+            ),
+            "ledgers": {k: v.get("status") for k, v in ledgers.items()},
+            "unaccounted_frames": slo.get("fleet_unaccounted_frames"),
+            "e2e_env_steps_per_sec": slo.get("fleet_e2e_env_steps_per_sec"),
+            "metrics_scalars": len(scalars),
+        }
+    finally:
+        daemon.stop()
 
 
 def _serve_smoke() -> dict:
@@ -213,6 +297,9 @@ def _serve_smoke() -> dict:
         base = f"http://127.0.0.1:{mport}"
         body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
         health = json.loads(urllib.request.urlopen(f"{base}/healthz", timeout=10).read())
+        flight = json.loads(
+            urllib.request.urlopen(f"{base}/debug/flight", timeout=10).read()
+        )
         names = {ln.split()[0] for ln in body.splitlines() if ln and not ln.startswith("#")}
         required = {
             "dotaclient_serve_requests_total",
@@ -224,7 +311,7 @@ def _serve_smoke() -> dict:
         missing = sorted(required - names)
         return {
             "ok": resp.status == 0 and not missing and health.get("ok") is True
-            and health.get("role") == "serve",
+            and health.get("role") == "serve" and flight.get("role") == "serve",
             "metrics_scalars": len(names),
             "missing_required_scalars": missing,
             "healthz": health,

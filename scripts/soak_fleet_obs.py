@@ -1,0 +1,653 @@
+"""Fleet telemetry soak: the standing audit catches what it must and
+stays silent otherwise → FLEET_OBS_SOAK.json.
+
+The PR-18 fleet plane (dotaclient_tpu/obs/fleet.py + fleetd) promotes
+the soak scripts' POST-HOC conservation ledgers to a LIVE service.
+This soak is its proof, with real components at every layer:
+
+- TWO broker fabric shards as REAL SUBPROCESSES (`python -m
+  dotaclient_tpu.transport.fabric --metrics_port ...` — the exact
+  k8s/broker.yaml invocation), each serving its broker_shard_* ledger
+  and /debug/flight;
+- two producer threads (real TcpBroker publishes, rendezvous-routed,
+  actor_publish_* counters + flight ring on an obs surface) and one
+  learner-shaped consumer (real pops, wire_frames_obs_bf16_total on
+  its own surface) — the fleet's scrape vocabulary, end to end;
+- ONE ControlPlane whose /topology "metrics" map advertises the
+  learner tier (fleetd DISCOVERS the consumer; shards and producers
+  ride the literal comma-lists — the rollback path, exercised
+  together), and whose policy scales a tier on a METER FLEETD SERVES;
+- ONE in-process FleetDaemon — the fleetd binary's exact shape —
+  polling, auditing, alerting, fanning in.
+
+Four bars, one run:
+1. CLEAN + CHAOS: steady traffic with a scrape-synchronized rolling
+   restart of shard-0 (traffic frozen for a poll so the pre-kill
+   ledger is on the wire — the drained-preStop restart k8s promises).
+   The restart must read as a FENCE: its resident frames land in
+   fleet_fenced_frames (known restart loss, byte-for-byte the level
+   fleetd last scraped) and unaccounted stays ZERO after quiesce.
+2. INJECTED LOSS: a rogue consumer steals frames from shard-1
+   (popped increments, no wire count — delivery-path loss). The
+   delivery ledger must flag EXACTLY the stolen count within one
+   poll window of the theft.
+3. ALERT → FAN-IN: the standing unaccounted alert fires on the loss
+   and the incident bundle must hold /debug/flight snapshots from
+   MULTIPLE OS PROCESSES (the shard subprocesses + this one) with a
+   populated trace_id index.
+4. CONTROL ON FLEET METERS: the control plane's policy clause reads
+   fleet_unaccounted_frames OFF FLEETD'S OWN /metrics and scales the
+   learner tier up, with the meter value in the decision ledger —
+   ROADMAP item 5's named remaining scope, closed.
+
+Alert threshold note: under continuous flow the delivery ledger
+wobbles by the frames in flight between the two scrape instants
+(±1-2); the soak alert uses gt,2.5 so the clean arm cannot page while
+a 12-frame theft clears the bar in one window. Stdlib + transport
+only — no jax anywhere in this soak.
+
+Run: python scripts/soak_fleet_obs.py                       # committed artifact
+     python scripts/soak_fleet_obs.py --quick --out /tmp/x  # nightly wrapper
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEAL = 12  # frames the rogue consumer steals (must clear gt,2.5 alert)
+ALERTS = "fleet_unaccounted_frames,gt,2.5,for=2"
+POLICY = (
+    # Scale the learner tier on a meter only fleetd serves. low=-1:
+    # unaccounted is never negative, so the clause can only scale up.
+    "learner:fleet_unaccounted_frames.max,high=2.5,low=-1,min=1,max=2,step=1,cooldown=60"
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_json(endpoint: str, route: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(f"http://{endpoint}{route}", timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8", "replace"))
+
+
+class ShardProc:
+    """One broker fabric shard SUBPROCESS on pinned ports, restartable
+    in place (same DNS identity — the StatefulSet restart shape)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.port = _free_port()
+        self.obs_port = _free_port()
+        self.proc = None
+        self.launches = 0
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def obs_endpoint(self) -> str:
+        return f"127.0.0.1:{self.obs_port}"
+
+    def launch(self, deadline_s: float = 20.0) -> None:
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dotaclient_tpu.transport.fabric",
+                "--host", "127.0.0.1",
+                "--port", str(self.port),
+                "--maxlen", "100000",
+                "--metrics_port", str(self.obs_port),
+            ],
+            cwd=REPO,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        self.launches += 1
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            try:  # obs comes up after the broker socket — one probe covers both
+                _get_json(self.obs_endpoint, "/healthz", timeout=1.0)
+                return
+            except Exception:
+                if self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"shard {self.index} exited rc={self.proc.returncode}"
+                    )
+                time.sleep(0.05)
+        raise RuntimeError(f"shard {self.index} never came up on :{self.obs_port}")
+
+    def kill(self) -> None:
+        self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.kill()
+
+
+class Producer:
+    """One actor-shaped publisher: rendezvous-routes every chunk over
+    the shard list via real TcpBroker clients, keeps the PR-6 publish
+    ledger (attempted = published + shed + failed), serves it on an obs
+    surface, and stamps a trace_id into every payload + its flight ring
+    (the incident bundle's correlation key)."""
+
+    def __init__(self, wid: int, shards, gate: threading.Event):
+        from dotaclient_tpu.obs.flight_recorder import FlightRecorder
+        from dotaclient_tpu.obs.http import MetricsHTTPServer
+
+        self.wid = wid
+        self.shards = shards
+        self.gate = gate
+        self.stop_ev = threading.Event()
+        self.attempted = 0
+        self.published = 0
+        self.failed = 0
+        self.shed = 0
+        self._clients = {}
+        self.recorder = FlightRecorder("actor")
+        self.obs = MetricsHTTPServer(
+            0, sources=[self._stats], flight_provider=self.recorder.snapshot
+        ).start()
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name=f"soak-producer-{wid}"
+        )
+
+    def _stats(self) -> dict:
+        return {
+            "actor_publish_attempted_total": float(self.attempted),
+            "actor_rollouts_published_total": float(self.published),
+            "broker_shed_observed_total": float(self.shed),
+            "broker_shed_publish_failed_total": float(self.failed),
+        }
+
+    def _client(self, shard):
+        c = self._clients.get(shard.index)
+        if c is None:
+            from dotaclient_tpu.transport.base import RetryPolicy
+            from dotaclient_tpu.transport.tcp import TcpBroker
+
+            c = TcpBroker(port=shard.port, retry=RetryPolicy(window_s=1.0))
+            self._clients[shard.index] = c
+        return c
+
+    def _run(self) -> None:
+        from dotaclient_tpu.transport.fabric import rendezvous_order
+
+        names = [s.endpoint for s in self.shards]
+        seq = 0
+        while not self.stop_ev.is_set():
+            if not self.gate.wait(timeout=0.2):
+                continue
+            trace_id = self.wid * 1_000_000 + seq
+            payload = struct.pack(">q", trace_id) + bytes(120)
+            shard = self.shards[rendezvous_order(trace_id, names)[0]]
+            self.attempted += 1
+            try:
+                self._client(shard).publish_experience(payload)
+                self.published += 1
+                if seq % 8 == 0:
+                    self.recorder.record(
+                        "publish", trace=trace_id, shard=shard.endpoint
+                    )
+            except Exception as e:
+                self.failed += 1
+                self._clients.pop(shard.index, None)
+                self.recorder.record(
+                    "publish_failed", trace=trace_id, error=type(e).__name__
+                )
+            seq += 1
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        self.stop_ev.set()
+        if self.thread.ident is not None:
+            self.thread.join(timeout=10)
+        for c in self._clients.values():
+            c.close()
+        self.obs.stop()
+
+
+class Consumer:
+    """The learner-shaped intake: pops every shard, counts each item as
+    one wire frame under the EXACT staging-intake meter name, and serves
+    the counter + a throughput gauge on its obs surface (the tier fleetd
+    discovers via /topology rather than a literal list)."""
+
+    def __init__(self, shards, gate: threading.Event):
+        from dotaclient_tpu.obs.flight_recorder import FlightRecorder
+        from dotaclient_tpu.obs.http import MetricsHTTPServer
+
+        self.shards = shards
+        self.gate = gate
+        self.stop_ev = threading.Event()
+        self.wire = 0
+        self._t0 = time.monotonic()
+        self._clients = {}
+        self.recorder = FlightRecorder("learner")
+        self.obs = MetricsHTTPServer(
+            0, sources=[self._stats], flight_provider=self.recorder.snapshot
+        ).start()
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name="soak-consumer"
+        )
+
+    def _stats(self) -> dict:
+        elapsed = max(time.monotonic() - self._t0, 1e-6)
+        return {
+            "wire_frames_obs_bf16_total": float(self.wire),
+            "env_steps_per_sec": float(self.wire) / elapsed,
+        }
+
+    def _client(self, shard):
+        c = self._clients.get(shard.index)
+        if c is None:
+            from dotaclient_tpu.transport.base import RetryPolicy
+            from dotaclient_tpu.transport.tcp import TcpBroker
+
+            c = TcpBroker(port=shard.port, retry=RetryPolicy(window_s=1.0))
+            self._clients[shard.index] = c
+        return c
+
+    def _run(self) -> None:
+        while not self.stop_ev.is_set():
+            if not self.gate.wait(timeout=0.2):
+                continue
+            for shard in self.shards:
+                try:
+                    got = self._client(shard).consume_experience(32, timeout=0.02)
+                except Exception:
+                    self._clients.pop(shard.index, None)
+                    continue
+                for item in got:
+                    (trace_id,) = struct.unpack(">q", item[:8])
+                    self.wire += 1
+                    if self.wire % 8 == 0:
+                        self.recorder.record("consume", trace=trace_id)
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        self.stop_ev.set()
+        if self.thread.ident is not None:
+            self.thread.join(timeout=10)
+        for c in self._clients.values():
+            c.close()
+        self.obs.stop()
+
+
+class StubTier:
+    """Minimal InProcessDriver router: the thing the policy scales."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.history = [n]
+
+    def replica_count(self) -> int:
+        return self.n
+
+    def scale_to(self, n: int) -> None:
+        self.n = int(n)
+        self.history.append(self.n)
+
+
+def _wait(pred, deadline_s: float, interval_s: float = 0.1):
+    """Poll pred() until truthy; returns the last value (falsy on timeout)."""
+    deadline = time.monotonic() + deadline_s
+    value = pred()
+    while not value and time.monotonic() < deadline:
+        time.sleep(interval_s)
+        value = pred()
+    return value
+
+
+def _resident(obs_endpoint: str) -> float:
+    from dotaclient_tpu.control.scrape import scrape_endpoint
+
+    sample = scrape_endpoint(obs_endpoint) or {}
+    return sample.get("broker_shard_resident", -1.0)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="FLEET_OBS_SOAK.json")
+    p.add_argument("--traffic-s", type=float, default=2.5,
+                   help="steady clean traffic before and after the restart")
+    p.add_argument("--poll-s", type=float, default=0.3)
+    p.add_argument("--deadline-s", type=float, default=25.0,
+                   help="per-wait bound (fence seen, loss flagged, ...)")
+    p.add_argument("--quick", action="store_true",
+                   help="nightly-wrapper scale: shorter traffic, same invariants")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.traffic_s = 1.2
+
+    from dotaclient_tpu.config import ControlConfig, ControlLoopConfig, FleetConfig
+    from dotaclient_tpu.control.drivers import InProcessDriver
+    from dotaclient_tpu.control.server import ControlPlane
+    from dotaclient_tpu.obs.fleetd import FleetDaemon
+    from dotaclient_tpu.obs.preflight import check as preflight_check
+    from dotaclient_tpu.transport.base import RetryPolicy
+    from dotaclient_tpu.transport.tcp import TcpBroker
+
+    host_preflight = preflight_check("soak_fleet_obs")
+
+    import tempfile
+
+    bundle_dir = tempfile.mkdtemp(prefix="fleet_soak_incidents_")
+    shards = [ShardProc(i) for i in range(2)]
+    for s in shards:
+        s.launch()
+
+    producer_gate = threading.Event()
+    consumer_gate = threading.Event()
+    producers = [Producer(wid, shards, producer_gate) for wid in range(2)]
+    consumer = Consumer(shards, consumer_gate)
+
+    # -- control plane: advertises the learner tier via /topology (fleetd
+    # DISCOVERY) and scales it on a meter only fleetd serves.
+    learner_tier = StubTier(1)
+    learner_metric_eps = [f"127.0.0.1:{consumer.obs.port}"]
+    driver = InProcessDriver(
+        {"learner": learner_tier},
+        metrics={"learner": lambda: list(learner_metric_eps)},
+    )
+    plane = ControlPlane(
+        ControlConfig(
+            control=ControlLoopConfig(port=0, poll_s=args.poll_s, policy=POLICY)
+        ),
+        driver,
+    ).start()
+
+    # -- fleetd: the binary's exact in-process shape. Shards + producers
+    # ride the literal lists (the rollback path); the consumer arrives
+    # ONLY via /topology discovery. Anchored BEFORE traffic opens so the
+    # audit baselines at a quiescent fleet and every later quiesce must
+    # close to exactly zero.
+    fcfg = FleetConfig()
+    fcfg.fleet.port = 0
+    fcfg.fleet.poll_s = args.poll_s
+    fcfg.fleet.stale_s = 3.0
+    fcfg.fleet.control = f"127.0.0.1:{plane.port}"
+    fcfg.fleet.brokers = ",".join(s.obs_endpoint for s in shards)
+    fcfg.fleet.actors = ",".join(f"127.0.0.1:{pr.obs.port}" for pr in producers)
+    fcfg.fleet.alerts = ALERTS
+    fcfg.fleet.bundle_dir = bundle_dir
+    daemon = FleetDaemon(fcfg).start()
+    fleet_ep = f"127.0.0.1:{daemon.port}"
+    # the policy's meter source: fleetd joins the learner tier's scrape
+    # list, so the controller reads fleet_unaccounted_frames.max off it
+    # (and fleetd discovers — and audits — itself, which must be inert).
+    learner_metric_eps.append(fleet_ep)
+
+    def fleet():
+        return _get_json(fleet_ep, "/fleet")
+
+    def slo(name: str, default: float = 0.0) -> float:
+        return fleet().get("slo", {}).get(name, default)
+
+    timeline = []
+
+    def mark(event: str, **extra):
+        timeline.append({"t": round(time.monotonic() - t0, 2), "event": event, **extra})
+
+    t0 = time.monotonic()
+    errors = []
+    try:
+        ok_anchor = _wait(lambda: fleet().get("polls", 0) >= 2, args.deadline_s)
+        if not ok_anchor:
+            errors.append("fleetd never completed its anchor polls")
+
+        # ---- phase A: clean traffic + scrape-synchronized rolling restart
+        producer_gate.set()
+        consumer_gate.set()
+        for pr in producers:
+            pr.thread.start()
+        consumer.thread.start()
+        mark("traffic_open")
+        time.sleep(args.traffic_s)
+
+        # Freeze traffic so the pre-kill ledger is scraped: consumer
+        # first (resident builds on both shards), then producers, then
+        # two poll windows of stillness.
+        consumer_gate.clear()
+        time.sleep(0.8)
+        producer_gate.clear()
+        time.sleep(3.5 * args.poll_s)
+        r0 = _resident(shards[0].obs_endpoint)
+        polls_at_kill = fleet().get("polls", 0)
+        shards[0].kill()
+        mark("shard0_killed", resident_at_kill=r0)
+        # at least one poll must SEE the outage (stale freeze, no alarm)
+        _wait(lambda: fleet().get("polls", 0) >= polls_at_kill + 2, args.deadline_s)
+        shards[0].launch()
+        mark("shard0_relaunched")
+        fence_seen = _wait(
+            lambda: slo("fleet_fences_total") >= 1.0, args.deadline_s
+        )
+        if not fence_seen:
+            errors.append("restart never read as a fence")
+        producer_gate.set()
+        consumer_gate.set()
+        time.sleep(args.traffic_s * 0.6)
+
+        # Quiesce A: stop producing, drain everything, let the audit
+        # settle — the clean arm's bar.
+        producer_gate.clear()
+        drained = _wait(
+            lambda: all(_resident(s.obs_endpoint) == 0.0 for s in shards),
+            args.deadline_s,
+        )
+        if not drained:
+            errors.append("shards never drained after phase A")
+        polls_q = fleet().get("polls", 0)
+        _wait(lambda: fleet().get("polls", 0) >= polls_q + 3, args.deadline_s)
+        report_a = fleet()
+        mark("phase_a_quiesced")
+
+        # ---- phase B: injected loss → detect → alert → fan-in → scale
+        producer_gate.set()
+        time.sleep(0.8)  # resident builds again (consumer still draining)
+        consumer_gate.clear()
+        time.sleep(0.8)  # stock shard-1 for the theft
+        producer_gate.clear()
+        time.sleep(3.5 * args.poll_s)  # stable windows around the theft
+        polls_at_steal = fleet().get("polls", 0)
+        rogue = TcpBroker(port=shards[1].port, retry=RetryPolicy(window_s=1.0))
+        stolen = 0
+        steal_deadline = time.monotonic() + args.deadline_s
+        while stolen < STEAL and time.monotonic() < steal_deadline:
+            stolen += len(rogue.consume_experience(STEAL - stolen, timeout=0.5))
+        rogue.close()
+        mark("frames_stolen", stolen=stolen)
+        if stolen != STEAL:
+            errors.append(f"rogue consumer only got {stolen}/{STEAL} frames")
+
+        detected = _wait(
+            lambda: slo("fleet_unaccounted_frames") >= STEAL - 0.5,
+            args.deadline_s,
+            interval_s=0.05,
+        )
+        polls_at_detect = fleet().get("polls", 0)
+        mark("loss_detected", polls_elapsed=polls_at_detect - polls_at_steal)
+        if not detected:
+            errors.append("injected loss never flagged")
+
+        fired = _wait(
+            lambda: slo("fleet_alerts_fired_total") >= 1.0, args.deadline_s
+        )
+        incidents = _wait(lambda: fleet().get("incidents"), args.deadline_s)
+        if not fired or not incidents:
+            errors.append("alert never fired / no incident bundle")
+        bundle = {}
+        if incidents:
+            with open(incidents[-1]) as f:
+                bundle = json.load(f)
+
+        scaled = _wait(
+            lambda: [
+                d
+                for d in plane.ledger()
+                if d["action"] == "up" and d["meter"] == "fleet_unaccounted_frames.max"
+            ],
+            args.deadline_s,
+        )
+        mark("control_scaled", moves=len(scaled or []))
+
+        # Final quiesce: the fleet must close to EXACTLY the stolen
+        # frames — loss reported precisely, nothing else accumulated.
+        consumer_gate.set()
+        _wait(
+            lambda: all(_resident(s.obs_endpoint) == 0.0 for s in shards),
+            args.deadline_s,
+        )
+        polls_f = fleet().get("polls", 0)
+        _wait(lambda: fleet().get("polls", 0) >= polls_f + 3, args.deadline_s)
+        report_b = fleet()
+        mark("final_quiesce")
+    finally:
+        producer_gate.set()  # never leave threads parked on a cleared gate
+        consumer_gate.set()
+        for pr in producers:
+            pr.close()
+        consumer.close()
+        daemon.stop()
+        plane.stop()
+        for s in shards:
+            s.stop()
+
+    produced = sum(pr.published for pr in producers)
+    consumed = consumer.wire
+    fenced = report_b["slo"]["fleet_fenced_frames"]
+    flights = (bundle.get("flights") or {}) if bundle else {}
+    flight_pids = {
+        v.get("pid") for v in flights.values() if isinstance(v, dict) and "pid" in v
+    }
+    flight_roles = sorted(
+        {v.get("role") for v in flights.values() if isinstance(v, dict)}
+    )
+
+    ledgers_a = report_a.get("ledgers", {})
+    ledgers_b = report_b.get("ledgers", {})
+    verdict = {
+        # bar 1: clean arm closes to zero across the rolling restart
+        "clean_arm_zero_unaccounted": (
+            report_a["slo"]["fleet_unaccounted_frames"] == 0.0
+            and report_a["slo"]["fleet_overaccounted_frames"] == 0.0
+            and all(
+                entry["status"] == "ok" for entry in ledgers_a.values()
+            )
+        ),
+        "restart_read_as_fence_not_loss": (
+            report_a["slo"]["fleet_fences_total"] >= 1.0
+            and report_a["slo"]["fleet_fenced_frames"] == r0
+            and r0 > 0.0
+        ),
+        "producer_ledger_balanced": all(
+            pr.attempted == pr.published + pr.shed + pr.failed for pr in producers
+        ),
+        # discovery really fed the audit: the consumer arrived only via
+        # the control plane's /topology "metrics" map
+        "topology_discovery_served_learner_tier": (
+            any(k.startswith("learner/") for k in report_b.get("targets", {}))
+            and report_b["slo"]["fleet_topology_refreshes_total"] >= 1.0
+        ),
+        # bar 2: the theft is flagged within one poll window (<=2 polls:
+        # the window in flight at steal time plus the one that sees it)
+        "loss_flagged_within_one_poll_window": bool(detected)
+        and polls_at_detect - polls_at_steal <= 2,
+        "loss_closes_to_exact_stolen_count": (
+            report_b["slo"]["fleet_unaccounted_frames"] == float(STEAL)
+            and ledgers_b.get("delivery", {}).get("status") == "alarm"
+            and ledgers_b.get("shard", {}).get("status") == "ok"
+        ),
+        # bar 3: fired alert → one bundle, flights from >1 OS process,
+        # trace ids correlated across roles
+        "alert_fired_on_loss": bool(fired)
+        and report_b["slo"]["fleet_alerts_fired_total"] >= 1.0,
+        "incident_bundle_multi_process": len(flight_pids) >= 2
+        and len([v for v in flights.values() if v]) >= 4,
+        "incident_bundle_trace_indexed": bool(bundle.get("trace_index")),
+        # bar 4: the control plane scaled on a fleetd-served meter, and
+        # the decision carries the value that justified it
+        "control_scaled_on_fleet_meter": bool(scaled)
+        and scaled[0]["value"] is not None
+        and scaled[0]["value"] > 2.5
+        and scaled[0]["actuation"]["actuated"] is True
+        and learner_tier.n == 2,
+        "fleet_closes_end_to_end": produced == consumed + int(fenced) + STEAL,
+        "no_errors": not errors,
+        "frames_published": produced,
+        "frames_consumed": consumed,
+        "frames_fenced": fenced,
+        "frames_stolen": STEAL,
+    }
+    artifact = {
+        "host": (
+            "single host: 2 fabric-shard SUBPROCESSES (the k8s/broker.yaml "
+            "invocation) + in-process producers/consumer/control-plane/"
+            "fleetd over real HTTP + real TCP (stdlib only, no jax)"
+        ),
+        "host_preflight": host_preflight,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "alerts": ALERTS,
+        "policy": POLICY,
+        "poll_s": args.poll_s,
+        "timeline": timeline,
+        "phase_a": {
+            "ledgers": ledgers_a,
+            "slo": {
+                k: v
+                for k, v in report_a.get("slo", {}).items()
+                if k.startswith("fleet_")
+            },
+            "resident_at_kill": r0,
+            "shard0_launches": shards[0].launches,
+        },
+        "phase_b": {
+            "ledgers": ledgers_b,
+            "slo": {
+                k: v
+                for k, v in report_b.get("slo", {}).items()
+                if k.startswith("fleet_")
+            },
+            "polls_at_steal": polls_at_steal,
+            "polls_at_detect": polls_at_detect,
+            "alerts": report_b.get("alerts"),
+            "incident_bundles": len(incidents or []),
+            "bundle_flight_roles": flight_roles,
+            "bundle_flight_pids": len(flight_pids),
+            "bundle_trace_ids": len(bundle.get("trace_index", {})),
+        },
+        "control": {
+            "moves": scaled or [],
+            "learner_replica_history": learner_tier.history,
+        },
+        "errors": errors,
+    }
+    artifact["verdict"] = verdict
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(verdict, indent=2))
+    return 0 if all(v for v in verdict.values() if isinstance(v, bool)) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

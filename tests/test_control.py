@@ -155,7 +155,11 @@ def test_scrape_endpoint_and_health_against_real_surface():
                             health_provider=lambda: dict(health)).start()
     try:
         ep = f"127.0.0.1:{srv.port}"
-        assert scrape_endpoint(ep) == {"serve_load_occupancy": 0.5}
+        first = scrape_endpoint(ep)
+        # every surface now also exports the fleet plane's restart fence
+        assert first["serve_load_occupancy"] == 0.5
+        assert first["obs_boot_epoch_ms"] > 0
+        assert set(first) == {"serve_load_occupancy", "obs_boot_epoch_ms"}
         gauges["serve_load_occupancy"] = 0.9  # live: sampled per scrape
         assert scrape_endpoint(ep)["serve_load_occupancy"] == 0.9
         ok, body = scrape_health(ep)

@@ -46,6 +46,7 @@ def test_manifests_exist():
         "inference.yaml",
         "control.yaml",
         "league.yaml",
+        "fleetd.yaml",
     } <= names
     assert (K8S / "Dockerfile").exists()
 
@@ -99,7 +100,12 @@ def test_flags_are_real_config_fields():
     from dotaclient_tpu.config import ActorConfig, EvalConfig, LearnerConfig, add_flags
     import argparse
 
-    from dotaclient_tpu.config import ControlConfig, InferenceConfig, LeagueConfig
+    from dotaclient_tpu.config import (
+        ControlConfig,
+        FleetConfig,
+        InferenceConfig,
+        LeagueConfig,
+    )
 
     known = {
         "dotaclient_tpu.runtime.learner": LearnerConfig(),
@@ -108,6 +114,7 @@ def test_flags_are_real_config_fields():
         "dotaclient_tpu.serve.server": InferenceConfig(),
         "dotaclient_tpu.control.server": ControlConfig(),
         "dotaclient_tpu.league.server": LeagueConfig(),
+        "dotaclient_tpu.obs.fleetd": FleetConfig(),
     }
     for fname, c in _our_containers():
         cmd = c.get("command")
@@ -304,6 +311,7 @@ def test_chaos_pinned_off_in_all_prod_manifests():
             "dotaclient_tpu.serve.handoff",  # carry store: no chaos surface
             "dotaclient_tpu.control.server",  # control plane: no chaos surface
             "dotaclient_tpu.league.server",  # league service: no chaos surface
+            "dotaclient_tpu.obs.fleetd",  # telemetry aggregator: no chaos surface
         ):
             continue
         args = c.get("args", [])

@@ -328,6 +328,13 @@ def test_registry_unregistered_filter():
     assert registry.is_registered("staging_pack_ring_occupancy")
     assert registry.is_registered("staging_pack_ring_wait_s")
     assert registry.is_registered("staging_pack_rows_per_s")
+    # fleet telemetry plane (ISSUE 18): the rollup family fleetd serves
+    # and the producer-side counters its conservation audit joins on.
+    assert registry.is_registered("fleet_unaccounted_frames")
+    assert registry.is_registered("fleet_ledger_delivery_unaccounted")
+    assert registry.is_registered("fleet_host_wall_gap")
+    assert registry.is_registered("actor_publish_attempted_total")
+    assert registry.is_registered("obs_boot_epoch_ms")
     assert not registry.is_registered("bogus_scalar")
     assert registry.unregistered(["step", "time", "loss", "bogus_scalar"]) == ["bogus_scalar"]
 
