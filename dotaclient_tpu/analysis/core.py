@@ -35,6 +35,7 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
 from collections import deque
 from dataclasses import dataclass, field
@@ -207,7 +208,12 @@ class ModuleUnit:
 class RepoContext:
     """Paths + parsed modules for one lint run. The cross-file inputs
     (config.py, obs/registry.py, k8s/) are overridable so the fixture
-    corpus can exercise the OBS rules hermetically."""
+    corpus can exercise the OBS rules hermetically.
+
+    Cross-file sources are parsed ONCE per lint run and shared across
+    all rule families through `source_of`/`ast_of`/`script_modules` —
+    before these caches, every family re-parsed config.py, the argparse
+    binaries, and the scripts/ drivers on its own."""
 
     root: str
     modules: List[ModuleUnit] = field(default_factory=list)
@@ -217,6 +223,58 @@ class RepoContext:
     scripts_dir: Optional[str] = None
     serialize_path: Optional[str] = None
     packer_cc_path: Optional[str] = None
+    _source_cache: Dict[str, Optional[str]] = field(
+        default_factory=dict, repr=False, compare=False
+    )
+    _ast_cache: Dict[str, Optional[ast.Module]] = field(
+        default_factory=dict, repr=False, compare=False
+    )
+    _script_modules: Optional[List[ModuleUnit]] = field(
+        default=None, repr=False, compare=False
+    )
+
+    def source_of(self, path: str) -> Optional[str]:
+        """Memoized file read (None on OSError) — one disk read per
+        cross-file input per lint run, shared by every rule family."""
+        key = os.path.abspath(path)
+        if key not in self._source_cache:
+            try:
+                with open(key, encoding="utf-8") as f:
+                    self._source_cache[key] = f.read()
+            except OSError:
+                self._source_cache[key] = None
+        return self._source_cache[key]
+
+    def ast_of(self, path: str) -> Optional[ast.Module]:
+        """Memoized ``ast.parse`` of `path` (None on read/syntax error).
+        Package files already parsed into `modules` are served from
+        their ModuleUnit, never re-parsed."""
+        key = os.path.abspath(path)
+        if key not in self._ast_cache:
+            for m in self.modules:
+                if m.abspath == key:
+                    self._ast_cache[key] = m.tree
+                    break
+            else:
+                source = self.source_of(key)
+                try:
+                    self._ast_cache[key] = (
+                        None if source is None else ast.parse(source, filename=key)
+                    )
+                except SyntaxError:
+                    self._ast_cache[key] = None
+        return self._ast_cache[key]
+
+    def script_modules(self) -> List[ModuleUnit]:
+        """The scripts/ bench+soak drivers as parsed ModuleUnits, once
+        per lint run (OBS002 argv scanning and the SVC fleet-graph
+        rules both read them)."""
+        if self._script_modules is None:
+            if self.scripts_dir and os.path.isdir(self.scripts_dir):
+                self._script_modules = parse_modules(self.root, [self.scripts_dir])
+            else:
+                self._script_modules = []
+        return self._script_modules
 
 
 class Rule:
@@ -263,6 +321,7 @@ def _ensure_rules_loaded() -> None:
         jax_rules,
         lif_rules,
         obs_rules,
+        proto_rules,
         thr_rules,
     )
 
@@ -332,6 +391,10 @@ class LintReport:
     stale_baseline: List[str]  # fingerprints with no current finding
     invalid: List[Finding]  # GRAFT000: suppression/baseline hygiene
     files_scanned: int = 0
+    # wall seconds per rule id — the nightly --strict budget ledger:
+    # a rule family that grows past its share shows up here, in --json,
+    # before it shows up as a timed-out gate
+    rule_seconds: Dict[str, float] = field(default_factory=dict)
 
     def failures(self, strict: bool = False) -> List[str]:
         """Human-readable list of everything that fails this run. The
@@ -355,6 +418,10 @@ class LintReport:
             "baselined": len(self.baselined),
             "stale_baseline": self.stale_baseline,
             "invalid": [f.render() for f in self.invalid],
+            "rule_seconds": {
+                rule: round(secs, 4)
+                for rule, secs in sorted(self.rule_seconds.items())
+            },
         }
 
 
@@ -437,10 +504,13 @@ def lint_repo(
 
     active = [RULES[r] for r in rules] if rules else list(RULES.values())
     raw: List[Finding] = []
+    rule_seconds: Dict[str, float] = {}
     for rule in active:
+        started = time.perf_counter()
         for module in ctx.modules:
             raw.extend(rule.run(module, ctx))
         raw.extend(rule.run_repo(ctx))
+        rule_seconds[rule.id] = time.perf_counter() - started
 
     # Partition: inline suppressions first, then the baseline.
     by_rel = {m.relpath: m for m in ctx.modules}
@@ -512,4 +582,5 @@ def lint_repo(
         stale_baseline=stale,
         invalid=invalid,
         files_scanned=len(ctx.modules) if selected_rel is None else len(selected_rel),
+        rule_seconds=rule_seconds,
     )

@@ -109,15 +109,21 @@ def _registry_names(ctx: RepoContext) -> Tuple[Set[str], Set[str]]:
     re-parsing the registry per file is pure waste)."""
     cached = getattr(ctx, "_registry_names_cache", None)
     if cached is None:
-        cached = ctx._registry_names_cache = parse_registry_names(ctx.registry_path)
+        cached = ctx._registry_names_cache = parse_registry_names(
+            ctx.registry_path, tree=ctx.ast_of(ctx.registry_path)
+        )
     return cached
 
 
-def parse_registry_names(registry_path: str) -> Tuple[Set[str], Set[str]]:
+def parse_registry_names(
+    registry_path: str, tree: Optional[ast.Module] = None
+) -> Tuple[Set[str], Set[str]]:
     """(exact scalar names, family prefixes) from obs/registry.py — by
-    AST, so linting never imports the package."""
-    with open(registry_path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=registry_path)
+    AST, so linting never imports the package. Pass `tree` (the
+    RepoContext.ast_of cache) to skip the re-parse."""
+    if tree is None:
+        with open(registry_path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=registry_path)
     scalars: Set[str] = set()
     prefixes: Set[str] = set()
     for node in ast.walk(tree):
@@ -142,6 +148,43 @@ def _registered(name: str, scalars: Set[str], prefixes: Set[str]) -> bool:
     if name in scalars or name in ("step", "time"):
         return True
     return any(name.startswith(p) for p in prefixes)
+
+
+def _head_registered(head: str, scalars: Set[str], prefixes: Set[str]) -> bool:
+    """Can a dynamically-composed name starting with `head` still land
+    inside the registry? True when the head sits inside a PREFIXES
+    family (``fleet_ledger_`` under ``fleet_``), when a family starts
+    with the head (``staging_`` composing into ``staging_pack_*``), or
+    when an exact scalar starts with it (``ckpt_`` + a stats key =
+    ``ckpt_save_ms``). Only a head that can NEVER reach a registered
+    name is drift — this keeps the check sound without re-deriving
+    every runtime tail."""
+    if not head:
+        return True  # f"{var}..." — nothing static to judge
+    if any(head.startswith(p) or p.startswith(head) for p in prefixes):
+        return True
+    return any(s.startswith(head) for s in scalars)
+
+
+def _key_violation(
+    key: ast.AST, scalars: Set[str], prefixes: Set[str]
+) -> Optional[Tuple[str, bool]]:
+    """(display name, is_dynamic) when `key` names an unregistered
+    scalar; None when registered or out of scope. Constant string keys
+    are judged exactly; f-string keys by their constant head (the
+    dynamically-composed family blind spot OBS001 used to document
+    instead of checking). Keys with no static head stay the runtime
+    drift guard's job."""
+    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+        if not _registered(key.value, scalars, prefixes):
+            return key.value, False
+        return None
+    if isinstance(key, ast.JoinedStr) and key.values:
+        first = key.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            if not _head_registered(first.value, scalars, prefixes):
+                return first.value + "…", True
+    return None
 
 
 @register
@@ -176,13 +219,11 @@ class UnregisteredScalar(Rule):
                 payload = sub.args[1]
                 if isinstance(payload, ast.Dict):
                     for key in payload.keys:
-                        if isinstance(key, ast.Constant) and isinstance(
-                            key.value, str
-                        ):
-                            if not _registered(key.value, scalars, prefixes):
-                                findings.append(
-                                    self._finding(module, key, key.value, fn)
-                                )
+                        bad = _key_violation(key, scalars, prefixes)
+                        if bad:
+                            findings.append(
+                                self._finding(module, key, bad[0], fn, bad[1])
+                            )
                 elif isinstance(payload, ast.Name):
                     log_dict_vars.add(payload.id)
             if not log_dict_vars:
@@ -195,12 +236,12 @@ class UnregisteredScalar(Rule):
                         isinstance(tgt, ast.Subscript)
                         and isinstance(tgt.value, ast.Name)
                         and tgt.value.id in log_dict_vars
-                        and isinstance(tgt.slice, ast.Constant)
-                        and isinstance(tgt.slice.value, str)
                     ):
-                        name = tgt.slice.value
-                        if not _registered(name, scalars, prefixes):
-                            findings.append(self._finding(module, tgt, name, fn))
+                        bad = _key_violation(tgt.slice, scalars, prefixes)
+                        if bad:
+                            findings.append(
+                                self._finding(module, tgt, bad[0], fn, bad[1])
+                            )
                     elif (
                         isinstance(tgt, ast.Name)
                         and tgt.id in log_dict_vars
@@ -210,13 +251,11 @@ class UnregisteredScalar(Rule):
                         # var: `scalars = {"name": ...}` then
                         # `metrics.log(step, scalars)`
                         for key in sub.value.keys:
-                            if isinstance(key, ast.Constant) and isinstance(
-                                key.value, str
-                            ):
-                                if not _registered(key.value, scalars, prefixes):
-                                    findings.append(
-                                        self._finding(module, key, key.value, fn)
-                                    )
+                            bad = _key_violation(key, scalars, prefixes)
+                            if bad:
+                                findings.append(
+                                    self._finding(module, key, bad[0], fn, bad[1])
+                                )
         return findings
 
     @staticmethod
@@ -242,23 +281,35 @@ class UnregisteredScalar(Rule):
                         return True
         return False
 
-    def _finding(self, module: ModuleUnit, node: ast.AST, name: str, fn) -> Finding:
+    def _finding(
+        self, module: ModuleUnit, node: ast.AST, name: str, fn, dynamic: bool = False
+    ) -> Finding:
         qual = module.qualname_at(node)
-        return self.make(
-            module,
-            node.lineno,
-            f"scalar {name!r} is logged here but not registered in "
-            f"obs/registry.py — dashboards select by name; add it to "
-            f"SCALARS (or a documented PREFIXES family) or rename",
-            context=qual,
-        )
+        if dynamic:
+            msg = (
+                f"dynamically-composed scalar head {name!r} is logged here "
+                f"but no obs/registry.py PREFIXES family (or SCALARS name) "
+                f"can contain it — dashboards select by name; register a "
+                f"family for the head or rename"
+            )
+        else:
+            msg = (
+                f"scalar {name!r} is logged here but not registered in "
+                f"obs/registry.py — dashboards select by name; add it to "
+                f"SCALARS (or a documented PREFIXES family) or rename"
+            )
+        return self.make(module, node.lineno, msg, context=qual)
 
 
-def config_field_map(config_path: str) -> Dict[str, Dict[str, Optional[str]]]:
+def config_field_map(
+    config_path: str, tree: Optional[ast.Module] = None
+) -> Dict[str, Dict[str, Optional[str]]]:
     """{ClassName: {field: nested-ClassName-or-None}} for every
-    @dataclass in config.py, resolved the way add_flags recurses."""
-    with open(config_path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=config_path)
+    @dataclass in config.py, resolved the way add_flags recurses.
+    Pass `tree` (the RepoContext.ast_of cache) to skip the re-parse."""
+    if tree is None:
+        with open(config_path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=config_path)
     classes: Dict[str, Dict[str, Optional[str]]] = {}
     names = {
         n.name for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
@@ -290,10 +341,11 @@ def flatten_flags(
     return out
 
 
-def argparse_flags(path: str) -> Set[str]:
+def argparse_flags(path: str, tree: Optional[ast.Module] = None) -> Set[str]:
     """--flag names from add_argument calls in a stdlib-argparse binary."""
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
+    if tree is None:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
     out: Set[str] = set()
     for node in ast.walk(tree):
         if (
@@ -320,7 +372,7 @@ class ManifestFlagDrift(Rule):
     def run_repo(self, ctx: RepoContext) -> List[Finding]:
         if not (ctx.config_path and os.path.exists(ctx.config_path)):
             return []
-        classes = config_field_map(ctx.config_path)
+        classes = config_field_map(ctx.config_path, tree=ctx.ast_of(ctx.config_path))
         findings: List[Finding] = self._scripts_pass(ctx, classes)
         if not (ctx.k8s_dir and os.path.isdir(ctx.k8s_dir)):
             return findings
@@ -384,20 +436,10 @@ class ManifestFlagDrift(Rule):
         namespace. Only lists that NAME a known binary are judged — a
         script's own argparse flags (self-reinvocation lists) never
         mention a module and stay out of scope."""
-        if not (ctx.scripts_dir and os.path.isdir(ctx.scripts_dir)):
-            return []
         findings: List[Finding] = []
-        for name in sorted(os.listdir(ctx.scripts_dir)):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(ctx.scripts_dir, name)
-            rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
-            try:
-                with open(path, encoding="utf-8") as f:
-                    tree = ast.parse(f.read(), filename=path)
-            except (SyntaxError, OSError):
-                continue
-            for lst in ast.walk(tree):
+        for script in ctx.script_modules():
+            rel = script.relpath
+            for lst in ast.walk(script.tree):
                 if not isinstance(lst, ast.List):
                     continue
                 strs = [
@@ -446,7 +488,7 @@ class ManifestFlagDrift(Rule):
                     os.path.dirname(ctx.config_path), *spec.split(":", 1)[1].split("/")
                 )
                 if os.path.exists(ap):
-                    namespaces |= argparse_flags(ap)
+                    namespaces |= argparse_flags(ap, tree=ctx.ast_of(ap))
             else:
                 namespaces |= flatten_flags(classes, spec)
         return namespaces, known
@@ -479,9 +521,10 @@ class UnconsumedFlag(Rule):
                 ):
                     # getattr(cfg, "field", default) — the compat-read idiom
                     consumed.add(sub.args[1].value)
-        with open(ctx.config_path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=ctx.config_path)
-        classes = config_field_map(ctx.config_path)
+        tree = ctx.ast_of(ctx.config_path)
+        if tree is None:
+            return []
+        classes = config_field_map(ctx.config_path, tree=tree)
         findings: List[Finding] = []
         for node in ast.walk(tree):
             if not isinstance(node, ast.ClassDef) or node.name not in classes:

@@ -18,15 +18,22 @@ Two name classes:
   family; keep these FEW and specific — a catch-all prefix would defeat
   the drift guard.
 
-This contract is enforced TWICE, and both guards parse THIS file:
+This contract is enforced THREE ways, and every guard parses THIS file:
 - runtime: the tier-1 drift guard above catches any name a real learner
   window emits that isn't registered;
 - lint time: graftlint's OBS001 (dotaclient_tpu/analysis/obs_rules.py)
   AST-checks every STRING-LITERAL scalar name passed to
-  MetricsLogger.log against SCALARS/PREFIXES before the code ever runs
-  (it reads the two dicts below by AST, never by import — keep them
-  literal dicts of constant string keys). Dynamic keys (f-strings,
-  loop-forwarded stats) are the runtime guard's half of the contract.
+  MetricsLogger.log against SCALARS/PREFIXES before the code ever runs,
+  and checks each f-string key by its constant head against the PREFIXES
+  families (it reads the two dicts below by AST, never by import — keep
+  them literal dicts of constant string keys). Fully-dynamic keys
+  (loop-forwarded stats) are the runtime guard's half of the contract;
+- fleet lint: graftproto (dotaclient_tpu/analysis/proto_rules.py)
+  resolves every meter the SHIPPED k8s autoscaler/alert clauses name
+  (SVC002) and every conservation-LEDGERS term (SVC004) against this
+  registry AND against what the scraped tier's import closure actually
+  emits — so a name here that no tier exports, or a clause naming an
+  unregistered meter, fails lint before any pod boots.
 """
 
 from __future__ import annotations

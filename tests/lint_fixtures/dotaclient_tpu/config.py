@@ -17,3 +17,27 @@ class LearnerConfig:
     # OBS003: defined, exposed as --dead_flag, consumed nowhere
     dead_flag: int = 0
     obs: ObsMini = field(default_factory=ObsMini)
+
+
+@dataclass
+class ControlMini:
+    port: int = 13400
+    policy: str = ""
+
+
+@dataclass
+class ControlConfig:
+    control: ControlMini = field(default_factory=ControlMini)
+    obs: ObsMini = field(default_factory=ObsMini)
+
+
+@dataclass
+class FleetMini:
+    port: int = 13420
+    alerts: str = ""
+
+
+@dataclass
+class FleetConfig:
+    fleet: FleetMini = field(default_factory=FleetMini)
+    obs: ObsMini = field(default_factory=ObsMini)

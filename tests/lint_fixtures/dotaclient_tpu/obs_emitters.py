@@ -31,3 +31,18 @@ def bad_literal_initializer_window(metrics, step):
     # var (not a subscript store)
     scalars = {"good_scalar": 1.0, "rogue_in_initializer": 2.0}
     metrics.log(step, scalars)
+
+
+def good_fstring_window(metrics, step):
+    scalars = {"loss": 0.0}
+    # dynamically-composed key whose constant head sits inside the
+    # registered fam_ family — clean
+    scalars[f"fam_le_{step}"] = 1.0
+    metrics.log(step, scalars)
+
+
+def bad_fstring_window(metrics, step):
+    scalars = {"loss": 0.0}
+    # OBS001: dynamically-composed head no PREFIXES family can contain
+    scalars[f"rogue_fam_{step}"] = 2.0
+    metrics.log(step, scalars)

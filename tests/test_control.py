@@ -132,7 +132,7 @@ def test_policy_engine_missing_meter_freezes_and_one_move_per_tier():
 
 
 def test_parse_prometheus_text_roundtrips_render():
-    scalars = {"serve_load_occupancy": 0.75, "fabric_queue_depth": 6144.0,
+    scalars = {"serve_load_occupancy": 0.75, "broker_shard_depth": 6144.0,
                "big_counter": 1234567890.0}
     text = render_prometheus(scalars)
     assert parse_prometheus_text(text) == scalars

@@ -85,6 +85,10 @@ def test_every_rule_fires_on_the_bad_corpus():
         "LIF001",
         "LIF002",
         "WIRE001",
+        "SVC001",
+        "SVC002",
+        "SVC003",
+        "SVC004",
     }
     assert expected <= fired, f"rules that never fired: {expected - fired}"
     # every registered code rule is exercised by the corpus

@@ -1,0 +1,360 @@
+"""graftproto tier-1 tests: the whole-fleet contract checker (SVC001–
+SVC004 over analysis/fleetgraph.py's static contract graph).
+
+Three layers, mirroring tests/test_graftlint.py's structure:
+
+- HEAD gate: the real tree is SVC-clean with the baseline still EMPTY —
+  every route, clause meter, grammar literal, and ledger term in the
+  repo genuinely resolves against its producer.
+- Fixture corpus: each SVC rule fires exactly on its labeled bad
+  fixture and nowhere else (the good twins — the served /topology edge,
+  the registered+exported alert meter, the parsing policy clause, the
+  exported ledger term — stay clean).
+- Mutants on a real-shaped tree: re-introduce each drift class into a
+  COPY of the real package/manifests and the lint catches it — probe
+  path typo, policy-meter rename (the exact drift this checker found
+  and fixed on landing: control.yaml keyed broker scaling on
+  fabric_queue_depth, which no tier exports), grammar typo, ledger-term
+  rename, and an unextractable LEDGERS (loud, never a silent skip).
+
+All in-process runs are pure AST; the no-JAX proof at the bottom runs
+the SVC rules in a subprocess — SVC003's grammar parsers execute in
+their OWN subprocess (analysis/grammar_check.py), so even it keeps
+jax/numpy out of the lint interpreter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from dotaclient_tpu.analysis import lint_repo
+from dotaclient_tpu.analysis.core import RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+BASELINE = os.path.join(REPO_ROOT, "dotaclient_tpu", "analysis", "baseline.json")
+
+SVC_RULES = ("SVC001", "SVC002", "SVC003", "SVC004")
+
+
+def _svc(report):
+    return [f for f in report.findings if f.rule.startswith("SVC")]
+
+
+# ---------------------------------------------------------------- repo gate
+
+
+def test_repo_is_svc_clean_with_empty_baseline():
+    """The acceptance bar: SVC001–SVC004 pass on HEAD with ZERO baseline
+    entries — the fleet's contracts all actually hold; nothing was
+    grandfathered in to make the gate green."""
+    report = lint_repo(REPO_ROOT)
+    assert [f.render() for f in _svc(report)] == []
+    with open(BASELINE) as f:
+        assert json.load(f)["entries"] == {}
+
+
+def test_svc_rules_registered_as_errors():
+    from dotaclient_tpu.analysis import proto_rules  # noqa: F401 (registers)
+
+    for rid in SVC_RULES:
+        assert RULES[rid].severity == "error", rid
+
+
+# ------------------------------------------------------------ fixture corpus
+
+
+def test_fixture_corpus_fires_each_svc_rule_exactly_where_labeled():
+    """Each rule fires on its bad fixture and ONLY there — the exact
+    sets double as the good-twin proof (the served /topology edge, the
+    /healthz probe, the registered+exported alert meter, the parsing
+    policy clause, the exported ledger term all stay absent)."""
+    report = lint_repo(FIXTURES)
+    svc = {}
+    for f in _svc(report):
+        svc.setdefault(f.rule, []).append(f)
+    assert set(svc) == set(SVC_RULES)
+
+    svc1 = sorted(svc["SVC001"], key=lambda f: f.path)
+    assert [os.path.basename(f.path) for f in svc1] == [
+        "fleetd.py",
+        "fleetd-fixture.yaml",
+    ]
+    assert "'/topologyy'" in svc1[0].message  # the drifted code edge
+    assert "dotaclient_tpu.control.server" in svc1[0].message
+    assert "'/fleet/status'" in svc1[1].message  # the drifted probe
+
+    (f2,) = svc["SVC002"]
+    assert os.path.basename(f2.path) == "control-fixture.yaml"
+    assert "'serve_ghost_occupancy'" in f2.message
+
+    (f3,) = svc["SVC003"]
+    assert os.path.basename(f3.path) == "fleetd-fixture.yaml"
+    assert "fleet_alerts" in f3.message
+
+    (f4,) = svc["SVC004"]
+    assert f4.path == "dotaclient_tpu/obs/fleet.py"
+    assert "'fleet_ghost_dropped_total'" in f4.message
+    assert f4.context == "LEDGERS"
+
+
+def test_obs001_prefix_families_cover_fstring_heads():
+    """The OBS001 extension riding this PR: a dynamically-composed
+    meter key f"rogue_fam_{k}" whose constant head no registry family
+    can contain fires; f"fam_le_{k}" inside the fam_ family is clean."""
+    report = lint_repo(FIXTURES)
+    obs1 = [
+        f
+        for f in report.findings
+        if f.rule == "OBS001" and "obs_emitters" in f.path
+    ]
+    dynamic = [f for f in obs1 if "dynamically-composed" in f.message]
+    assert len(dynamic) == 1
+    assert "'rogue_fam_…'" in dynamic[0].message
+    assert "bad_fstring_window" in dynamic[0].context
+    assert not any("good_fstring_window" in f.context for f in obs1)
+
+
+# ----------------------------------------------- suppression + baseline
+
+
+def test_svc_finding_obeys_inline_suppression_discipline(tmp_path):
+    """SVC findings ride the same escape hatches as every other family:
+    a REASONED inline suppression hides the fleetd fixture's drifted
+    route; the finding still counts as suppressed, not gone."""
+    corpus = tmp_path / "corpus"
+    shutil.copytree(FIXTURES, corpus)
+    fleetd = corpus / "dotaclient_tpu" / "obs" / "fleetd.py"
+    fleetd.write_text(
+        fleetd.read_text().replace(
+            'urlopen(f"http://{self._control_endpoint}/topologyy")',
+            'urlopen(f"http://{self._control_endpoint}/topologyy")'
+            "  # graftlint: disable=SVC001(fixture: drift kept on purpose)",
+        )
+    )
+    report = lint_repo(str(corpus))
+    assert not any(
+        f.rule == "SVC001" and f.path.endswith("fleetd.py")
+        for f in report.findings
+    )
+    assert any(
+        f.rule == "SVC001" and f.path.endswith("fleetd.py")
+        for f in report.suppressed
+    )
+
+
+def test_svc_finding_baselines_and_goes_stale(tmp_path):
+    """The ratchet applies to SVC too: a pinned ledger-drift finding
+    stops failing the gate; FIXING the drift makes the entry stale (the
+    baseline can only shrink)."""
+    report = lint_repo(FIXTURES)
+    pinned = next(f for f in _svc(report) if f.rule == "SVC004")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(
+        json.dumps({"entries": {pinned.fingerprint(): {"reason": "audited"}}})
+    )
+    pinned_run = lint_repo(FIXTURES, baseline_path=str(bl))
+    assert pinned.fingerprint() in {f.fingerprint() for f in pinned_run.baselined}
+    assert pinned.fingerprint() not in {
+        f.fingerprint() for f in pinned_run.findings
+    }
+
+    corpus = tmp_path / "corpus"
+    shutil.copytree(FIXTURES, corpus)
+    fp = corpus / "dotaclient_tpu" / "obs" / "fleet.py"
+    src = fp.read_text()
+    fixed = src.replace(
+        '            LedgerTerm("fleet_ghost_dropped_total", "actor", -1.0),\n',
+        "",
+    )
+    assert fixed != src, "fixture ledger term moved — update this pin"
+    fp.write_text(fixed)
+    after = lint_repo(str(corpus), baseline_path=str(bl))
+    assert pinned.fingerprint() in after.stale_baseline
+
+
+def test_svc_fingerprints_survive_line_shifts(tmp_path):
+    """Baseline contract: padding lines above LEDGERS must not churn
+    SVC004's fingerprint (messages carry no line numbers)."""
+    corpus = tmp_path / "corpus"
+    shutil.copytree(FIXTURES, corpus)
+    before = {f.fingerprint() for f in _svc(lint_repo(str(corpus)))}
+    fp = corpus / "dotaclient_tpu" / "obs" / "fleet.py"
+    fp.write_text("# pad\n# pad\n# pad\n" + fp.read_text())
+    after = {f.fingerprint() for f in _svc(lint_repo(str(corpus)))}
+    assert before == after
+
+
+# --------------------------------------------------- mutants on a real tree
+
+
+def _package_copy(tmp_path):
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "dotaclient_tpu"), tmp_path / "dotaclient_tpu"
+    )
+    shutil.copytree(os.path.join(REPO_ROOT, "k8s"), tmp_path / "k8s")
+    return tmp_path
+
+
+def test_policy_meter_rename_regression_fails_lint(tmp_path):
+    """The landing-day drift, as a regression test: control.yaml used to
+    key broker scaling on fabric_queue_depth — a meter no tier exports,
+    so the clause could only ever hold on 'meter missing'. Re-introduce
+    it; SVC002 names it."""
+    root = _package_copy(tmp_path)
+    cy = root / "k8s" / "control.yaml"
+    src = cy.read_text()
+    mutant = src.replace(
+        "broker:broker_shard_depth.max", "broker:fabric_queue_depth.max"
+    )
+    assert mutant != src, "control.yaml policy clause moved — update this pin"
+    cy.write_text(mutant)
+    report = lint_repo(str(root))
+    svc2 = [f for f in report.findings if f.rule == "SVC002"]
+    assert svc2 and any("fabric_queue_depth" in f.message for f in svc2)
+
+
+def test_probe_path_drift_mutant_fails_lint(tmp_path):
+    """A probe-path typo in the inference manifest 404s at runtime and
+    restarts the pod forever; SVC001 catches it statically — the check
+    test_k8s.py used to hand-pin per manifest."""
+    root = _package_copy(tmp_path)
+    iy = root / "k8s" / "inference.yaml"
+    src = iy.read_text()
+    mutant = src.replace("path: /healthz", "path: /healthzz")
+    assert mutant != src
+    iy.write_text(mutant)
+    report = lint_repo(str(root))
+    svc1 = [f for f in report.findings if f.rule == "SVC001"]
+    assert svc1 and all("'/healthzz'" in f.message for f in svc1)
+    assert any("inference.yaml" in f.path for f in svc1)
+
+
+def test_grammar_typo_mutant_fails_lint(tmp_path):
+    """A truncated matchmaking clause crashes league.server on boot;
+    SVC003 runs the REAL parse_match_policy on the committed literal."""
+    root = _package_copy(tmp_path)
+    ly = root / "k8s" / "league.yaml"
+    src = ly.read_text()
+    mutant = src.replace(
+        '"prioritized@0.7;exploiter@0.3"', '"prioritized@0.7;exploiter@"'
+    )
+    assert mutant != src, "league.yaml policy literal moved — update this pin"
+    ly.write_text(mutant)
+    report = lint_repo(str(root))
+    svc3 = [f for f in report.findings if f.rule == "SVC003"]
+    assert svc3 and any(
+        "league_policy" in f.message and "league.yaml" in f.path for f in svc3
+    )
+
+
+def test_ledger_term_rename_mutant_fails_lint(tmp_path):
+    """Renaming a counter on the EMITTING side without touching the
+    ledger silently drops a leg from the conservation audit; SVC004
+    pins every term to the tier that must export it."""
+    root = _package_copy(tmp_path)
+    fp = root / "dotaclient_tpu" / "obs" / "fleet.py"
+    src = fp.read_text()
+    mutant = src.replace(
+        'LedgerTerm("actor_rollouts_published_total", "actor"',
+        'LedgerTerm("actor_rollouts_published_totalz", "actor"',
+    )
+    assert mutant != src, "fleet.py producer ledger moved — update this pin"
+    fp.write_text(mutant)
+    report = lint_repo(str(root))
+    svc4 = [f for f in report.findings if f.rule == "SVC004"]
+    assert svc4 and any(
+        "actor_rollouts_published_totalz" in f.message for f in svc4
+    )
+
+
+def test_unextractable_ledgers_is_loud_not_silent(tmp_path):
+    """The WIRE001 discipline: a LEDGERS refactor the extractor can no
+    longer read is itself a finding — never a silently-skipped audit."""
+    root = _package_copy(tmp_path)
+    fp = root / "dotaclient_tpu" / "obs" / "fleet.py"
+    src = fp.read_text()
+    # `1 * (...)` stays valid syntax (the interpreter owns syntax, not
+    # the lint) but the value is a BinOp, not the literal tuple the
+    # extractor can read
+    mutant = src.replace(
+        "LEDGERS: Tuple[LedgerSpec, ...] = (",
+        "LEDGERS: Tuple[LedgerSpec, ...] = 1 * (",
+        1,
+    )
+    assert mutant != src, "fleet.py LEDGERS assignment moved — update this pin"
+    fp.write_text(mutant)
+    report = lint_repo(str(root))
+    svc4 = [f for f in report.findings if f.rule == "SVC004"]
+    assert svc4 and any("extraction failed" in f.message for f in svc4)
+
+
+def test_corpus_without_fleet_surfaces_skips_cleanly(tmp_path):
+    """A synthetic tree with no HTTP layer, no manifests, and no
+    fleet.py must produce ZERO SVC findings — the rules skip, they do
+    not flood (the tmp-tree pattern every other graftlint test relies
+    on)."""
+    pkg = tmp_path / "dotaclient_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from urllib.request import urlopen\n"
+        "def poll(ep):\n"
+        "    return urlopen(f'http://{ep}/some/route')\n"
+    )
+    report = lint_repo(str(tmp_path))
+    assert _svc(report) == []
+
+
+# ------------------------------------------------------------- import proof
+
+
+def test_svc_rules_run_without_jax_in_lint_process():
+    """The no-JAX proof, extended to the SVC family: SVC003 shells out
+    to grammar_check.py for the real parsers, so even a full SVC run
+    keeps jax AND numpy out of the lint interpreter itself."""
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO_ROOT!r})\n"
+        "from dotaclient_tpu.analysis import lint_repo\n"
+        f"report = lint_repo({REPO_ROOT!r}, rules={list(SVC_RULES)!r})\n"
+        "assert not report.failures(), report.failures()\n"
+        "assert 'jax' not in sys.modules, 'SVC linting imported jax'\n"
+        "assert 'numpy' not in sys.modules, 'SVC linting imported numpy'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=180)
+
+
+# ------------------------------------------------------------- nightly lane
+
+
+@pytest.mark.nightly
+@pytest.mark.slow
+def test_lint_strict_nightly_covers_svc_and_reports_budget():
+    """The nightly --strict wrapper, extended: the CLI gate is green
+    with the SVC rules loaded, and the per-rule wall-time ledger in
+    --json covers every SVC id (the budget satellite — a rule family
+    growing past its share shows up here before it times out the
+    gate). Marked slow as well so `-m 'not slow'` quick iteration
+    (which overrides the addopts nightly exclusion) skips it too."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "lint_graft.py"),
+            "--strict",
+            "--json",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["ok"]
+    assert set(SVC_RULES) <= set(payload["rule_seconds"])
+    assert all(s >= 0.0 for s in payload["rule_seconds"].values())

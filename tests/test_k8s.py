@@ -380,10 +380,12 @@ def test_inference_service_manifest():
     assert args[args.index("--broker_url") + 1].startswith("tcp://broker-0.broker:13370,")
     assert args[args.index("--obs.enabled") + 1] == "true"
     mport = int(args[args.index("--obs.metrics_port") + 1])
-    assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    # probe PATHS are graftproto's SVC001 gate now (every httpGet path
+    # is checked against the binary's actual served surface —
+    # test_graftproto_covers_probes_and_grammars pins the coverage);
+    # port agreement stays here, it's manifest-local wiring
     assert c["readinessProbe"]["httpGet"]["port"] == mport
     live = c["livenessProbe"]
-    assert live["httpGet"]["path"] == "/healthz"
     assert live["initialDelaySeconds"] >= 60, (
         "liveness must outwait the boot-time tick compile"
     )
@@ -464,16 +466,15 @@ def test_serve_endpoint_lists_match_replicas_and_league_rides_serve():
 def test_league_service_manifest():
     """League service (ISSUE 17): a single-replica Deployment + Service
     (the registry dir is the state; restart = matches.jsonl replay, not
-    loss); the committed --league.policy must PARSE (a typo'd clause
-    would crash matchmaking on boot); port agreement end to end
+    loss); port agreement end to end
     (league.port == containerPort == probe port == Service port ==
     every client's --serve.league / --serve.league_endpoint); the slot
     count must equal the inference tier's --serve.models minus one
     (slot 0 is the live tree — drift strands assignments or leaves
     slots the sync can never fill); and the serve tier must actually
-    run multi-model with the sync pointed back at this Service."""
-    from dotaclient_tpu.league.policy import parse_match_policy
-
+    run multi-model with the sync pointed back at this Service. That the
+    committed --league.policy PARSES is graftproto's SVC003 gate now —
+    the real parse_match_policy runs on this literal in the lint."""
     (_, dep), = [
         (f, d) for f, d in DOCS
         if d["metadata"]["name"] == "league" and d["kind"] == "Deployment"
@@ -483,8 +484,10 @@ def test_league_service_manifest():
     assert c["command"][2] == "dotaclient_tpu.league.server"
     args = c["args"]
 
-    clauses = parse_match_policy(args[args.index("--league.policy") + 1])
-    assert clauses, "shipped matchmaking policy must have at least one clause"
+    assert args[args.index("--league.policy") + 1].strip(), (
+        "shipped matchmaking policy must be non-empty (SVC003 proves it "
+        "parses; an empty value would silently skip the lint's proof)"
+    )
 
     lport = int(args[args.index("--league.port") + 1])
     assert {p["containerPort"] for p in c["ports"]} == {lport}
@@ -590,9 +593,8 @@ def test_session_continuity_manifests():
 
 
 def test_control_plane_manifest():
-    """Control plane (PR 16): a single-replica Deployment + Service; the
-    committed --control.policy must PARSE (a typo'd clause would crash
-    the pod loop on boot), the driver ships "static" (observe-only until
+    """Control plane (PR 16): a single-replica Deployment + Service;
+    the driver ships "static" (observe-only until
     the ledger earns the k8s flip), every port agrees (control.port ==
     containerPort == probe port == Service port — clients dial
     control:control-plane:<that port>), and the scrape flag lists name
@@ -609,8 +611,12 @@ def test_control_plane_manifest():
     assert c["command"][2] == "dotaclient_tpu.control.server"
     args = c["args"]
 
+    # that the clause string PARSES (and that every meter it keys on is
+    # registered and actually exported by the scraped tier) is
+    # graftproto's SVC002/SVC003 gate; the checks below are the SEMANTIC
+    # shipping pins a parser can't know — sane bands, observe-only
+    # driver, poll cadence under every cooldown
     clauses = parse_policy(args[args.index("--control.policy") + 1])
-    assert clauses, "shipped policy must have at least one clause"
     for cl in clauses:
         assert cl.min >= 1 and cl.low < cl.high and cl.cooldown_s > 0
     assert {cl.tier for cl in clauses} >= {"server", "broker"}
@@ -653,6 +659,54 @@ def test_control_plane_manifest():
     assert brokers == [
         f"broker-{i}.broker:9100" for i in range(brk["spec"]["replicas"])
     ], "broker scrape list must name every broker shard exactly"
+
+
+def test_graftproto_covers_probes_and_grammars():
+    """The hand-pinned probe-path and policy-parses checks that used to
+    live in this suite are now the SVC001/SVC003 lint gate (graftproto).
+    This test pins the COVERAGE, not the verdict: every manifest probe
+    path is extracted and attributed to its binary, every committed
+    policy/alert/matchmaking clause reaches the grammar proof, and each
+    probe path re-verifies against the binary's actual served surface —
+    so the lint's clean verdict genuinely spans the surfaces this suite
+    stopped pinning by hand."""
+    import os
+
+    from dotaclient_tpu.analysis.core import RepoContext, parse_modules
+    from dotaclient_tpu.analysis.fleetgraph import fleet_graph
+
+    root = str(K8S.parent)
+    ctx = RepoContext(
+        root=root,
+        modules=parse_modules(root, [os.path.join(root, "dotaclient_tpu")]),
+        k8s_dir=str(K8S),
+        scripts_dir=os.path.join(root, "scripts"),
+        registry_path=os.path.join(root, "dotaclient_tpu", "obs", "registry.py"),
+        config_path=os.path.join(root, "dotaclient_tpu", "config.py"),
+    )
+    g = fleet_graph(ctx)
+
+    probes = {(p.relpath, p.route, p.binary) for p in g.probe_routes()}
+    assert ("k8s/inference.yaml", "/healthz", "dotaclient_tpu.serve.server") in probes
+    assert ("k8s/league.yaml", "/healthz", "dotaclient_tpu.league.server") in probes
+    assert ("k8s/control.yaml", "/healthz", "dotaclient_tpu.control.server") in probes
+    assert ("k8s/fleetd.yaml", "/healthz", "dotaclient_tpu.obs.fleetd") in probes
+    # the block-style learner probes and the prometheus scrape
+    # annotations are edges too, not just the flow-style one-liners
+    assert ("k8s/learner.yaml", "/healthz", "dotaclient_tpu.runtime.learner") in probes
+    assert ("k8s/learner.yaml", "/metrics", "dotaclient_tpu.runtime.learner") in probes
+
+    # SVC001 restated: every extracted probe path is genuinely served
+    for p in g.probe_routes():
+        served = g.served_by(p.binary)
+        assert not served or p.route in served, (
+            f"{p.relpath}:{p.line}: probe {p.route!r} not served by {p.binary}"
+        )
+
+    grammars = {(lit.relpath, lit.grammar) for lit in g.grammar_literals()}
+    assert ("k8s/control.yaml", "control_policy") in grammars
+    assert ("k8s/league.yaml", "league_policy") in grammars
+    assert ("k8s/fleetd.yaml", "fleet_alerts") in grammars
 
 
 def test_actor_fleet_scale_and_kill_switch():
