@@ -358,6 +358,72 @@ def main() -> None:
     stop.set()
     staging.stop()
 
+    # ---- in-network assembly headline pair (ISSUE 20): classic host
+    # pack CPU vs the concat-only landing --staging.assemble leaves on
+    # this host, for the SAME wire frames and the SAME transfer layout.
+    # The classic arm is the production pack (C packer into the fused
+    # transfer views, python fill fallback); the concat arm lands rows a
+    # shard-side RowAssembler pre-packed — one memcpy per row (single
+    # buffer) or one per dtype-group segment. scripts/ab_inet_pack.py
+    # owns the bitwise-parity/scaling artifact (INET_PACK_AB.json);
+    # this pair is the at-a-glance cost collapse.
+    from dotaclient_tpu.runtime.staging import fill_rollouts
+    from dotaclient_tpu.transport.assemble import RowAssembler
+    from dotaclient_tpu.transport.serialize import deserialize_rollout
+
+    asm_frames = _make_frames(cfg, cfg.batch_size)
+    _obs_bf16 = cfg.stage_obs_compute_dtype and cfg.policy.dtype == "bfloat16"
+    _asm = RowAssembler(
+        cfg.seq_len, cfg.policy.lstm_hidden, cfg.policy.aux_heads, _obs_bf16
+    )
+    _rows = [np.frombuffer(_asm.assemble(f).payload, np.uint8) for f in asm_frames]
+    _lib = None
+    if cfg.native_packer:
+        from dotaclient_tpu import native as _native
+
+        _lib = _native.load_packer()
+    _pack_items = (
+        asm_frames
+        if _lib is not None
+        else [deserialize_rollout(f) for f in asm_frames]
+    )
+
+    def _time_arm(fn, reps=7):
+        walls = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            fn()
+            walls.append(time.perf_counter() - t)
+        return float(np.median(walls))
+
+    def _classic_pack():
+        payload, outb = io.alloc_transfer()
+        if _lib is not None:
+            _native.pack_frames(
+                _lib, _pack_items, cfg.seq_len, cfg.policy.lstm_hidden,
+                cfg.policy.aux_heads, obs_bf16=_obs_bf16, out=outb,
+            )
+        else:
+            fill_rollouts(outb, _pack_items, cfg.seq_len)
+
+    _payloads = [bytes(r) for r in _rows]
+
+    def _concat_land():
+        payload, _outb = io.alloc_transfer()
+        raw = np.frombuffer(b"".join(_payloads), np.uint8).reshape(
+            len(_payloads), io.row_bytes
+        )
+        if isinstance(payload, dict):
+            for key, buf in payload.items():
+                u8 = buf.view(np.uint8)
+                off = io.seg_off[key]
+                u8[: len(_payloads)] = raw[:, off : off + u8.shape[1]]
+        else:
+            payload[: len(_payloads)] = raw
+
+    host_pack_cpu_s = _time_arm(_classic_pack)
+    host_concat_s = _time_arm(_concat_land)
+
     # ---- end-to-end rate: producers → broker → staging → device, with
     # the learner's PIPELINED loop (--learner.prefetch, the production
     # default): the SAME PrefetchLane the Learner runs stages batch N+1
@@ -763,6 +829,13 @@ def main() -> None:
         # host-feed topology of this run (scripts/ab_pack_scale.py owns
         # the 1/2/4-worker scaling artifact, PACK_SCALE_AB.json)
         "pack_workers": pack_workers,
+        # In-network assembly cost pair (ISSUE 20): classic host pack
+        # CPU per batch vs the concat-only landing left on this host
+        # when the fabric shards pre-pack (--broker.assemble +
+        # --staging.assemble); same frames, same transfer layout
+        # (INET_PACK_AB.json is the bitwise-parity artifact).
+        "host_pack_cpu_s_per_batch": round(host_pack_cpu_s, 6),
+        "host_concat_s_per_batch": round(host_concat_s, 6),
         "e2e_over_device_only": round(e2e_rate / device_rate, 3),
         # Overlapped-loop scoreboard (--learner.prefetch, ISSUE 15):
         # share of prefetch-lane work hidden behind the device step, the

@@ -175,6 +175,14 @@ class StagingConfig:
     # retires. 2 = classic double buffering; raise it only if H2D
     # latency (not pack) is the longest stage.
     transfer_depth: int = 2
+    # In-network batch assembly (--staging.assemble): consume DTB1
+    # blocks of rows the fabric shards already packed into the native
+    # row layout (shards run --broker.assemble); the learner-side pack
+    # collapses to a per-row memcpy into a TransferRing slot. Requires
+    # the fused-H2D path (the assembled rows ARE the transfer layout)
+    # and pack_workers=1 (there is nothing left for a pool to do).
+    # Default off keeps the classic consume path byte-for-byte.
+    assemble: bool = False
 
 
 @dataclass

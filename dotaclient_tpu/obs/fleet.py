@@ -150,6 +150,43 @@ LEDGERS: Tuple[LedgerSpec, ...] = (
             ),
         ),
     ),
+    LedgerSpec(
+        name="assembled",
+        doc=(
+            "in-network batch assembly (--broker.assemble): admitted = "
+            "packed + reject + bypassed + dropped + resident — every row "
+            "a shard admitted while armed is packed into a block, "
+            "dead-lettered (reject), popped wire-form by a classic "
+            "consumer (bypassed), evicted, or still resident "
+            "(assembled-but-unpopped)"
+        ),
+        terms=(
+            LedgerTerm(
+                "broker_assemble_rows_admitted_total", "broker", +1.0,
+                required=False,
+            ),
+            LedgerTerm(
+                "broker_assemble_rows_packed_total", "broker", -1.0,
+                required=False,
+            ),
+            LedgerTerm(
+                "broker_assemble_rows_reject_total", "broker", -1.0,
+                required=False,
+            ),
+            LedgerTerm(
+                "broker_assemble_rows_bypassed_total", "broker", -1.0,
+                required=False,
+            ),
+            LedgerTerm(
+                "broker_assemble_rows_dropped_total", "broker", -1.0,
+                required=False,
+            ),
+            LedgerTerm(
+                "broker_assemble_rows_resident", "broker", -1.0,
+                kind="gauge", required=False,
+            ),
+        ),
+    ),
 )
 
 

@@ -334,6 +334,20 @@ PREFIXES: Dict[str, str] = {
     # broker_shed_throttle_s (runtime/actor.py ShedThrottle /
     # VectorActor.stats; transport/tcp.py watermarks are the source)
     "broker_shed_": "broker load-shed observability (admission refusals + actor throttle)",
+    # in-network batch assembly tier (--broker.assemble; transport/tcp.py
+    # BrokerServer.assemble_ledger via transport/fabric.py
+    # shard_metrics_source — the shard binary's --metrics_port surface):
+    # broker_assemble_rows_admitted_total / _rows_packed_total /
+    # _rows_reject_total (frames the classic ingest would also
+    # dead-letter) / _rows_bypassed_total (classic CONSUME popped them
+    # wire-form while armed) / _rows_dropped_total (drop-oldest +
+    # priority eviction) / _rows_resident (assembled-but-unpopped rows,
+    # the conservation gauge) / _blocks_built_total / _blocks_served_total
+    # / _block_bytes_total / _cpu_s_total (shard-side pack seconds — the
+    # CPU the learner host no longer spends). The assembled-rows
+    # conservation identity over these terms is a fleet LEDGER
+    # (obs/fleet.py) audited by graftproto SVC004 and fleetd.
+    "broker_assemble_": "in-network batch assembly ledger (transport/tcp.py assemble tier)",
     # per-configured-endpoint health gauges (serve/client.py
     # RemoteFleet.stats): serve_endpoint_up_<i> (1 = in rotation, 0 =
     # sitting out a cooldown) and serve_endpoint_cooldown_s_<i>

@@ -27,6 +27,7 @@ Mechanics:
 from __future__ import annotations
 
 import queue
+import zlib
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -65,6 +66,86 @@ class _LeafSlot(NamedTuple):
     cols: int
 
 
+class RowLayout:
+    """The single-buffer row layout, mesh-free and jax-free.
+
+    Extracted from FusedBatchIO so the broker shards (ISSUE 20 in-network
+    assembly) can compute the EXACT byte layout of a staged batch row —
+    group segments in the fixed ("f32","i32","bf16","u8") order, each
+    padded to 4 bytes, leaves at their column offsets — without touching
+    jax or a device mesh. Built from the flattened template's
+    (shape, dtype) list; FusedBatchIO delegates its single-buffer layout
+    here, so shard-side and learner-side offsets can never diverge
+    (`layout_crc` pins the whole descriptor and travels in every DTB1
+    block header)."""
+
+    def __init__(self, specs: List[Tuple[Tuple[int, ...], Any]]):
+        self.slots: Dict[str, List[_LeafSlot]] = {}
+        cols: Dict[str, int] = {}
+        for i, (shape, dtype) in enumerate(specs):
+            key = _group_key(dtype)
+            n = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 else 1
+            self.slots.setdefault(key, []).append(
+                _LeafSlot(i, tuple(shape), dtype, cols.get(key, 0), n)
+            )
+            cols[key] = cols.get(key, 0) + n
+        self.group_cols = cols
+        self.n_leaves = len(specs)
+        self.seg_off: Dict[str, int] = {}
+        off = 0
+        for key in ("f32", "i32", "bf16", "u8"):
+            if key not in cols:
+                continue
+            self.seg_off[key] = off
+            nbytes = cols[key] * np.dtype(_GROUP_DTYPES[key]).itemsize
+            off += (nbytes + 3) & ~3
+        self.row_bytes = off
+        # Canonical descriptor → crc32: every quantity a row copy depends
+        # on. Two processes agreeing on the crc agree on every byte
+        # position of every leaf.
+        desc = ";".join(
+            f"{s.index}:{','.join(map(str, s.shape[1:]))}:"
+            f"{np.dtype(s.dtype).name}:{key}:{s.start}"
+            for key in ("f32", "i32", "bf16", "u8")
+            if key in self.slots
+            for s in self.slots[key]
+        )
+        desc += "|" + ",".join(
+            f"{k}={self.seg_off[k]}" for k in sorted(self.seg_off)
+        )
+        desc += f"|row_bytes={self.row_bytes}"
+        self.layout_crc = zlib.crc32(desc.encode()) & 0xFFFFFFFF
+
+    def views_into(self, buf: np.ndarray, rows: int) -> List[np.ndarray]:
+        """Leaf views (flat order) into a [rows, row_bytes] u8 buffer —
+        the alloc_views_single body, layout-only. Bool leaves come back
+        as bool views; every view is asserted to share memory with buf
+        (a silent copy would disconnect the batch from the transfer
+        bytes and ship zeros)."""
+        leaves: List[Any] = [None] * self.n_leaves
+        for key, slots in self.slots.items():
+            gdt = np.dtype(_GROUP_DTYPES[key])
+            for s in slots:
+                dt = np.dtype(np.bool_) if np.dtype(s.dtype) == np.bool_ else gdt
+                rev = []
+                acc = dt.itemsize
+                for d in reversed(s.shape[1:]):
+                    rev.append(acc)
+                    acc *= d
+                strides = (self.row_bytes,) + tuple(reversed(rev))
+                v = np.ndarray(
+                    shape=(rows,) + s.shape[1:],
+                    dtype=dt,
+                    buffer=buf,
+                    offset=self.seg_off[key] + s.start * gdt.itemsize,
+                    strides=strides,
+                )
+                if not np.may_share_memory(v, buf):
+                    raise AssertionError("RowLayout.views_into: leaf view detached")
+                leaves[s.index] = v
+        return leaves
+
+
 class FusedBatchIO:
     """Pack/unpack between a TrainBatch pytree and dtype-grouped
     [B, cols] buffers. Built once per (config, mesh) from a template
@@ -76,15 +157,12 @@ class FusedBatchIO:
         if any(leaf.shape[0] != B for leaf in leaves):
             raise ValueError("fused_io: every batch leaf must be batch-leading")
         self.batch = B
-        self.slots: Dict[str, List[_LeafSlot]] = {}
-        cols: Dict[str, int] = {}
-        for i, leaf in enumerate(leaves):
-            key = _group_key(leaf.dtype)
-            n = int(np.prod(leaf.shape[1:], dtype=np.int64)) if leaf.ndim > 1 else 1
-            self.slots.setdefault(key, []).append(
-                _LeafSlot(i, tuple(leaf.shape), leaf.dtype, cols.get(key, 0), n)
-            )
-            cols[key] = cols.get(key, 0) + n
+        # The mesh-free layout core (shared with the broker-side row
+        # assembler — transport/assemble.py builds the SAME RowLayout
+        # from the same template specs, so layout_crc pins parity).
+        self.layout = RowLayout([(tuple(l.shape), l.dtype) for l in leaves])
+        self.slots = self.layout.slots
+        cols = self.layout.group_cols
         self.group_cols = cols
         # pack() accepts exactly this many rows; defaults to the template
         # (global) batch. Multihost learners set it to their per-process
@@ -101,15 +179,8 @@ class FusedBatchIO:
         # [B, row_bytes] u8 array — on the tunneled chip the per-transfer
         # RPC overhead (~0.28 ms each, r3) makes transfer COUNT matter;
         # rows stay intact so dp sharding is identical to the group mode.
-        self.seg_off: Dict[str, int] = {}
-        off = 0
-        for key in ("f32", "i32", "bf16", "u8"):
-            if key not in cols:
-                continue
-            self.seg_off[key] = off
-            nbytes = cols[key] * np.dtype(_GROUP_DTYPES[key]).itemsize
-            off += (nbytes + 3) & ~3
-        self.row_bytes = off
+        self.seg_off = self.layout.seg_off
+        self.row_bytes = self.layout.row_bytes
         self.single_sharding = NamedSharding(mesh, P(dp, None))
         # When True (set by build_single_train_step), alloc_transfer /
         # pack_transfer / transfer_shardings produce the one-buffer
@@ -205,29 +276,7 @@ class FusedBatchIO:
 
         rows = self.local_rows
         buf = np.zeros((rows, self.row_bytes), np.uint8)
-        leaves: List[Any] = [None] * sum(len(s) for s in self.slots.values())
-        for key, slots in self.slots.items():
-            gdt = np.dtype(_GROUP_DTYPES[key])
-            for s in slots:
-                dt = np.dtype(np.bool_) if np.dtype(s.dtype) == np.bool_ else gdt
-                # C-contiguous strides for the per-row block; the leading
-                # (batch) stride is the full row width.
-                rev = []
-                acc = dt.itemsize
-                for d in reversed(s.shape[1:]):
-                    rev.append(acc)
-                    acc *= d
-                strides = (self.row_bytes,) + tuple(reversed(rev))
-                v = np.ndarray(
-                    shape=(rows,) + s.shape[1:],
-                    dtype=dt,
-                    buffer=buf,
-                    offset=self.seg_off[key] + s.start * gdt.itemsize,
-                    strides=strides,
-                )
-                if not np.may_share_memory(v, buf):
-                    raise AssertionError("fused_io.alloc_views_single: leaf view detached")
-                leaves[s.index] = v
+        leaves = self.layout.views_into(buf, rows)
         batch = jax.tree.unflatten(self.treedef, leaves)
         batch.obs.action_mask[:] = F.zeros_observation().action_mask
         return buf, batch

@@ -438,6 +438,62 @@ class StagingBuffer:
 
             self._item_encode = serialize_rollout
             self._item_decode = deserialize_rollout
+        # In-network batch assembly (--staging.assemble, transport/
+        # assemble.py): the fabric shards pre-pack every admitted frame
+        # into the native packer's exact row layout and this host
+        # consumes DTB1 blocks of finished rows. _ingest_assembled
+        # meters the per-row sidecars (version/trace/priority/episode)
+        # and _pack_assembled lands payload bytes into a TransferRing
+        # slot with memcpy only — the whole learner-host pack cost
+        # collapses to the fan-in concat. The spec handed to the broker
+        # is derived FROM the fused layout, so a shard whose template
+        # disagrees fails the layout_crc handshake at connect, never
+        # mid-batch.
+        self._assemble_spec = None
+        if self._staging_cfg.assemble:
+            if fused_io is None:
+                raise ValueError(
+                    "staging.assemble requires the fused H2D path: the "
+                    "assembled rows ARE the transfer layout (build the "
+                    "learner with fused staging)"
+                )
+            if self._staging_cfg.pack_workers > 1:
+                raise ValueError(
+                    "staging.assemble replaces the host pack pool (the "
+                    "learner-side pack is concat-only) — set "
+                    "staging.pack_workers=1"
+                )
+            enable = getattr(broker, "enable_assembled_consume", None)
+            if enable is None:
+                raise ValueError(
+                    "staging.assemble needs a broker that serves DTB1 "
+                    "blocks (transport.fabric.FabricBroker over tcp:// "
+                    "shards running --broker.assemble)"
+                )
+            from dotaclient_tpu.transport.serialize import (
+                BlockSpec,
+                deserialize_block,
+                serialize_block,
+            )
+
+            spec = BlockSpec(
+                seq_len=cfg.seq_len,
+                lstm_hidden=cfg.policy.lstm_hidden,
+                with_aux=cfg.policy.aux_heads,
+                obs_bf16=(
+                    cfg.stage_obs_compute_dtype
+                    and cfg.policy.dtype == "bfloat16"
+                ),
+                row_bytes=fused_io.row_bytes,
+                layout_crc=fused_io.layout.layout_crc,
+            )
+            enable(spec)
+            self._assemble_spec = spec
+            # Snapshot codec: a pending AssembledRow checkpoints as a
+            # 1-row DTB1 block (payload + full sidecar), so restored
+            # rows re-enter the same memcpy landing unchanged.
+            self._item_encode = lambda row: serialize_block(spec, [row])
+            self._item_decode = lambda b: deserialize_block(b)[1][0]
         # Replay reservoir (dotaclient_tpu/replay/): owned and touched by
         # the consumer thread only, same single-writer discipline as
         # _pending. Payloads match the pending-item type — raw frame
@@ -512,11 +568,13 @@ class StagingBuffer:
             "wire_frames_obs_bf16": 0,
             "wire_frames_obs_f32": 0,
         }
-        if self._staging_cfg.pack_workers > 1:
-            # Parallel-feed meters, present ONLY in pool mode so default
-            # runs emit no new scalars (stats() copies this dict and the
-            # learner re-emits pack_* as the registry-pinned
-            # staging_pack_* family).
+        if self._staging_cfg.pack_workers > 1 or self._assemble_spec is not None:
+            # Parallel-feed meters, present ONLY in pool or assembled
+            # mode so default runs emit no new scalars (stats() copies
+            # this dict and the learner re-emits pack_* as the
+            # registry-pinned staging_pack_* family). In assembled mode
+            # pack_wall_s measures the concat-only landing — the
+            # headline "host pack CPU collapsed" number.
             self._stats["pack_wall_s"] = 0.0
             self._stats["pack_ring_wait_s"] = 0.0
 
@@ -550,6 +608,13 @@ class StagingBuffer:
             )
             self._thread.start()
             return self
+        if self._assemble_spec is not None:
+            # Assembled intake lands rows into ring slots even on the
+            # single-consumer path (memcpy of batch N+1 overlaps the H2D
+            # of batch N; lease protocol identical to pool mode). Fresh
+            # ring per start — a finished learner loop may still hold a
+            # lease on an old slot, exactly the pool-mode hazard.
+            self._ring = self._fused_io.make_ring(self._staging_cfg.transfer_depth)
         self._thread = threading.Thread(target=self._run, daemon=True, name="staging-consumer")
         self._thread.start()
         return self
@@ -812,6 +877,8 @@ class StagingBuffer:
         Pool mode (pack_workers > 1) row-shards the same copy across the
         worker pool — bitwise identical output for any split — and in
         fused mode targets a TransferRing slot, returned as the lease."""
+        if self._assemble_spec is not None:
+            return self._pack_assembled(items)
         # Fuse the compute-dtype obs cast into the copy when staging
         # targets bf16 (bitwise equal to the separate numpy astype pass
         # it replaces; ~1.1ms/batch at flagship shapes).
@@ -948,6 +1015,48 @@ class StagingBuffer:
             return out, None, None  # cast applied in-copy
         return cast_obs_to_compute_dtype(self.cfg, out), None, None
 
+    def _pack_assembled(self, items: List):
+        """Assembled-intake landing: every pending item is an
+        AssembledRow whose payload already holds the exact RowLayout
+        bytes, so "packing" a batch is a ring-slot acquire plus one
+        C-level row concat and one bulk copy per dtype group (single
+        bulk copy in single-buffer mode) — no parse, no per-field
+        scatter, no cast.
+        Bitwise identical to the classic pack of the same wire
+        frames: the shard ran the SAME row encoder over the SAME bytes
+        (scripts/ab_inet_pack.py pins this, INET_PACK_AB.json)."""
+        t0 = time.perf_counter()
+        slot = None
+        while slot is None:
+            if self._stop.is_set():
+                raise _StagingStopped()
+            # Ring backpressure: every slot ready or in transfer.
+            slot = self._ring.acquire(timeout=0.2)
+        with self._stats_lock:
+            self._stats["pack_ring_wait_s"] += time.perf_counter() - t0
+        payload = slot.payload
+        n_rows = len(items)
+        # One C-level concat of the row payloads into a [rows, row_bytes]
+        # matrix (b"".join is a single allocation+memcpy pass), then
+        # bulk-land it — per-row python slicing costs more than the pack
+        # it replaces at B=256 (the AB's landing-strategy measurement).
+        raw = np.frombuffer(
+            b"".join(row.payload for row in items), np.uint8
+        ).reshape(n_rows, self._fused_io.row_bytes)
+        if isinstance(payload, dict):
+            # Grouped transfer layout: one vectorized strided copy per
+            # dtype group — the row layout's segment order/offsets are
+            # the grouped layout's columns, so each group is a column
+            # slice of the stacked rows.
+            seg_off = self._fused_io.seg_off
+            for key, buf in payload.items():
+                u8 = buf.view(np.uint8)
+                off = seg_off[key]
+                u8[:n_rows] = raw[:, off : off + u8.shape[1]]
+        else:
+            payload[:n_rows] = raw
+        return slot.batch, payload, slot
+
     def _parse(self, frame: bytes):
         """PYTHON-fallback frame parse → ((Rollout, version, L, H,
         actor_id, ep_return, last_done), None) or (None, reason) if
@@ -1023,7 +1132,76 @@ class StagingBuffer:
         deque copy; the flight recorder dumps this as a section."""
         return list(self._quarantine)  # graftlint: disable=THR001(one GIL-atomic deque-snapshot copy; appends live in _ingest on the sole writer thread)
 
+    def _ingest_assembled(self, rows: List) -> None:
+        """Assembled-intake twin of _ingest: items are AssembledRows the
+        fabric fan-in already fence-checked, so admission here is pure
+        sidecar bookkeeping — staleness filter on the shard-stamped
+        version, episode accounting from the last_done row flag, trace
+        hops from the sidecar ids, heartbeats from actor_id. No parse:
+        a row that reached this host was already validated (and its
+        layout_crc handshake pinned) by the shard; the one defensive
+        check left is the payload length, which dead-letters under the
+        classic "layout" reason rather than poisoning the memcpy."""
+        version_now = self.version_fn()
+        min_version = version_now - self.cfg.ppo.max_staleness
+        spec = self._assemble_spec
+        consumed = len(rows)
+        dropped_stale = dropped_bad = quarantined = episodes = 0
+        ep_ret = 0.0
+        now = time.monotonic()
+        tr = self._tracer
+        wire_bytes = 0
+        wire_bf16 = wire_f32 = 0
+        for row in rows:
+            wire_bytes += len(row.payload)
+            if len(row.payload) != spec.row_bytes:
+                dropped_bad += 1
+                quarantined += 1
+                self._quarantine_put(row.payload, "layout")
+                continue
+            # The wire dtype is a block-level fact in assembled mode
+            # (every row of a block shares the negotiated layout), but
+            # the fleetwide bf16-rollout gauges must keep counting.
+            if spec.obs_bf16:
+                wire_bf16 += 1
+            else:
+                wire_f32 += 1
+            self._actor_seen[row.actor_id] = now
+            if len(self._actor_seen) > 4096:
+                cutoff = now - self.heartbeat_window_s
+                self._actor_seen = {
+                    a: t for a, t in self._actor_seen.items() if t >= cutoff
+                }
+            ref = None
+            if tr is not None and (row.trace_id or row.birth_time):
+                ref = TraceRef(row.trace_id, row.birth_time)
+                # covers serialize + shard assembly + block wire
+                tr.hop("consume", ref)
+            if row.version < min_version:
+                dropped_stale += 1
+                continue
+            if row.last_done:
+                episodes += 1
+                ep_ret += row.episode_return
+            self._pending.append(row)
+            if tr is not None:
+                if ref is not None:
+                    tr.hop("staging_admit", ref)
+                self._pending_traces.append(ref)
+        with self._stats_lock:
+            self._stats["consumed"] += consumed
+            self._stats["dropped_stale"] += dropped_stale
+            self._stats["dropped_bad"] += dropped_bad
+            self._stats["quarantined"] += quarantined
+            self._stats["episodes"] += episodes
+            self._stats["episode_return_sum"] += ep_ret
+            self._stats["wire_bytes"] += wire_bytes
+            self._stats["wire_frames_obs_bf16"] += wire_bf16
+            self._stats["wire_frames_obs_f32"] += wire_f32
+
     def _ingest(self, frames: List[bytes]) -> None:
+        if self._assemble_spec is not None:
+            return self._ingest_assembled(frames)
         version_now = self.version_fn()
         min_version = version_now - self.cfg.ppo.max_staleness
         H = self.cfg.policy.lstm_hidden
@@ -1450,6 +1628,11 @@ class StagingBuffer:
             out["pack_rows_per_s"] = out["rows_packed"] / max(
                 out.get("pack_wall_s", 0.0), 1e-9
             )
+        elif self._ring is not None:
+            # Assembled intake: ring gauges without a pool (the concat
+            # landing runs on the one consumer thread).
+            out["pack_ring_depth"] = float(self._ring.depth)
+            out["pack_ring_occupancy"] = float(self._ring.occupancy)
         return out
 
     def stop(self) -> None:

@@ -1,0 +1,204 @@
+"""Shard-side row assembly for --broker.assemble (in-network batch
+assembly, ISSUE 20).
+
+A fabric shard running with assembly armed packs each admitted frame
+ONCE into the native packer's exact single-buffer row layout
+(parallel/fused_io.RowLayout) and serves consumers DTB1 blocks of
+pre-packed rows; the learner host then lands rows with memcpy only.
+The row encoder here is the SAME code the learner-side pack uses — a
+1-row native PackPlan (or the python fill_rollouts fallback) over
+views of the same RowLayout — so shard-assembled and learner-assembled
+bytes are provably identical (INET_PACK_AB.json pins this bitwise).
+
+Import discipline: the module top level touches only stdlib + the
+transport wire helpers already in the classic shard's import closure.
+Everything heavy — the TrainBatch template (ops.batch -> jax),
+RowLayout (parallel.fused_io -> jax), ml_dtypes, the native packer —
+loads lazily inside RowAssembler, so a shard that never arms
+--broker.assemble keeps today's import surface (subprocess-proven in
+tests/test_inet_assemble.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+from dotaclient_tpu.transport.fabric import peek_fabric, strip_fabric
+from dotaclient_tpu.transport.serialize import (
+    _ROLLOUT_MAGIC2,
+    _ROLLOUT_MAGIC3,
+    AssembledRow,
+    BlockSpec,
+    check_dtr3_dtype_map,
+    peek_rollout_trace,
+    strip_rollout_trace,
+)
+
+
+def flatten_batch(batch) -> List:
+    """TrainBatch -> leaf list in jax.tree.flatten order, without jax.
+
+    The pytree here is nothing but (named)tuples, ndarrays, and Nones;
+    jax flattens namedtuples in field order and drops Nones, which this
+    recursion reproduces exactly. test_inet_assemble pins the layout_crc
+    built from this order against FusedBatchIO's jax-flattened one, so
+    a divergence (e.g. a dict sneaking into TrainBatch) fails loudly."""
+    out: List = []
+
+    def walk(x):
+        if x is None:
+            return
+        if isinstance(x, tuple):
+            for v in x:
+                walk(v)
+            return
+        out.append(x)
+
+    walk(batch)
+    return out
+
+
+def unflatten_like(template, leaves: Iterator):
+    """Rebuild `template`'s (named)tuple structure with leaves drawn
+    from `leaves` — inverse of flatten_batch over the same structure."""
+    if template is None:
+        return None
+    if isinstance(template, tuple):
+        vals = [unflatten_like(v, leaves) for v in template]
+        if hasattr(template, "_fields"):  # namedtuple
+            return type(template)(*vals)
+        return tuple(vals)
+    return next(leaves)
+
+
+class RowAssembler:
+    """Packs one wire frame at a time into RowLayout row bytes.
+
+    Single-threaded by design: the broker event loop owns it (one per
+    armed shard), packing at admission and at the lazy backlog sweep.
+    Holds a persistent 1-row buffer + a pristine copy (zeros + the
+    template's NOOP action-mask floor); each pack restores pristine
+    bytes first so short rollouts leave no residue from longer ones —
+    the same guarantee zeros_train_batch gives the classic path.
+    """
+
+    def __init__(
+        self,
+        seq_len: int,
+        lstm_hidden: int,
+        with_aux: bool,
+        obs_bf16: bool,
+        use_native: bool = True,
+    ):
+        import numpy as np
+
+        from dotaclient_tpu.ops.batch import zeros_train_batch
+        from dotaclient_tpu.parallel.fused_io import RowLayout
+
+        obs_dtype = None
+        if obs_bf16:
+            import ml_dtypes
+
+            obs_dtype = ml_dtypes.bfloat16
+        self._np = np
+        tmpl = zeros_train_batch(
+            1, seq_len, lstm_hidden, with_aux, obs_dtype=obs_dtype
+        )
+        tmpl_leaves = flatten_batch(tmpl)
+        layout = RowLayout([(tuple(l.shape), l.dtype) for l in tmpl_leaves])
+        self.layout = layout
+        self.spec = BlockSpec(
+            seq_len=seq_len,
+            lstm_hidden=lstm_hidden,
+            with_aux=with_aux,
+            obs_bf16=obs_bf16,
+            row_bytes=layout.row_bytes,
+            layout_crc=layout.layout_crc,
+        )
+        self._buf = np.zeros((1, layout.row_bytes), np.uint8)
+        views = layout.views_into(self._buf, 1)
+        self._batch = unflatten_like(tmpl, iter(views))
+        # Seed the views with the template content (zeros everywhere but
+        # the NOOP action-mask floor), then snapshot the pristine bytes.
+        for view, leaf in zip(flatten_batch(self._batch), tmpl_leaves):
+            view[:] = leaf
+        self._pristine = self._buf.copy()
+        self._native = None
+        self._plan = None
+        if use_native:
+            from dotaclient_tpu import native
+
+            lib = native.load_packer()
+            if lib is not None:
+                self._native = native
+                self._lib = lib
+                self._plan = native.PackPlan(
+                    lib, self._batch, 1, seq_len, lstm_hidden,
+                    with_aux, obs_bf16, 0, 1,
+                )
+
+    @property
+    def native_active(self) -> bool:
+        return self._plan is not None
+
+    def assemble(self, frame: bytes, priority: float = 0.0) -> AssembledRow:
+        """One admitted broker frame (FAB1 envelope included when the
+        producer sent one) -> a packed AssembledRow.
+
+        Raises ValueError with the quarantine reason ("dtype_map",
+        "parse", "layout") on a frame the classic ingest would also
+        reject — the caller meters it, never ships it."""
+        np = self._np
+        boot = epoch = seq = 0
+        env = peek_fabric(frame)
+        if env is not None:
+            _key, boot, epoch, seq = env
+            frame = strip_fabric(frame)
+        trace_id, birth = 0, 0.0
+        if frame[:4] == _ROLLOUT_MAGIC2:
+            trace_id, birth = peek_rollout_trace(frame)
+            frame = strip_rollout_trace(frame)
+        if frame[:4] == _ROLLOUT_MAGIC3:
+            reason = check_dtr3_dtype_map(frame)
+            if reason is not None:
+                raise ValueError(reason)
+            trace_id, birth = peek_rollout_trace(frame)
+        np.copyto(self._buf, self._pristine)
+        if self._plan is not None:
+            hdr = self._native.frame_header(self._lib, frame)
+            if hdr is None:
+                raise ValueError("parse")
+            version, L, H, _flags, actor_id, ep_ret, last_done = hdr
+            if L > self.spec.seq_len or H != self.spec.lstm_hidden:
+                raise ValueError("layout")
+            self._plan.pack([frame])
+        else:
+            from dotaclient_tpu.runtime.staging import fill_rollouts
+            from dotaclient_tpu.transport.serialize import deserialize_rollout
+
+            try:
+                r = deserialize_rollout(frame)
+            except ValueError:
+                raise ValueError("parse")
+            L = r.length
+            if L > self.spec.seq_len or (
+                r.initial_state[0].shape[0] != self.spec.lstm_hidden
+            ):
+                raise ValueError("layout")
+            fill_rollouts(self._batch, [r], self.spec.seq_len)
+            version, actor_id = r.version, r.actor_id
+            ep_ret = float(r.episode_return)
+            last_done = float(r.dones[L - 1]) if L else 0.0
+        return AssembledRow(
+            payload=self._buf.tobytes(),
+            version=int(version),
+            actor_id=int(actor_id),
+            episode_return=float(ep_ret),
+            trace_id=int(trace_id),
+            birth_time=float(birth),
+            priority=float(priority),
+            boot=int(boot),
+            epoch=int(epoch),
+            seq=int(seq),
+            last_done=float(last_done) > 0.0,
+        )

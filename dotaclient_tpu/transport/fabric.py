@@ -77,7 +77,10 @@ from dotaclient_tpu.transport.base import (
     RetryPolicy,
     connect as _connect,
 )
-from dotaclient_tpu.transport.serialize import peek_rollout_actor_id
+from dotaclient_tpu.transport.serialize import (
+    deserialize_block,
+    peek_rollout_actor_id,
+)
 
 _log = logging.getLogger(__name__)
 
@@ -256,6 +259,12 @@ class FabricBroker(Broker):
             sorted(set(consume_shards)) if consume_shards is not None else None
         )
         self._fence = ShardFence()
+        # In-network assembly (ISSUE 20): when a BlockSpec is set the
+        # pop threads issue GET_BLOCK instead of CONSUME and the fan-in
+        # queue carries serialize.AssembledRow objects (one row == one
+        # frame, so every residual/quiesce/drain contract holds in the
+        # same units).
+        self._block_spec = None
         self._fanin: "queue.Queue" = queue.Queue(maxsize=fanin_depth)
         self._stop = threading.Event()
         self._quiesce = threading.Event()
@@ -421,6 +430,28 @@ class FabricBroker(Broker):
 
     # ----------------------------------------------------------- consume
 
+    def enable_assembled_consume(self, spec) -> None:
+        """Switch this consumer's fan-in to shard-assembled DTB1 blocks
+        (serialize.BlockSpec = the learner's exact row layout; the shard
+        refuses any other). Must run before the first consume — the pop
+        threads are built in one mode and stay there. Consumed items
+        become serialize.AssembledRow objects. Every consumed shard must
+        be a tcp:// endpoint (GET_BLOCK is a tcp-broker op; mem:// test
+        brokers have no assembly tier)."""
+        with self._fanin_lock:
+            if self._fanin_started:
+                raise RuntimeError("enable_assembled_consume after fan-in started")
+            bad = [
+                self.endpoints[i]
+                for i in self._my_shards()
+                if not self.endpoints[i].startswith("tcp://")
+            ]
+            if bad:
+                raise ValueError(
+                    f"assembled consume needs tcp:// shards, got {bad}"
+                )
+            self._block_spec = spec
+
     def _ensure_fanin(self) -> None:
         with self._fanin_lock:
             if self._fanin_started:
@@ -458,9 +489,16 @@ class FabricBroker(Broker):
                 self._mid_pop[i] = True
             try:
                 try:
-                    frames = self._shard(i).consume_experience(
-                        max_items=self._pop_batch, timeout=0.2
-                    )
+                    shard = self._shard(i)
+                    if self._block_spec is not None:
+                        block = shard.consume_block(
+                            self._block_spec, max_rows=self._pop_batch, timeout=0.2
+                        )
+                        _, frames = deserialize_block(block)
+                    else:
+                        frames = shard.consume_experience(
+                            max_items=self._pop_batch, timeout=0.2
+                        )
                 except (ConnectionError, OSError, ValueError):
                     self._mark_down(i)
                     with self._meters_lock:
@@ -477,11 +515,22 @@ class FabricBroker(Broker):
                 with self._meters_lock:
                     self._shard_popped[i] += len(frames)
                 for f in frames:
-                    env = peek_fabric(f)
-                    if env is not None:
-                        if not self._fence.admit(*env):
+                    if self._block_spec is not None:
+                        # Assembled row: the fence stamp rode the sidecar
+                        # (the shard packed the FAB1 envelope into it);
+                        # boot 0 = un-enveloped producer, always admitted.
+                        # The route key IS the actor_id — publish derives
+                        # it from the same header field.
+                        if f.boot and not self._fence.admit(
+                            f.actor_id, f.boot, f.epoch, f.seq
+                        ):
                             continue
-                        f = f[_ENV.size :]
+                    else:
+                        env = peek_fabric(f)
+                        if env is not None:
+                            if not self._fence.admit(*env):
+                                continue
+                            f = f[_ENV.size :]
                     while not self._stop.is_set():
                         try:
                             self._fanin.put(f, timeout=0.2)
@@ -656,6 +705,7 @@ def shard_metrics_source(server):
 
     def source():
         led = server.ledger()
+        asm = server.assemble_ledger()
         return {
             "broker_shard_enqueued_total": float(led["enqueued"]),
             "broker_shard_popped_total": float(led["popped"]),
@@ -665,6 +715,21 @@ def shard_metrics_source(server):
             "broker_shard_evicted_low_total": float(led["evicted_low"]),
             "broker_shard_resident": float(led["resident"]),
             "broker_shard_depth": float(led["resident"]),
+            # In-network assembly station (--broker.assemble; all zero
+            # when the shard is not armed). Conservation identity:
+            # admitted = packed + reject + bypassed + dropped + resident
+            # (obs/fleet.py "assembled" LedgerSpec; the fleetd auditor
+            # and graftproto SVC004 both consume these names).
+            "broker_assemble_rows_admitted_total": float(asm["rows_admitted"]),
+            "broker_assemble_rows_packed_total": float(asm["rows_packed"]),
+            "broker_assemble_rows_reject_total": float(asm["rows_reject"]),
+            "broker_assemble_rows_bypassed_total": float(asm["rows_bypassed"]),
+            "broker_assemble_rows_dropped_total": float(asm["rows_dropped"]),
+            "broker_assemble_rows_resident": float(asm["rows_resident"]),
+            "broker_assemble_blocks_built_total": float(asm["blocks_built"]),
+            "broker_assemble_blocks_served_total": float(asm["blocks_served"]),
+            "broker_assemble_block_bytes_total": float(asm["block_bytes"]),
+            "broker_assemble_cpu_s_total": round(float(asm["cpu_s"]), 6),
         }
 
     return source
@@ -699,6 +764,16 @@ def main(argv=None):
         help="age half-life of the eviction priority decay, seconds",
     )
     p.add_argument(
+        "--broker.assemble", dest="broker_assemble",
+        type=lambda s: s.lower() in ("1", "true", "yes", "on"),
+        default=False,
+        help="in-network batch assembly: pre-pack admitted frames into "
+        "the learner's exact row layout at admission and serve DTB1 "
+        "blocks to GET_BLOCK consumers (ISSUE 20). Flip CONSUMER-first "
+        "— the learner must understand DTB1 before any shard arms this "
+        "(MIGRATION item 20); off = byte-identical classic shard",
+    )
+    p.add_argument(
         "--metrics_port", type=int, default=0,
         help="obs scrape surface port: /metrics (broker_shard_* ledger "
         "gauges), /healthz, /debug/flight (0 = no surface, the pre-"
@@ -713,6 +788,7 @@ def main(argv=None):
         shed_low=args.shed_low,
         priority_shed=args.priority,
         prio_half_life_s=args.prio_half_life_s,
+        assemble=args.broker_assemble,
     ).start()
     obs_http = None
     if args.metrics_port != 0:
@@ -734,10 +810,11 @@ def main(argv=None):
         ).start()
     shed = f", shed {args.shed_high}/{args.shed_low}" if args.shed_high else ""
     prio = ", priority admission" if args.priority else ""
+    asm = ", assemble" if args.broker_assemble else ""
     obs_note = f", obs :{obs_http.port}" if obs_http is not None else ""
     print(
         f"fabric shard listening on {args.host}:{server.port} "
-        f"(queue bound {args.maxlen}{shed}{prio}{obs_note})",
+        f"(queue bound {args.maxlen}{shed}{prio}{asm}{obs_note})",
         flush=True,
     )
     try:

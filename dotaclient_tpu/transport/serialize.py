@@ -501,6 +501,170 @@ def deserialize_obs(
 
 # --- weights -----------------------------------------------------------
 
+# --------------------------------------------------------------------------
+# DTB1: pre-assembled batch-shard blocks (ISSUE 20 in-network assembly).
+#
+# A fabric shard running --broker.assemble packs each admitted frame ONCE
+# into the native packer's exact single-buffer row layout
+# (parallel/fused_io.py RowLayout) and serves consumers whole blocks of
+# rows plus a per-row sidecar, so the learner's host side is memcpy-only.
+#
+# Block layout (little-endian):
+#   magic  b'DTB1'
+#   u8     fmt        — format revision (1)
+#   u16    n_rows
+#   u16    seq_len    — T (row padded to T steps; obs carry T+1)
+#   u16    lstm_hidden
+#   u8     flags      — bit0: aux targets; bit1: obs leaves staged bf16
+#   u32    row_bytes  — bytes per packed row (RowLayout.row_bytes)
+#   u32    layout_crc — RowLayout.layout_crc; the consumer REFUSES a
+#          block whose crc differs from its own layout (a schema or
+#          segment-order drift would otherwise scramble silently)
+#   n_rows × 52-byte sidecar (_BLK_SIDE below): model_version, actor_id,
+#          episode_return, trace_id, birth_time, priority, the fabric
+#          fence stamp (boot/epoch/seq — boot 0 marks a row from an
+#          un-enveloped producer: always admitted, like an un-enveloped
+#          PUB frame), and row_flags (bit0: the row's final step ended
+#          an episode — the learner's episode accounting)
+#   n_rows × row_bytes packed row payload.
+
+BLOCK_MAGIC = b"DTB1"
+_BLK = struct.Struct("<4sBHHHBII")
+_BLK_SIDE = struct.Struct("<IIfQdfQIII")
+_BLK_FLAG_AUX = 1
+_BLK_FLAG_OBS_BF16 = 2
+_BLK_ROW_DONE = 1  # row_flags bit0: last real step completed an episode
+_BLK_FMT = 1
+
+
+class BlockSpec(NamedTuple):
+    """Everything two processes must agree on for a packed row to be
+    byte-portable between them. The consumer sends its spec in the
+    GET_BLOCK request; the shard embeds its own in every block header."""
+
+    seq_len: int
+    lstm_hidden: int
+    with_aux: bool
+    obs_bf16: bool
+    row_bytes: int
+    layout_crc: int
+
+
+class AssembledRow(NamedTuple):
+    """One pre-packed batch row + its sidecar (what a DTR frame becomes
+    after shard-side assembly). `payload` is exactly RowLayout.row_bytes
+    long; the fence stamp mirrors the FAB1 envelope the frame arrived
+    under (boot=0 = un-enveloped, always admitted)."""
+
+    payload: bytes
+    version: int
+    actor_id: int = 0
+    episode_return: float = 0.0
+    trace_id: int = 0
+    birth_time: float = 0.0
+    priority: float = 0.0
+    boot: int = 0
+    epoch: int = 0
+    seq: int = 0
+    last_done: bool = False
+
+
+def block_spec_flags(spec: BlockSpec) -> int:
+    """The u8 flags byte a BlockSpec serializes to (block header and
+    GET_BLOCK request share the encoding)."""
+    return (_BLK_FLAG_AUX if spec.with_aux else 0) | (
+        _BLK_FLAG_OBS_BF16 if spec.obs_bf16 else 0
+    )
+
+
+def serialize_block(spec: BlockSpec, rows: List[AssembledRow]) -> bytes:
+    flags = block_spec_flags(spec)
+    parts = [
+        _BLK.pack(
+            BLOCK_MAGIC,
+            _BLK_FMT,
+            len(rows),
+            spec.seq_len,
+            spec.lstm_hidden,
+            flags,
+            spec.row_bytes,
+            spec.layout_crc,
+        )
+    ]
+    for r in rows:
+        parts.append(
+            _BLK_SIDE.pack(
+                r.version & 0xFFFFFFFF,
+                r.actor_id & 0xFFFFFFFF,
+                float(r.episode_return),
+                r.trace_id & 0xFFFFFFFFFFFFFFFF,
+                float(r.birth_time),
+                float(r.priority),
+                r.boot & 0xFFFFFFFFFFFFFFFF,
+                r.epoch & 0xFFFFFFFF,
+                r.seq & 0xFFFFFFFF,
+                _BLK_ROW_DONE if r.last_done else 0,
+            )
+        )
+    for r in rows:
+        if len(r.payload) != spec.row_bytes:
+            raise ValueError(
+                f"block row payload {len(r.payload)}B != row_bytes {spec.row_bytes}"
+            )
+        parts.append(bytes(r.payload))
+    return b"".join(parts)
+
+
+def peek_block_spec(data: bytes) -> Optional[BlockSpec]:
+    """BlockSpec from a DTB1 header, or None if `data` is not a block."""
+    if len(data) < _BLK.size or data[:4] != BLOCK_MAGIC:
+        return None
+    magic, fmt, n, T, H, flags, row_bytes, crc = _BLK.unpack_from(data)
+    if fmt != _BLK_FMT:
+        return None
+    return BlockSpec(
+        seq_len=T,
+        lstm_hidden=H,
+        with_aux=bool(flags & _BLK_FLAG_AUX),
+        obs_bf16=bool(flags & _BLK_FLAG_OBS_BF16),
+        row_bytes=row_bytes,
+        layout_crc=crc,
+    )
+
+
+def deserialize_block(data: bytes) -> Tuple[BlockSpec, List[AssembledRow]]:
+    spec = peek_block_spec(data)
+    if spec is None:
+        raise ValueError("not a DTB1 block")
+    n = _BLK.unpack_from(data)[2]
+    need = _BLK.size + n * _BLK_SIDE.size + n * spec.row_bytes
+    if len(data) != need:
+        raise ValueError(f"block length {len(data)} != expected {need} ({n} rows)")
+    rows: List[AssembledRow] = []
+    pay0 = _BLK.size + n * _BLK_SIDE.size
+    for i in range(n):
+        version, actor_id, ep_ret, trace_id, birth, prio, boot, epoch, seq, rflags = (
+            _BLK_SIDE.unpack_from(data, _BLK.size + i * _BLK_SIDE.size)
+        )
+        off = pay0 + i * spec.row_bytes
+        rows.append(
+            AssembledRow(
+                payload=data[off : off + spec.row_bytes],
+                version=version,
+                actor_id=actor_id,
+                episode_return=ep_ret,
+                trace_id=trace_id,
+                birth_time=birth,
+                priority=prio,
+                boot=boot,
+                epoch=epoch,
+                seq=seq,
+                last_done=bool(rflags & _BLK_ROW_DONE),
+            )
+        )
+    return spec, rows
+
+
 _DTYPES = {0: np.float32, 1: np.int32, 2: np.uint8}
 
 

@@ -82,12 +82,33 @@ PUB_EXP, CONSUME, PUB_W, GET_W, DEPTH, STATS, PUB_EXP2 = (
 # kills the connection (unknown type) — upgrade brokers first, the
 # PUB_EXP2 precedent (MIGRATION item 14).
 PUB_EXPP, STATS2 = 0x08, 0x09
+# In-network batch assembly (--broker.assemble, transport/assemble.py):
+#   0x0A GET_BLOCK  payload = u16 max_rows, f32 timeout, u16 seq_len,
+#        u16 lstm_hidden, u8 flags, u32 row_bytes, u32 layout_crc (the
+#        consumer's BlockSpec — the shard packs into EXACTLY this row
+#        layout or kills the connection, never serves scrambled bytes)
+#                                                   → 0x89 reply
+#   0x89 reply      one DTB1 block (serialize.serialize_block; 0 rows
+#        when the wait timed out empty)
+# Only an armed shard answers GET_BLOCK; a classic broker kills the
+# connection on the unknown op (broker-first upgrade — but the flip
+# discipline is CONSUMER-first: the learner must understand DTB1 before
+# any shard arms assembly, MIGRATION item 20).
+GET_BLOCK = 0x0A
 R_ACK, R_CONSUME, R_GET_W, R_DEPTH, R_SHED, R_STATS, R_STATS2 = (
     0x81, 0x82, 0x84, 0x85, 0x86, 0x87, 0x88,
 )
+R_BLOCK = 0x89
+_GETBLK = struct.Struct("<HfHHBII")
 
 MAX_FRAME = 256 * 1024 * 1024
 _POLL_SLICE = 30.0  # max per-request server-side wait when blocking forever
+
+# _asm_meta entry for a frame that failed assembly (malformed / layout
+# mismatch): kept resident so the deques stay lockstep, counted as
+# asm_rows_reject when a block build pops it, still serveable to a
+# classic CONSUME (whose learner quarantines it, exactly as today).
+_ASM_REJECT = object()
 
 
 # --------------------------------------------------------------------- server
@@ -105,6 +126,8 @@ class BrokerServer:
         shed_low: int = 0,
         priority_shed: bool = False,
         prio_half_life_s: float = 8.0,
+        assemble: bool = False,
+        assemble_native: bool = True,
     ):
         if shed_high and shed_low >= shed_high:
             raise ValueError(
@@ -126,6 +149,36 @@ class BrokerServer:
             collections.deque(maxlen=maxlen) if priority_shed else None
         )
         self.evicted_low = 0  # residents evicted to admit a higher priority
+        # In-network batch assembly (--broker.assemble): a third deque in
+        # lockstep with `experience` holds each resident's (priority,
+        # packed-row) entry — pre-packed eagerly at admission once the
+        # first GET_BLOCK supplies the consumer's BlockSpec, lazily at
+        # block build for the pre-spec backlog. Entry values: None (not
+        # yet packed), an AssembledRow, or _ASM_REJECT (the frame failed
+        # assembly — metered when popped, never served in a block). Off
+        # (default): no deque, no per-publish work, classic wire bytes
+        # untouched (tests/test_inet_assemble.py pins this in a
+        # subprocess).
+        self.assemble = assemble
+        self.assemble_native = assemble_native
+        self._assembler = None  # transport.assemble.RowAssembler, lazy
+        self._asm_meta: Optional[collections.deque] = (
+            collections.deque(maxlen=maxlen) if assemble else None
+        )
+        # Assembly conservation counters (the broker_assemble_* meter
+        # family): every row admitted while armed is exactly one of
+        # packed (served in a block) / reject / bypassed (classic
+        # CONSUME took it) / dropped (drop-oldest or priority eviction)
+        # / still-resident.
+        self.asm_rows_admitted = 0
+        self.asm_rows_packed = 0
+        self.asm_rows_reject = 0
+        self.asm_rows_bypassed = 0
+        self.asm_rows_dropped = 0
+        self.asm_blocks_built = 0
+        self.asm_blocks_served = 0
+        self.asm_block_bytes = 0
+        self.asm_cpu_s = 0.0
         self.experience: collections.deque = collections.deque(maxlen=maxlen)
         self.dropped = 0
         # Conservation-ledger counters (loop-thread-written; cross-thread
@@ -204,9 +257,26 @@ class BrokerServer:
         lockstep and the priority metadata never misaligns."""
         if len(self.experience) == self.experience.maxlen:
             self.dropped += 1
+            if self._asm_meta is not None:
+                self.asm_rows_dropped += 1
         self.experience.append(frame)
         if self._prio_meta is not None:
             self._prio_meta.append((priority, time.monotonic()))
+        if self._asm_meta is not None:
+            # Pre-pack at admission — the point of --broker.assemble is
+            # that this CPU runs on the horizontally-scalable shard tier.
+            # Before the first GET_BLOCK supplies a spec the entry stays
+            # None (packed lazily at block build).
+            entry = None
+            if self._assembler is not None:
+                t0 = time.monotonic()
+                try:
+                    entry = self._assembler.assemble(frame, priority)
+                except ValueError:
+                    entry = _ASM_REJECT
+                self.asm_cpu_s += time.monotonic() - t0
+            self._asm_meta.append((priority, entry))
+            self.asm_rows_admitted += 1
         self.enqueued_total += 1
         if self.first_enqueue_t is None:
             self.first_enqueue_t = time.monotonic()
@@ -237,6 +307,9 @@ class BrokerServer:
                     if idx >= 0 and priority > min_eff:
                         del self.experience[idx]
                         del self._prio_meta[idx]
+                        if self._asm_meta is not None:
+                            del self._asm_meta[idx]
+                            self.asm_rows_dropped += 1
                         self.evicted_low += 1
                         admitted = True
                 if admitted:
@@ -275,6 +348,9 @@ class BrokerServer:
                     frames.append(self.experience.popleft())
                     if self._prio_meta is not None:
                         self._prio_meta.popleft()
+                    if self._asm_meta is not None:
+                        self._asm_meta.popleft()
+                        self.asm_rows_bypassed += 1
                 self.popped_total += len(frames)
             out = [struct.pack("<H", len(frames))]
             for f in frames:
@@ -290,6 +366,69 @@ class BrokerServer:
                 # kill path, hence BaseException).
                 self.reply_lost_frames += len(frames)
                 raise
+        elif mtype == GET_BLOCK:
+            if not self.assemble:
+                # Loudly, not silently: the consumer flipped assembled
+                # intake against a shard that wasn't armed — kill the
+                # connection (the unknown-op precedent) so the operator
+                # sees a hard failure, never a hung learner.
+                raise ValueError("GET_BLOCK against a shard without --broker.assemble")
+            max_rows, timeout, want_T, want_H, want_flags, want_rb, want_crc = (
+                _GETBLK.unpack(payload)
+            )
+            self._ensure_assembler(want_T, want_H, want_flags, want_rb, want_crc)
+            async with self._cond:
+                if not self.experience and timeout > 0:
+                    self.consume_waiters += 1
+                    try:
+                        await asyncio.wait_for(
+                            self._cond.wait_for(lambda: len(self.experience) > 0), timeout
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                    finally:
+                        self.consume_waiters -= 1
+                popped = []  # (frame, priority, entry)
+                while self.experience and len(popped) < max_rows:
+                    f = self.experience.popleft()
+                    if self._prio_meta is not None:
+                        self._prio_meta.popleft()
+                    prio, entry = self._asm_meta.popleft()
+                    popped.append((f, prio, entry))
+                self.popped_total += len(popped)
+            rows = []
+            for f, prio, entry in popped:
+                if entry is None:
+                    # Pre-spec backlog: pack now, same encoder.
+                    t0 = time.monotonic()
+                    try:
+                        entry = self._assembler.assemble(f, prio)
+                    except ValueError:
+                        entry = _ASM_REJECT
+                    self.asm_cpu_s += time.monotonic() - t0
+                if entry is _ASM_REJECT:
+                    self.asm_rows_reject += 1
+                else:
+                    rows.append(entry)
+            if self.priority_shed:
+                # Priority-ordered block: highest-priority rows first
+                # (stable — FIFO within a priority level). Pop order is
+                # FIFO either way, so the ledger semantics match CONSUME.
+                rows.sort(key=lambda r: -r.priority)
+            from dotaclient_tpu.transport.serialize import serialize_block
+
+            block = serialize_block(self._assembler.spec, rows)
+            self.asm_rows_packed += len(rows)
+            self.asm_blocks_built += 1
+            try:
+                await self._reply(writer, R_BLOCK, block)
+            except BaseException:
+                # Same contract as CONSUME: rows popped for a reply that
+                # never completed leave with this broker, counted.
+                self.reply_lost_frames += len(popped)
+                raise
+            self.asm_blocks_served += 1
+            self.asm_block_bytes += len(block)
         elif mtype == STATS:
             await self._reply(
                 writer,
@@ -337,6 +476,42 @@ class BrokerServer:
             await self._reply(writer, R_DEPTH, struct.pack("<II", len(self.experience), self.dropped))
         else:
             raise ValueError(f"unknown message type {mtype:#x}")
+
+    def _ensure_assembler(self, T: int, H: int, flags: int, row_bytes: int, crc: int):
+        """Build the RowAssembler from the consumer's spec (first
+        GET_BLOCK) and verify this shard reproduces EXACTLY the
+        requested row layout. Any disagreement — a featurizer/schema
+        drift between shard and learner images, or a second consumer
+        with a different spec — kills the connection rather than ever
+        serving bytes the consumer would scramble into its batch."""
+        from dotaclient_tpu.transport.serialize import (
+            _BLK_FLAG_AUX,
+            _BLK_FLAG_OBS_BF16,
+            block_spec_flags,
+        )
+
+        if self._assembler is None:
+            from dotaclient_tpu.transport.assemble import RowAssembler
+
+            t0 = time.monotonic()
+            self._assembler = RowAssembler(
+                T,
+                H,
+                bool(flags & _BLK_FLAG_AUX),
+                bool(flags & _BLK_FLAG_OBS_BF16),
+                use_native=self.assemble_native,
+            )
+            self.asm_cpu_s += time.monotonic() - t0
+        spec = self._assembler.spec
+        mine = (
+            spec.seq_len, spec.lstm_hidden, block_spec_flags(spec),
+            spec.row_bytes, spec.layout_crc,
+        )
+        want = (T, H, flags, row_bytes, crc)
+        if mine != want:
+            raise ValueError(
+                f"DTB1 spec mismatch: shard assembles {mine}, consumer wants {want}"
+            )
 
     async def _reply(self, writer: asyncio.StreamWriter, mtype: int, payload: bytes):
         writer.write(_LEN.pack(len(payload)) + _TYPE.pack(mtype) + payload)
@@ -419,6 +594,26 @@ class BrokerServer:
             "reply_lost": self.reply_lost_frames,
             "evicted_low": self.evicted_low,
             "resident": len(self.experience),
+        }
+
+    def assemble_ledger(self) -> dict:
+        """Assembly-station conservation snapshot (all zero when the
+        shard is not armed). Identity at any quiescent point:
+        `rows_admitted == rows_packed + rows_reject + rows_bypassed +
+        rows_dropped + rows_resident` — a kill mid-assembly leaves its
+        rows in `resident` (or `reply_lost` via the classic counter),
+        never unaccounted (obs/fleet.py "assembled" LedgerSpec)."""
+        return {
+            "rows_admitted": self.asm_rows_admitted,
+            "rows_packed": self.asm_rows_packed,
+            "rows_reject": self.asm_rows_reject,
+            "rows_bypassed": self.asm_rows_bypassed,
+            "rows_dropped": self.asm_rows_dropped,
+            "rows_resident": len(self.experience) if self.assemble else 0,
+            "blocks_built": self.asm_blocks_built,
+            "blocks_served": self.asm_blocks_served,
+            "block_bytes": self.asm_block_bytes,
+            "cpu_s": self.asm_cpu_s,
         }
 
     def stop(self):
@@ -624,6 +819,43 @@ class TcpBroker(Broker):
             frames.append(payload[off : off + n])
             off += n
         return frames
+
+    def consume_block(self, spec, max_rows: int, timeout: Optional[float] = None) -> bytes:
+        """GET_BLOCK: pop up to `max_rows` shard-assembled rows as one
+        DTB1 block (raw bytes — the caller deserializes; staging hands
+        payloads straight to memcpy). `spec` is the consumer's
+        serialize.BlockSpec; the shard refuses (connection kill) rather
+        than serve a different row layout. Same timeout semantics as
+        consume_experience; a 0-row block means the wait expired empty.
+        Requires an armed assemble-era shard — any other broker kills
+        the connection on the unknown op (MIGRATION item 20)."""
+        from dotaclient_tpu.transport.serialize import block_spec_flags
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if deadline is None:
+                wait = _POLL_SLICE
+            else:
+                wait = max(0.0, deadline - time.monotonic())
+            slice_wait = min(wait, _POLL_SLICE)
+            payload = self._exp.request(
+                GET_BLOCK,
+                _GETBLK.pack(
+                    max_rows,
+                    slice_wait,
+                    spec.seq_len,
+                    spec.lstm_hidden,
+                    block_spec_flags(spec),
+                    spec.row_bytes,
+                    spec.layout_crc,
+                ),
+                R_BLOCK,
+                read_timeout=slice_wait + 10.0,
+            )
+            assert payload is not None
+            (count,) = struct.unpack_from("<H", payload, 5)  # _BLK n_rows
+            if count or (deadline is not None and time.monotonic() >= deadline):
+                return payload
 
     def publish_weights(self, data: bytes) -> None:
         self._w.request(PUB_W, data, R_ACK)

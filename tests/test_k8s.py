@@ -297,6 +297,33 @@ def test_broker_fabric_statefulset_and_shard_lists_match_replicas():
     assert lists >= 4  # learner, multihost learner, actors, evaluator, serve
 
 
+def test_broker_assemble_pinned_off_with_ab_paper_trail():
+    """In-network batch assembly (ISSUE 20): the shard fleet ships
+    --broker.assemble EXPLICITLY pinned (the chaos-flag precedent) and
+    the pin is OFF — the consumers-first rollout arms learners
+    (--staging.assemble) before any shard pre-packs, and an unarmed
+    shard is subprocess-proven byte-for-byte HEAD
+    (tests/test_inet_assemble.py). The committed INET_PACK_AB verdict
+    must be ALL GREEN regardless: it is the bitwise shard-pack parity
+    proof a future flip rides on (the WIRE_SOAK flip pattern — changing
+    this pin must touch the artifact too; MIGRATION item 20 is the
+    rollout order, rollback = clear the flag)."""
+    verdict = json.loads((K8S.parent / "INET_PACK_AB.json").read_text())["verdict"]
+    assert verdict["all_green"] is True, (
+        "the --broker.assemble pin requires a green INET_PACK_AB verdict"
+    )
+    (_, doc), = [
+        (f, d) for f, d in DOCS
+        if d["metadata"]["name"] == "broker" and d["kind"] != "Service"
+    ]
+    args = doc["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--broker.assemble" in args, "broker.assemble not pinned"
+    assert args[args.index("--broker.assemble") + 1] == "false", (
+        "assembly ships OFF until the learner fleet runs "
+        "--staging.assemble (consumers-first; MIGRATION item 20)"
+    )
+
+
 def test_chaos_pinned_off_in_all_prod_manifests():
     """Chaos fault injection is a soak-only tool: every production
     container of this package that HAS the flag must pin it false, so a

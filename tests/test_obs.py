@@ -328,6 +328,20 @@ def test_registry_unregistered_filter():
     assert registry.is_registered("staging_pack_ring_occupancy")
     assert registry.is_registered("staging_pack_ring_wait_s")
     assert registry.is_registered("staging_pack_rows_per_s")
+    # in-network batch assembly (ISSUE 20): the shard binary exports
+    # the assemble-tier ledger as the broker_assemble_ family — pin the
+    # conservation terms (obs/fleet.py "assembled" LedgerSpec joins on
+    # exactly these) and the shard-side cost meter.
+    assert registry.is_registered("broker_assemble_rows_admitted_total")
+    assert registry.is_registered("broker_assemble_rows_packed_total")
+    assert registry.is_registered("broker_assemble_rows_reject_total")
+    assert registry.is_registered("broker_assemble_rows_bypassed_total")
+    assert registry.is_registered("broker_assemble_rows_dropped_total")
+    assert registry.is_registered("broker_assemble_rows_resident")
+    assert registry.is_registered("broker_assemble_blocks_built_total")
+    assert registry.is_registered("broker_assemble_blocks_served_total")
+    assert registry.is_registered("broker_assemble_block_bytes_total")
+    assert registry.is_registered("broker_assemble_cpu_s_total")
     # fleet telemetry plane (ISSUE 18): the rollup family fleetd serves
     # and the producer-side counters its conservation audit joins on.
     assert registry.is_registered("fleet_unaccounted_frames")
